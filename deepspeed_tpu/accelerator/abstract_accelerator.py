@@ -1,0 +1,82 @@
+"""Hardware abstraction seam.
+
+TPU-native re-design of the reference's ``accelerator/abstract_accelerator.py``
+(``DeepSpeedAccelerator`` ABC). The reference ABC is stream/event centric
+because CUDA exposes manual scheduling; under XLA the compiler owns scheduling,
+so the surviving surface is: device enumeration, memory stats, RNG, dtype
+support, profiler ranges, the communication backend name, and op dispatch.
+"""
+
+import abc
+
+
+class Accelerator(abc.ABC):
+    _name: str = "abstract"
+
+    # --- identity -------------------------------------------------------
+    def device_name(self, device_index=None) -> str:
+        raise NotImplementedError
+
+    def is_available(self) -> bool:
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Global device count visible to this process group."""
+
+    @abc.abstractmethod
+    def local_device_count(self) -> int:
+        """Devices attached to this host process."""
+
+    def current_device(self):
+        raise NotImplementedError
+
+    def communication_backend_name(self) -> str:
+        """'xla' on TPU: collectives are compiler-inserted over ICI/DCN
+        (reference returns 'nccl' for CUDA, abstract_accelerator.py:177)."""
+        raise NotImplementedError
+
+    # --- memory ---------------------------------------------------------
+    def memory_stats(self, device_index=None) -> dict:
+        raise NotImplementedError
+
+    def memory_allocated(self, device_index=None) -> int:
+        raise NotImplementedError
+
+    def total_memory(self, device_index=None) -> int:
+        raise NotImplementedError
+
+    def available_memory(self, device_index=None) -> int:
+        raise NotImplementedError
+
+    def empty_cache(self) -> None:
+        """XLA owns allocation; provided for API parity."""
+        return None
+
+    # --- dtype / capability --------------------------------------------
+    def is_bf16_supported(self) -> bool:
+        raise NotImplementedError
+
+    def is_fp16_supported(self) -> bool:
+        raise NotImplementedError
+
+    def supported_dtypes(self):
+        raise NotImplementedError
+
+    # --- RNG ------------------------------------------------------------
+    def default_rng(self, seed: int):
+        raise NotImplementedError
+
+    # --- profiler ranges (nvtx analogue) --------------------------------
+    def range_push(self, msg: str):
+        raise NotImplementedError
+
+    def range_pop(self):
+        raise NotImplementedError
+
+    # --- op builder dispatch -------------------------------------------
+    def create_op_builder(self, op_name: str):
+        raise NotImplementedError
+
+    def get_op_builder(self, op_name: str):
+        raise NotImplementedError

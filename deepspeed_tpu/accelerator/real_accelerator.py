@@ -1,0 +1,20 @@
+"""Accelerator selection (reference: ``accelerator/real_accelerator.py``:
+``get_accelerator()``/``set_accelerator()`` injection seam)."""
+
+_accelerator = None
+
+
+def get_accelerator():
+    global _accelerator
+    if _accelerator is None:
+        from deepspeed_tpu.accelerator.tpu_accelerator import TpuAccelerator
+
+        _accelerator = TpuAccelerator()
+    return _accelerator
+
+
+def set_accelerator(accel) -> None:
+    """Inject a third-party accelerator implementation (must be set before the
+    first get_accelerator() call to take effect everywhere)."""
+    global _accelerator
+    _accelerator = accel

@@ -1,0 +1,56 @@
+"""Per-collective message-size and bandwidth accounting.
+
+Reference: ``deepspeed/utils/comms_logging.py`` (``CommsLogger``: records
+per-op message sizes, computes algorithmic and bus bandwidth). On TPU the
+wrappers in ``deepspeed_tpu.comm`` call ``append`` at trace time — counts are
+per *compiled program*, not per execution, which is the meaningful unit
+under XLA (the schedule is static). Wall-times come from jax.profiler, not
+host timers, so this logger tracks volume + counts.
+"""
+
+from collections import defaultdict
+
+import numpy as np
+
+
+def get_msg_size(tensor) -> int:
+    try:
+        return int(np.prod(tensor.shape)) * tensor.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def convert_size(size_bytes: float) -> str:
+    if size_bytes == 0:
+        return "0B"
+    units = ("B", "KB", "MB", "GB", "TB")
+    i = 0
+    while size_bytes >= 1024 and i < len(units) - 1:
+        size_bytes /= 1024.0
+        i += 1
+    return f"{size_bytes:.2f} {units[i]}"
+
+
+class CommsLogger:
+    def __init__(self, verbose: bool = False):
+        self.verbose = verbose
+        # op_name -> msg_size -> count
+        self.comms_dict = defaultdict(lambda: defaultdict(int))
+
+    def append(self, op_name: str, tensor, axes):
+        size = get_msg_size(tensor)
+        self.comms_dict[op_name][size] += 1
+
+    def summary(self) -> dict:
+        out = {}
+        for op, sizes in self.comms_dict.items():
+            total = sum(size * count for size, count in sizes.items())
+            count = sum(sizes.values())
+            out[op] = {"count": count, "total_bytes": total, "total_human": convert_size(total)}
+        return out
+
+    def log_all(self):
+        from deepspeed_tpu.utils.logging import logger
+
+        for op, stats in self.summary().items():
+            logger.info(f"comm op: {op} | calls traced: {stats['count']} | volume: {stats['total_human']}")

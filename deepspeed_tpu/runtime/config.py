@@ -1,0 +1,319 @@
+"""JSON config system.
+
+TPU-native counterpart of the reference's ``runtime/config.py``
+(``DeepSpeedConfig``, config.py:674): same JSON schema (a user can bring their
+ds_config.json), same ``train_batch_size = micro_batch * grad_accum * DP``
+reconciliation, per-feature typed blocks, ``"auto"`` sentinel resolution.
+
+TPU-specific additions:
+  - ``mesh``: named mesh axis sizes ({"data": -1, "fsdp": 1, "tensor": 1,
+    "expert": 1, "pipe": 1, "sequence": 1}); -1 absorbs remaining devices.
+  - zero_optimization maps to sharding policy (see runtime/zero/config.py);
+    CUDA-specific knobs (bucket sizes, overlap_comm...) are accepted and
+    recorded for compatibility but XLA owns scheduling.
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import AUTO, ConfigError, from_dict, is_auto
+from deepspeed_tpu.runtime.zero.config import ZeroConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class FP16Config:
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+
+
+@dataclass
+class OptimizerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class GradientClippingHolder:
+    value: float = 0.0
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    # reference: activation_checkpointing/checkpointing.py configure() :789
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU addition: jax.checkpoint policy name (see runtime/activation_checkpointing)
+    policy: str = "full"
+
+
+@dataclass
+class TensorboardConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTpuJob"
+
+
+@dataclass
+class WandbConfig:
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+@dataclass
+class CSVConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTpuJob"
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class MeshConfig:
+    """Device mesh axis sizes; -1 on one axis absorbs the remainder."""
+
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class PipelineConfig:
+    stages: int = 1
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+
+
+@dataclass
+class MoEConfig:
+    enabled: bool = False
+    ep_size: int = 1
+    num_experts: int = 1
+    top_k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    use_rts: bool = True  # random token selection
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+
+
+@dataclass
+class EigenvalueConfig:
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+@dataclass
+class CurriculumConfig:
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class DataEfficiencyConfig:
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = field(default_factory=dict)
+    data_routing: Dict[str, Any] = field(default_factory=dict)
+
+
+class TpuConfig:
+    """Parsed, validated full config (reference DeepSpeedConfig equivalent)."""
+
+    def __init__(self, config, mesh_device_count: Optional[int] = None):
+        if isinstance(config, str):
+            with open(config, "r") as fh:
+                config = json.load(fh)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise ConfigError(f"config must be a dict or a path to a JSON file, got {type(config)}")
+        self._raw = dict(config)
+
+        g = config.get
+        self.train_batch_size = g(C.TRAIN_BATCH_SIZE, None)
+        self.train_micro_batch_size_per_gpu = g(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, None)
+        self.gradient_accumulation_steps = g(C.GRADIENT_ACCUMULATION_STEPS, None)
+        self.steps_per_print = g(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.gradient_clipping = g(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = g(C.PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = g(C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.wall_clock_breakdown = g(C.WALL_CLOCK_BREAKDOWN, False)
+        self.memory_breakdown = g("memory_breakdown", False)
+        self.dump_state = g("dump_state", False)
+        self.seed = g("seed", 1234)
+        self.disable_allgather = g("disable_allgather", False)
+        self.communication_data_type = g("communication_data_type", None)
+        self.sparse_gradients_enabled = g(C.SPARSE_GRADIENTS, False)
+
+        self.fp16 = from_dict(FP16Config, g("fp16", {}))
+        self.bf16 = from_dict(BF16Config, g("bf16", g("bfloat16", {})))
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ConfigError("fp16 and bf16 cannot both be enabled")
+        self.optimizer = from_dict(OptimizerConfig, g("optimizer", {})) if g("optimizer") else None
+        self.scheduler = from_dict(SchedulerConfig, g("scheduler", {})) if g("scheduler") else None
+        self.zero_config = from_dict(ZeroConfig, g("zero_optimization", {}))
+        self.activation_checkpointing = from_dict(ActivationCheckpointingConfig, g("activation_checkpointing", {}))
+        self.tensorboard = from_dict(TensorboardConfig, g("tensorboard", {}))
+        self.wandb = from_dict(WandbConfig, g("wandb", {}))
+        self.csv_monitor = from_dict(CSVConfig, g("csv_monitor", {}))
+        self.flops_profiler = from_dict(FlopsProfilerConfig, g("flops_profiler", {}))
+        self.mesh = from_dict(MeshConfig, g("mesh", {}))
+        self.pipeline = from_dict(PipelineConfig, g("pipeline", {}))
+        self.moe = from_dict(MoEConfig, g("moe", {}))
+        self.comms_logger = from_dict(CommsLoggerConfig, g("comms_logger", {}))
+        self.eigenvalue = from_dict(EigenvalueConfig, g("eigenvalue", {}))
+        self.curriculum = from_dict(CurriculumConfig, g("curriculum_learning", {}))
+        self.data_efficiency = from_dict(DataEfficiencyConfig, g("data_efficiency", {}))
+        self.compression = g("compression_training", {})
+        self.progressive_layer_drop = g("progressive_layer_drop", {"enabled": False})
+        self.elasticity = g("elasticity", {})
+        self.autotuning = g("autotuning", {})
+        self.checkpoint = g("checkpoint", {})
+        self.aio = g("aio", {})
+        self.zero_allow_untested_optimizer = g("zero_allow_untested_optimizer", False)
+        self.zero_force_ds_cpu_optimizer = g("zero_force_ds_cpu_optimizer", True)
+
+        self._mesh_device_count = mesh_device_count
+        self._resolve_batch_sizes()
+
+    # --- batch triad reconciliation (reference runtime/config.py batch logic)
+    def _resolve_batch_sizes(self):
+        dp = self.dp_world_size()
+        tb, mb, gas = self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps
+        tb = None if is_auto(tb) else tb
+        mb = None if is_auto(mb) else mb
+        gas = None if is_auto(gas) else gas
+
+        if tb is not None and mb is not None and gas is not None:
+            if tb != mb * gas * dp:
+                raise ConfigError(
+                    f"train_batch_size ({tb}) != micro_batch ({mb}) * grad_accum ({gas}) * dp_world_size ({dp})"
+                )
+        elif tb is not None and mb is not None:
+            gas, rem = divmod(tb, mb * dp)
+            if rem:
+                raise ConfigError(f"train_batch_size {tb} not divisible by micro_batch*dp {mb * dp}")
+        elif tb is not None and gas is not None:
+            mb, rem = divmod(tb, gas * dp)
+            if rem:
+                raise ConfigError(f"train_batch_size {tb} not divisible by grad_accum*dp {gas * dp}")
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp
+        elif tb is not None:
+            mb, rem = divmod(tb, dp)
+            gas = 1
+            if rem:
+                raise ConfigError(f"train_batch_size {tb} not divisible by dp_world_size {dp}")
+        else:
+            raise ConfigError(
+                "Provide at least train_batch_size or train_micro_batch_size_per_gpu "
+                f"(keys: {C.TRAIN_BATCH_SIZE}, {C.TRAIN_MICRO_BATCH_SIZE_PER_GPU})"
+            )
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    def dp_world_size(self) -> int:
+        """Data-parallel world size implied by the mesh (data × fsdp axes)."""
+        counts = self.mesh_axis_sizes()
+        return counts["data"] * counts["fsdp"]
+
+    def mesh_axis_sizes(self) -> Dict[str, int]:
+        import jax
+
+        n = self._mesh_device_count or jax.device_count()
+        shape = self.mesh.to_dict()
+        from deepspeed_tpu.comm.comm import _normalize_mesh_shape
+
+        return _normalize_mesh_shape(shape, n)
+
+    # --- dtype resolution ----------------------------------------------
+    def model_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def loss_scale(self) -> float:
+        if self.fp16.enabled:
+            return self.fp16.loss_scale  # 0 => dynamic
+        return 1.0
+
+    def initial_dynamic_scale(self) -> float:
+        return 2.0 ** self.fp16.initial_scale_power if self.fp16.enabled else 1.0
+
+    def print_config(self, name: str = "TpuConfig"):
+        logger.info(f"{name}:")
+        logger.info(json.dumps(self._raw, indent=2, sort_keys=True, default=str))
+
+    def to_dict(self) -> dict:
+        return dict(self._raw)
+
+
+# Backwards-friendly alias: users porting ds_config-driven scripts
+DeepSpeedConfig = TpuConfig

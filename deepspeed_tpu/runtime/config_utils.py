@@ -1,0 +1,59 @@
+"""Typed-config plumbing (reference: ``runtime/config_utils.py``'s pydantic
+``DeepSpeedConfigModel`` with ``"auto"`` support). Implemented with plain
+dataclasses to stay dependency-light: each config block is a dataclass built
+from a (possibly partial) dict; unknown keys raise; ``"auto"`` is a sentinel
+resolved by the engine."""
+
+import dataclasses
+from typing import Any
+
+AUTO = "auto"
+
+
+def is_auto(value: Any) -> bool:
+    return isinstance(value, str) and value == AUTO
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def from_dict(cls, data: dict, path: str = ""):
+    """Build dataclass ``cls`` from ``data``, recursing into nested dataclass
+    fields; unknown keys are an error (catches config typos early, like the
+    reference's pydantic models)."""
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ConfigError(f"config block {path or cls.__name__} must be a dict, got {type(data).__name__}")
+    field_map = {f.name: f for f in dataclasses.fields(cls)}
+    # accept both canonical names and documented aliases
+    aliases = getattr(cls, "_aliases", {})
+    kwargs = {}
+    for key, value in data.items():
+        name = aliases.get(key, key)
+        if name not in field_map:
+            raise ConfigError(f"Unknown config key '{path}{key}' for {cls.__name__}")
+        f = field_map[name]
+        if dataclasses.is_dataclass(f.type) and isinstance(value, dict):
+            value = from_dict(f.type, value, path=f"{path}{key}.")
+        kwargs[name] = value
+    obj = cls(**kwargs)
+    # recurse defaults for nested dataclass fields passed as dicts via defaults
+    for f in dataclasses.fields(cls):
+        v = getattr(obj, f.name)
+        if isinstance(v, dict) and dataclasses.is_dataclass(_resolve_type(f)):
+            setattr(obj, f.name, from_dict(_resolve_type(f), v, path=f"{path}{f.name}."))
+    return obj
+
+
+def _resolve_type(f):
+    return f.type if dataclasses.is_dataclass(f.type) else None
+
+
+def asdict_config(obj) -> dict:
+    return dataclasses.asdict(obj)
+
+
+def get_scalar_param(d: dict, name: str, default):
+    return d.get(name, default)

@@ -1,0 +1,101 @@
+"""ZeRO config block (reference: ``runtime/zero/config.py`` +
+``offload_config.py``). Same JSON keys; on TPU the stage selects a *sharding
+policy* (see runtime/zero/sharding.py) rather than a hand-written optimizer:
+
+  stage 0 — params/grads/opt replicated (plain DP; grads psum over data axes)
+  stage 1 — optimizer state sharded over the ``fsdp`` axis
+  stage 2 — + gradient (accumulation buffer) sharded over ``fsdp``
+  stage 3 — + parameters sharded over ``fsdp`` (XLA gathers on use)
+
+CUDA-era scheduling knobs (bucket sizes, overlap_comm, prefetch counts) are
+accepted for config compatibility and ignored: XLA's latency-hiding scheduler
+owns collective placement.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime.config_utils import from_dict
+
+
+@dataclass
+class OffloadParamConfig:
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+@dataclass
+class OffloadOptimizerConfig:
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+@dataclass
+class ZeroConfig:
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = False
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: OffloadParamConfig = field(default_factory=OffloadParamConfig)
+    offload_optimizer: OffloadOptimizerConfig = field(default_factory=OffloadOptimizerConfig)
+    sub_group_size: int = 1_000_000_000
+    cpu_offload: bool = False  # legacy stage-1/2 flag
+    cpu_offload_params: bool = False
+    prefetch_bucket_size: int = 50_000_000
+    param_persistence_threshold: int = 100_000
+    model_persistence_threshold: int = 2**63 - 1
+    max_live_parameters: int = 1_000_000_000
+    max_reuse_distance: int = 1_000_000_000
+    gather_16bit_weights_on_model_save: bool = False
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+
+    # aliases used by DeepSpeed JSON configs at various versions
+    _aliases = {
+        "stage3_prefetch_bucket_size": "prefetch_bucket_size",
+        "stage3_param_persistence_threshold": "param_persistence_threshold",
+        "stage3_model_persistence_threshold": "model_persistence_threshold",
+        "stage3_max_live_parameters": "max_live_parameters",
+        "stage3_max_reuse_distance": "max_reuse_distance",
+        "stage3_gather_16bit_weights_on_model_save": "stage3_gather_16bit_weights_on_model_save",
+    }
+
+    def __post_init__(self):
+        if isinstance(self.offload_param, dict):
+            self.offload_param = from_dict(OffloadParamConfig, self.offload_param)
+        if isinstance(self.offload_optimizer, dict):
+            self.offload_optimizer = from_dict(OffloadOptimizerConfig, self.offload_optimizer)
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.cpu_offload and self.offload_optimizer.device == "none":
+            self.offload_optimizer.device = "cpu"
+
+    def offload_optimizer_enabled(self) -> bool:
+        return self.offload_optimizer.device != "none"
+
+    def offload_param_enabled(self) -> bool:
+        return self.offload_param.device != "none"

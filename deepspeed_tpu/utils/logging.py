@@ -1,0 +1,56 @@
+"""Rank-filtered logging.
+
+TPU-native equivalent of the reference's ``deepspeed/utils/logging.py``
+(``log_dist``, ``logger``): in a multi-controller JAX job every host runs the
+same program, so "rank" here is ``jax.process_index()``.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str, level: str) -> logging.Logger:
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger("deepspeed_tpu", LOG_LEVEL)
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # jax not initialised yet
+        return 0
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log ``message`` only on the listed process indices (None/-1 = all)."""
+    my_rank = _process_index()
+    if ranks is None or any(r == -1 or r == my_rank for r in ranks):
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        logger.info(message)
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,43 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+TPU translation of the reference's ``tests/unit/common.py`` DistributedTest
+pattern: instead of forking N processes over NCCL, JAX exposes N virtual
+devices in-process via ``--xla_force_host_platform_device_count`` and tests
+build real meshes/shardings over them (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+# The environment pins JAX_PLATFORMS=axon (real TPU) and sitecustomize
+# pre-imports jax internals, so env vars are already captured; use
+# jax.config.update, which works post-import but pre-backend-init.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_comm_state():
+    yield
+    try:
+        from deepspeed_tpu import comm
+
+        comm.destroy()
+    except Exception:
+        pass
+
+
+@pytest.fixture
+def mesh8():
+    """Default 8-device mesh, all devices on the fsdp axis."""
+    from deepspeed_tpu import comm
+
+    comm.destroy()
+    return comm.init_distributed(mesh_shape={"data": 1, "fsdp": -1}, verbose=False)

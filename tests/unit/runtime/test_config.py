@@ -1,0 +1,91 @@
+"""Config system tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import TpuConfig
+from deepspeed_tpu.runtime.config_utils import ConfigError
+
+
+def test_batch_triad_full():
+    cfg = TpuConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 1},
+        mesh_device_count=8,
+    )
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triad_infer_gas():
+    cfg = TpuConfig({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, mesh_device_count=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triad_infer_micro():
+    cfg = TpuConfig({"train_batch_size": 64}, mesh_device_count=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triad_mismatch_raises():
+    with pytest.raises(ConfigError):
+        TpuConfig(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 1},
+            mesh_device_count=8,
+        )
+
+
+def test_batch_sizes_with_fsdp_mesh():
+    cfg = TpuConfig(
+        {"train_micro_batch_size_per_gpu": 2, "mesh": {"data": 1, "fsdp": -1}},
+        mesh_device_count=8,
+    )
+    assert cfg.dp_world_size() == 8
+    assert cfg.train_batch_size == 16
+
+
+def test_fp16_and_bf16_conflict():
+    with pytest.raises(ConfigError):
+        TpuConfig(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+            mesh_device_count=8,
+        )
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        TpuConfig({"train_batch_size": 8, "fp16": {"enabledd": True}}, mesh_device_count=8)
+
+
+def test_zero_stage_and_offload():
+    cfg = TpuConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu"},
+                "stage3_prefetch_bucket_size": 1000,
+            },
+        },
+        mesh_device_count=8,
+    )
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.offload_optimizer_enabled()
+    assert cfg.zero_config.prefetch_bucket_size == 1000
+
+
+def test_legacy_cpu_offload_flag():
+    cfg = TpuConfig(
+        {"train_batch_size": 8, "zero_optimization": {"stage": 2, "cpu_offload": True}},
+        mesh_device_count=8,
+    )
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_dtype_resolution():
+    import jax.numpy as jnp
+
+    cfg = TpuConfig({"train_batch_size": 8, "bf16": {"enabled": True}}, mesh_device_count=8)
+    assert cfg.model_dtype() == jnp.bfloat16
+    cfg = TpuConfig({"train_batch_size": 8, "fp16": {"enabled": True}}, mesh_device_count=8)
+    assert cfg.model_dtype() == jnp.float16
+    assert cfg.initial_dynamic_scale() == 2.0**16
