@@ -1,0 +1,107 @@
+"""deepspeed_tpu: a TPU-native large-model training & inference framework.
+
+Public API parity with the reference's ``deepspeed/__init__.py``:
+``initialize()`` (:54), ``init_inference()`` (:251), ``init_distributed``
+(comm/comm.py:526), ``add_config_arguments()`` (:228) — re-designed for
+JAX/XLA execution (see runtime/engine.py for the execution-model notes).
+"""
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import init_distributed
+from deepspeed_tpu.accelerator import get_accelerator
+from deepspeed_tpu.runtime.config import TpuConfig, DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import TpuEngine, DeepSpeedEngine
+from deepspeed_tpu.utils.logging import logger, log_dist
+
+
+def initialize(
+    args=None,
+    model=None,
+    optimizer=None,
+    model_parameters=None,
+    training_data=None,
+    lr_scheduler=None,
+    loss_fn=None,
+    params=None,
+    mpu=None,
+    dist_init_required=None,
+    collate_fn=None,
+    config=None,
+    config_params=None,
+    mesh=None,
+):
+    """Create a training engine (reference: deepspeed/__init__.py:54).
+
+    Model forms accepted:
+      - an object with ``init(rng) -> params`` and ``loss(params, batch, rng)``
+        (e.g. ``deepspeed_tpu.models.TransformerModel``), or
+      - ``loss_fn(params, batch, rng)`` + ``params`` pytree (any JAX model).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    assert config is not None, "provide config= (dict or path to JSON)"
+
+    if model is None:
+        assert loss_fn is not None and params is not None, "provide model= or (loss_fn=, params=)"
+        from deepspeed_tpu.runtime.engine import _FnModel
+
+        model = _FnModel(loss_fn, params)
+
+    cfg = TpuConfig(config)
+
+    if cfg.pipeline.stages > 1 or _is_pipeline_model(model):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(
+            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh
+        )
+    else:
+        engine = TpuEngine(
+            model,
+            cfg,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            training_data=training_data,
+            mesh=mesh,
+        )
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def _is_pipeline_model(model) -> bool:
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+    return isinstance(model, PipelineModule)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Create an inference engine (reference: deepspeed/__init__.py:251)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import TpuInferenceConfig
+
+    if isinstance(config, dict) or config is None:
+        merged = dict(config or {})
+        merged.update(kwargs)
+        config = TpuInferenceConfig.from_dict(merged)
+    return InferenceEngine(model, config)
+
+
+def add_config_arguments(parser):
+    """Inject --deepspeed / --deepspeed_config CLI args (reference
+    deepspeed/__init__.py:228)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true")
+    group.add_argument("--deepspeed_config", default=None, type=str, help="Path to JSON config")
+    group.add_argument("--deepscale", default=False, action="store_true", help=argparse_suppress())
+    group.add_argument("--local_rank", type=int, default=-1)
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+
+    return argparse.SUPPRESS

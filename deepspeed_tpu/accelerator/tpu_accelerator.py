@@ -92,15 +92,18 @@ class TpuAccelerator(Accelerator):
     def manual_seed(self, seed: int):
         return jax.random.PRNGKey(seed)
 
-    # --- profiler ranges ------------------------------------------------
+    # --- profiler ranges (nvtx push/pop semantics: LIFO stack) ----------
     def range_push(self, msg: str):
-        self._range = jax.profiler.TraceAnnotation(msg)
-        self._range.__enter__()
+        if not hasattr(self, "_range_stack"):
+            self._range_stack = []
+        annotation = jax.profiler.TraceAnnotation(msg)
+        annotation.__enter__()
+        self._range_stack.append(annotation)
 
     def range_pop(self):
-        if getattr(self, "_range", None) is not None:
-            self._range.__exit__(None, None, None)
-            self._range = None
+        stack = getattr(self, "_range_stack", [])
+        if stack:
+            stack.pop().__exit__(None, None, None)
 
     @contextlib.contextmanager
     def range(self, msg: str):

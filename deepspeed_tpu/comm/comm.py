@@ -68,6 +68,11 @@ def _normalize_mesh_shape(mesh_shape: Optional[dict], n_devices: int) -> dict:
     if unknown:
         raise ValueError(f"Unknown mesh axes {unknown}; valid axes: {MESH_AXES}")
     wildcards = [ax for ax, s in shape.items() if s == -1]
+    # 'data' is the default absorber (MeshConfig defaults it to -1); an
+    # explicit -1 on another axis takes precedence over that default.
+    if len(wildcards) > 1 and "data" in wildcards:
+        shape["data"] = 1
+        wildcards.remove("data")
     fixed = int(np.prod([s for s in shape.values() if s != -1]))
     if len(wildcards) > 1:
         raise ValueError("At most one mesh axis may be -1")
@@ -196,12 +201,20 @@ def get_process_count() -> int:
 
 
 def barrier(group: GroupLike = None):
-    """Block until all outstanding device work completes.
+    """Block until all previously dispatched device work completes.
 
-    XLA programs are globally scheduled; a host-side sync is the meaningful
-    analogue of torch.distributed.barrier for timing/checkpoint boundaries.
+    Runs a trivial program replicated over the whole mesh and fetches the
+    result to host: per-device program queues are FIFO, so completion implies
+    every earlier program on those devices finished; in multi-controller mode
+    all processes execute the same global program, which is the rendezvous.
+    The host fetch matters — on relayed backends block_until_ready can ack
+    before execution.
     """
-    jax.block_until_ready(jax.device_put(np.zeros(())))
+    mesh = get_mesh()
+    token = jax.jit(
+        lambda: jax.numpy.zeros(()), out_shardings=NamedSharding(mesh, PartitionSpec())
+    )()
+    float(token)
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +240,9 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group: GroupLike = None):
         return jax.lax.pmax(tensor, axes)
     if op == ReduceOp.MIN:
         return jax.lax.pmin(tensor, axes)
+    if op == ReduceOp.PROD:
+        gathered = jax.lax.all_gather(tensor, axes, axis=0)
+        return jax.numpy.prod(gathered, axis=0)
     raise ValueError(f"unsupported reduce op {op}")
 
 
@@ -284,9 +300,9 @@ def replicated_sharding() -> NamedSharding:
 
 
 def batch_axes() -> Tuple[str, ...]:
-    """Mesh axes the global batch is split over (ZeRO's DP dimension)."""
-    mesh = get_mesh()
-    return tuple(ax for ax in ("data", "fsdp") if mesh.shape[ax] >= 1)
+    """Mesh axes the global batch is split over (ZeRO's DP dimension).
+    Size-1 axes are harmless in a PartitionSpec, so no filtering needed."""
+    return ("data", "fsdp")
 
 
 def dp_world_size() -> int:

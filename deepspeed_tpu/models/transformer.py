@@ -1,0 +1,405 @@
+"""Decoder-only transformer (GPT-2 / Llama families), TPU-first.
+
+This is the flagship model the engine trains and benches. Design choices that
+matter on TPU (vs the reference's per-layer torch modules +
+``csrc/transformer`` fused CUDA kernels):
+
+  - layer params are *stacked* along a leading L dim and the decoder body is a
+    single ``lax.scan`` — one compiled layer body regardless of depth (fast
+    compile, and XLA pipelines the scan);
+  - everything is static-shape, bf16-friendly, einsum-based so the MXU gets
+    large batched GEMMs; elementwise chains (bias/residual/norm/activation)
+    are left to XLA fusion — the CUDA fused-kernel inventory
+    (softmax/gelu/layernorm/transform kernels, SURVEY §2.4 #5/#6) is the
+    compiler's job here, with Pallas reserved for attention;
+  - parameters carry logical axis names (embed/mlp/heads/vocab/layers) so the
+    ZeRO/TP ShardingPolicy can place them (runtime/zero/sharding.py);
+  - activation rematerialisation is a ``jax.checkpoint`` policy around the
+    scanned layer body (reference: activation_checkpointing/checkpointing.py).
+
+Functional API: ``init(rng, cfg) -> params``; ``apply(params, cfg, tokens)``;
+``loss(params, cfg, batch)``. The TransformerModel class packages these for
+the engine protocol.
+"""
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_policies as cp
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None => MHA
+    ffn_hidden_size: Optional[int] = None  # None => 4*hidden (gpt) / derived (llama)
+    max_seq_len: int = 1024
+    pos_embedding: str = "learned"  # learned | rope | none
+    norm_type: str = "layernorm"  # layernorm | rmsnorm
+    activation: str = "gelu"  # gelu | silu_glu (SwiGLU)
+    tie_embeddings: bool = True
+    dtype: str = "float32"  # compute/storage dtype for params & activations
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | dots_with_no_batch_dims
+    attn_impl: str = "xla"  # xla | pallas (flash attention kernel)
+    use_bias: bool = True  # linear/ln biases (gpt2 yes, llama no)
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def ffn_size(self):
+        if self.ffn_hidden_size is not None:
+            return self.ffn_hidden_size
+        return 4 * self.hidden_size
+
+    @property
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[self.dtype]
+
+    def num_params(self) -> int:
+        D, V, L, F = self.hidden_size, self.vocab_size, self.num_layers, self.ffn_size
+        kvd = self.kv_heads * self.head_dim
+        attn = D * D + 2 * D * kvd + D * D  # q,k,v,o
+        mlp = (3 if self.activation == "silu_glu" else 2) * D * F
+        per_layer = attn + mlp + 2 * D  # + ln scales
+        if self.use_bias:
+            per_layer += (D + 2 * kvd + D) + (F + D) + 2 * D  # attn/mlp/ln biases
+        emb = V * D + (self.max_seq_len * D if self.pos_embedding == "learned" else 0)
+        head = 0 if self.tie_embeddings else V * D
+        final = D + (D if self.use_bias else 0)
+        return emb + L * per_layer + final + head
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6*N + attention term)."""
+        n = self.num_params() - self.vocab_size * self.hidden_size * (1 if self.tie_embeddings else 2)
+        attn_flops = 12 * self.num_layers * self.hidden_size * seq_len  # 2*2*3 per token pair
+        return 6.0 * n + attn_flops
+
+
+# preset shapes for parity configs (BASELINE.md tracked configs)
+PRESETS = {
+    "gpt2-125m": dict(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12, max_seq_len=1024),
+    "gpt2-350m": dict(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16, max_seq_len=1024),
+    "gpt2-1.5b": dict(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25, max_seq_len=1024),
+    "llama2-7b": dict(
+        vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=32,
+        ffn_hidden_size=11008, max_seq_len=4096, pos_embedding="rope", norm_type="rmsnorm",
+        activation="silu_glu", tie_embeddings=False, use_bias=False,
+    ),
+    "llama2-70b": dict(
+        vocab_size=32000, hidden_size=8192, num_layers=80, num_heads=64, num_kv_heads=8,
+        ffn_hidden_size=28672, max_seq_len=4096, pos_embedding="rope", norm_type="rmsnorm",
+        activation="silu_glu", tie_embeddings=False, use_bias=False,
+    ),
+}
+
+
+def get_config(preset: str, **overrides) -> TransformerConfig:
+    base = dict(PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init(rng, cfg: TransformerConfig):
+    """Build the parameter pytree (all leaves fp32; engine casts as needed)."""
+    D, V, L, F, S = cfg.hidden_size, cfg.vocab_size, cfg.num_layers, cfg.ffn_size, cfg.max_seq_len
+    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
+    keys = iter(jax.random.split(rng, 32))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(jnp.float32)
+
+    def stack(maker):
+        return jnp.stack([maker(k) for k in jax.random.split(next(keys), L)])
+
+    params = {
+        "embed": {"tok": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02},
+        "layers": {
+            "attn": {
+                "wq": stack(lambda k: dense(k, (D, nh * hd), D)),
+                "wk": stack(lambda k: dense(k, (D, nkv * hd), D)),
+                "wv": stack(lambda k: dense(k, (D, nkv * hd), D)),
+                "wo": stack(lambda k: dense(k, (nh * hd, D), nh * hd) / math.sqrt(2 * L)),
+            },
+            "mlp": {
+                "wi": stack(lambda k: dense(k, (D, F), D)),
+                "wo": stack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
+            },
+            "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
+            "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
+        },
+        "final_norm": {"scale": jnp.ones((D,), jnp.float32)},
+    }
+    if cfg.activation == "silu_glu":
+        params["layers"]["mlp"]["wg"] = stack(lambda k: dense(k, (D, F), D))
+    if cfg.pos_embedding == "learned":
+        params["embed"]["pos"] = jax.random.normal(next(keys), (S, D), jnp.float32) * 0.02
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"w": dense(next(keys), (D, V), D)}
+    if cfg.use_bias:
+        params["layers"]["attn"]["bq"] = jnp.zeros((L, nh * hd), jnp.float32)
+        params["layers"]["attn"]["bk"] = jnp.zeros((L, nkv * hd), jnp.float32)
+        params["layers"]["attn"]["bv"] = jnp.zeros((L, nkv * hd), jnp.float32)
+        params["layers"]["attn"]["bo"] = jnp.zeros((L, D), jnp.float32)
+        params["layers"]["mlp"]["bi"] = jnp.zeros((L, F), jnp.float32)
+        params["layers"]["mlp"]["bo"] = jnp.zeros((L, D), jnp.float32)
+        params["layers"]["ln1"]["bias"] = jnp.zeros((L, D), jnp.float32)
+        params["layers"]["ln2"]["bias"] = jnp.zeros((L, D), jnp.float32)
+        params["final_norm"]["bias"] = jnp.zeros((D,), jnp.float32)
+    return params
+
+
+def logical_specs(params, cfg: TransformerConfig):
+    """Per-dimension logical axis names, mirroring the params pytree.
+
+    The ShardingPolicy maps these through rules onto mesh axes; the 'layers'
+    leading scan dim is never sharded.
+    """
+
+    def annotate(path, leaf):
+        names = [p.key for p in path if hasattr(p, "key")]
+        last = names[-1]
+        stacked = "layers" in names
+        pre = ("layers",) if stacked else ()
+        if "attn" in names:
+            table = {
+                "wq": ("embed", "heads"), "wk": ("embed", "kv"), "wv": ("embed", "kv"),
+                "wo": ("heads", "embed"), "bq": ("heads",), "bk": ("kv",), "bv": ("kv",), "bo": ("embed",),
+            }
+            return pre + table[last]
+        if "mlp" in names:
+            table = {"wi": ("embed", "mlp"), "wg": ("embed", "mlp"), "wo": ("mlp", "embed"),
+                     "bi": ("mlp",), "bo": ("embed",)}
+            return pre + table[last]
+        if "ln1" in names or "ln2" in names:
+            return pre + ("norm",)
+        if "final_norm" in names:
+            return ("norm",)
+        if "embed" in names:
+            return ("vocab", "embed") if last == "tok" else ("seq", "embed")
+        if "lm_head" in names:
+            return ("embed", "vocab")
+        return tuple(None for _ in leaf.shape)
+
+    return jax.tree_util.tree_map_with_path(annotate, params)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _norm(x, scale, bias, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.norm_type == "rmsnorm":
+        x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + cfg.norm_eps)
+    else:
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        x32 = (x32 - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+    out = x32 * scale
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding over head_dim (reference analogue:
+    csrc/transformer/inference apply_rotary_pos_emb.cu)."""
+    B, S, H, hd = x.shape
+    half = hd // 2
+    freqs = jnp.exp(-math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # B,S,half
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
+    """Causal multi-head / grouped-query attention.
+
+    xla impl: einsum softmax einsum (fp32 logits). pallas impl: flash kernel
+    (ops/pallas/flash_attention.py) once available.
+    """
+    B, S, nh, hd = q.shape
+    nkv = k.shape[2]
+    if cfg.attn_impl == "pallas":
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(causal[None, None, :, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng):
+    """One decoder layer; shapes: x (B,S,D), layer_params leaves unstacked."""
+    attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
+    ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
+    B, S, D = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg)
+    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
+    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
+    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
+    if cfg.use_bias:
+        q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+    attn_out = _attention(q, k, v, cfg, positions).reshape(B, S, nh * hd)
+    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
+    if cfg.use_bias:
+        attn_out = attn_out + attn_p["bo"]
+    if cfg.dropout > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - cfg.dropout, attn_out.shape)
+        attn_out = jnp.where(keep, attn_out / (1.0 - cfg.dropout), 0.0).astype(attn_out.dtype)
+    x = x + attn_out
+
+    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
+    if cfg.activation == "silu_glu":
+        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
+        act = jax.nn.silu(gate) * up
+    else:
+        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        if cfg.use_bias:
+            act = act + mlp_p["bi"]
+        act = jax.nn.gelu(act)
+    mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
+    if cfg.use_bias:
+        mlp_out = mlp_out + mlp_p["bo"]
+    return x + mlp_out
+
+
+_REMAT_POLICIES = {
+    "nothing_saveable": cp.nothing_saveable,
+    "dots_saveable": cp.dots_saveable,
+    "dots_with_no_batch_dims": cp.dots_with_no_batch_dims_saveable,
+    "full": cp.everything_saveable,
+}
+
+
+def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
+    """tokens (B, S) int32 -> logits (B, S, V)."""
+    dtype = cfg.jnp_dtype
+    B, S = tokens.shape
+    x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["pos"][:S].astype(dtype)
+
+    layer_fn = partial(_layer_body, cfg=cfg, positions=positions)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=_REMAT_POLICIES[cfg.remat_policy], static_argnums=())
+
+    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+    if cfg.scan_layers:
+        if cfg.dropout > 0.0 and dropout_rng is not None:
+            layer_rngs = jax.random.split(dropout_rng, cfg.num_layers)
+        else:
+            layer_rngs = jnp.zeros((cfg.num_layers, 2), jnp.uint32)
+
+        def scan_step(carry, inp):
+            layer_p, rng = inp
+            rng = rng if cfg.dropout > 0.0 and dropout_rng is not None else None
+            return layer_fn(carry, layer_p, dropout_rng=rng), None
+
+        x, _ = jax.lax.scan(scan_step, x, (layers, layer_rngs))
+    else:
+        for i in range(cfg.num_layers):
+            layer_p = jax.tree.map(lambda p: p[i], layers)
+            rng = jax.random.fold_in(dropout_rng, i) if (cfg.dropout > 0.0 and dropout_rng is not None) else None
+            x = layer_fn(x, layer_p, dropout_rng=rng)
+
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+    return logits
+
+
+def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
+    """Next-token cross entropy. batch: {'input_ids': (B,S) int32} and
+    optional 'labels' (shifted internally if absent) and 'loss_mask'."""
+    tokens = batch["input_ids"]
+    logits = apply(params, cfg, tokens, dropout_rng=rng)
+    if "labels" in batch:
+        labels = batch["labels"]
+        logits_for_loss = logits
+    else:
+        labels = tokens[:, 1:]
+        logits_for_loss = logits[:, :-1]
+    logits32 = logits_for_loss.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits32, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, : nll.shape[1]].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+class TransformerModel:
+    """Engine-protocol wrapper (see runtime/engine.py): init/loss/logical_specs."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    @classmethod
+    def from_preset(cls, name: str, **overrides):
+        return cls(get_config(name, **overrides))
+
+    def init(self, rng):
+        return init(rng, self.cfg)
+
+    def loss(self, params, batch, rng=None):
+        return loss_fn(params, self.cfg, batch, rng=rng)
+
+    def apply(self, params, tokens, rng=None):
+        return apply(params, self.cfg, tokens, dropout_rng=rng)
+
+    def logical_specs(self, params):
+        return logical_specs(params, self.cfg)
+
+    def flops_per_token(self, seq_len: int) -> float:
+        return self.cfg.flops_per_token(seq_len)
+
+    def num_params(self) -> int:
+        return self.cfg.num_params()

@@ -1,0 +1,105 @@
+"""Monitoring fan-out (reference: ``deepspeed/monitor/monitor.py``
+``MonitorMaster`` + tensorboard/wandb/csv writers, rank-0 only)."""
+
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class _Writer:
+    enabled = False
+
+    def write_events(self, events: List[Tuple[str, float, int]]):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+
+class TensorBoardMonitor(_Writer):
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"tensorboard unavailable ({e}); disabling TB monitor")
+            return
+        out = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+        self.writer = SummaryWriter(log_dir=out)
+        self.enabled = True
+
+    def write_events(self, events):
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+
+    def flush(self):
+        if self.enabled:
+            self.writer.flush()
+
+
+class CSVMonitor(_Writer):
+    def __init__(self, cfg):
+        self.enabled = cfg.enabled
+        if not self.enabled:
+            return
+        self.dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events):
+        import csv
+
+        for name, value, step in events:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class WandbMonitor(_Writer):
+    def __init__(self, cfg):
+        self.enabled = False
+        if not cfg.enabled:
+            return
+        try:
+            import wandb
+        except Exception:
+            logger.warning("wandb not installed; disabling wandb monitor")
+            return
+        wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+        self.wandb = wandb
+        self.enabled = True
+
+    def write_events(self, events):
+        for name, value, step in events:
+            self.wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(_Writer):
+    def __init__(self, config):
+        import jax
+
+        self.writers = []
+        if jax.process_index() == 0:
+            for w in (
+                TensorBoardMonitor(config.tensorboard),
+                CSVMonitor(config.csv_monitor),
+                WandbMonitor(config.wandb),
+            ):
+                if w.enabled:
+                    self.writers.append(w)
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events):
+        for w in self.writers:
+            w.write_events(events)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()

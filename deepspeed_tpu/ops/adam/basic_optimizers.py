@@ -1,0 +1,100 @@
+"""SGD / Adagrad / Lion functional optimizers (reference: torch.optim passthrough
++ ``csrc/adagrad/cpu_adagrad.cpp``), same init/update protocol as FusedAdam."""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    momentum_buf: Any
+
+
+@dataclass(frozen=True)
+class SGD:
+    lr: float = 1e-3
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    nesterov: bool = False
+
+    def init(self, params):
+        return SGDState(momentum_buf=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(g, buf, p):
+            g = g.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            buf_new = self.momentum * buf + g
+            d = g + self.momentum * buf_new if self.nesterov else buf_new
+            return -lr * d, buf_new
+
+        out = jax.tree.map(leaf, grads, state.momentum_buf, params)
+        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), SGDState(momentum_buf=pick(1))
+
+
+class AdagradState(NamedTuple):
+    step: jnp.ndarray
+    sum_sq: Any
+
+
+@dataclass(frozen=True)
+class Adagrad:
+    lr: float = 1e-2
+    eps: float = 1e-10
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        return AdagradState(
+            step=jnp.zeros((), jnp.int32),
+            sum_sq=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        )
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(g, s, p):
+            g = g.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            s_new = s + g * g
+            return -lr * g / (jnp.sqrt(s_new) + self.eps), s_new
+
+        out = jax.tree.map(leaf, grads, state.sum_sq, params)
+        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), AdagradState(step=state.step + 1, sum_sq=pick(1))
+
+
+class LionState(NamedTuple):
+    exp_avg: Any
+
+
+@dataclass(frozen=True)
+class Lion:
+    lr: float = 1e-4
+    betas: tuple = (0.9, 0.99)
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        return LionState(exp_avg=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        def leaf(g, m, p):
+            g = g.astype(jnp.float32)
+            upd = -lr * jnp.sign(b1 * m + (1.0 - b1) * g)
+            if self.weight_decay > 0.0:
+                upd = upd - lr * self.weight_decay * p.astype(jnp.float32)
+            m_new = b2 * m + (1.0 - b2) * g
+            return upd, m_new
+
+        out = jax.tree.map(leaf, grads, state.exp_avg, params)
+        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), LionState(exp_avg=pick(1))

@@ -1,0 +1,74 @@
+"""Fused Adam/AdamW.
+
+TPU-native counterpart of the reference's multi-tensor fused Adam
+(``csrc/adam/multi_tensor_adam.cu`` + ``ops/adam/fused_adam.py:18``): under
+XLA a whole-pytree jitted update *is* the fused multi-tensor apply — one
+compiled program over all parameter leaves, fused elementwise chains, no
+per-tensor launches. The optimizer is functional (init/update) so its state
+can carry ZeRO shardings.
+"""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray  # i32 scalar
+    exp_avg: Any  # pytree like params
+    exp_avg_sq: Any
+
+
+@dataclass(frozen=True)
+class FusedAdam:
+    """Adam/AdamW with bias correction, matching torch.optim.Adam semantics
+    (the reference validates DeepSpeedCPUAdam against torch Adam the same way,
+    tests/unit/ops/adam/)."""
+
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    adam_w_mode: bool = True
+    bias_correction: bool = True
+
+    def init(self, params) -> AdamState:
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zeros2 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return AdamState(step=jnp.zeros((), jnp.int32), exp_avg=zeros, exp_avg_sq=zeros2)
+
+    def update(self, grads, state: AdamState, params, lr=None):
+        """Returns (updates, new_state); updates are deltas to *add* to params."""
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def leaf(g, m, v, p):
+            g = g.astype(jnp.float32)
+            if not self.adam_w_mode and self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * (g * g)
+            denom = jnp.sqrt(v_new / bc2) + self.eps
+            upd = -lr * (m_new / bc1) / denom
+            if self.adam_w_mode and self.weight_decay > 0.0:
+                upd = upd - lr * self.weight_decay * p.astype(jnp.float32)
+            return upd, m_new, v_new
+
+        out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
+        updates = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        exp_avg = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        exp_avg_sq = jax.tree.map(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return updates, AdamState(step=step, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq)
+
+
+def FusedAdamW(**kw):
+    kw.setdefault("adam_w_mode", True)
+    return FusedAdam(**kw)

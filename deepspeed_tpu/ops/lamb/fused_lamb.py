@@ -1,0 +1,59 @@
+"""Fused LAMB (reference: ``csrc/lamb/fused_lamb_cuda.cpp:112`` +
+``ops/lamb/fused_lamb.py``): Adam update with layer-wise trust-ratio scaling.
+One jitted pytree update; the per-layer norms the CUDA kernel computes with
+block reductions are plain jnp reductions fused by XLA."""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+@dataclass(frozen=True)
+class FusedLamb:
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    bias_correction: bool = True
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+
+    def init(self, params) -> LambState:
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return LambState(step=jnp.zeros((), jnp.int32), exp_avg=z(), exp_avg_sq=z())
+
+    def update(self, grads, state: LambState, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        bc1 = 1.0 - b1 ** step.astype(jnp.float32) if self.bias_correction else jnp.float32(1.0)
+        bc2 = 1.0 - b2 ** step.astype(jnp.float32) if self.bias_correction else jnp.float32(1.0)
+
+        def leaf(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * (g * g)
+            adam_step = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            if self.weight_decay > 0.0:
+                adam_step = adam_step + self.weight_decay * p32
+            w_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(adam_step.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                1.0,
+            )
+            return -lr * trust * adam_step, m_new, v_new
+
+        out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
+        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), LambState(step=step, exp_avg=pick(1), exp_avg_sq=pick(2))

@@ -1,0 +1,21 @@
+"""Pluggable checkpoint engine seam (reference:
+``runtime/checkpoint_engine/checkpoint_engine.py`` CheckpointEngine ABC; the
+Nebula async-service impl maps to any future async array writer)."""
+
+import abc
+
+
+class CheckpointEngine(abc.ABC):
+    @abc.abstractmethod
+    def save(self, path: str, state_tree, metadata: dict) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load(self, path: str, template_tree):
+        """Returns (restored_tree, metadata). ``template_tree`` supplies target
+        shapes/dtypes/shardings — restore re-shards to the *current* mesh, which
+        is what makes elastic/universal checkpointing work (SURVEY §5)."""
+        ...
+
+    def commit(self, tag: str) -> bool:
+        return True

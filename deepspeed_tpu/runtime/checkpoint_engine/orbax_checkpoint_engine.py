@@ -1,0 +1,53 @@
+"""Orbax-backed checkpoint engine.
+
+Reference mapping: ``torch_checkpoint_engine.py`` (blocking save of
+mp_rank/zero_pp_rank shards, engine.py:2798) → Orbax array checkpointing:
+every host writes its shards of each global array, restore re-shards to the
+template's NamedShardings. That property IS the reference's "elastic
+checkpoint" (engine.py:732 — load optimizer state at a different DP world
+size) and the universal-checkpoint reshape (checkpoint/deepspeed_checkpoint.py)
+for free: the on-disk format is logical-array-shaped, not rank-shaped.
+"""
+
+import json
+import os
+
+import jax
+
+from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    def __init__(self, use_ocdbt: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._use_ocdbt = use_ocdbt
+
+    def save(self, path: str, state_tree, metadata: dict) -> None:
+        ocp = self._ocp
+        path = os.path.abspath(path)
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(path, state_tree, force=True)
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
+                json.dump(metadata, fh, default=str)
+
+    def load(self, path: str, template_tree):
+        ocp = self._ocp
+        path = os.path.abspath(path)
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding, global_shape=x.shape, dtype=x.dtype),
+            template_tree,
+        )
+        abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template_tree)
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args)
+        )
+        meta_path = os.path.join(path, "ds_metadata.json")
+        metadata = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                metadata = json.load(fh)
+        return restored, metadata

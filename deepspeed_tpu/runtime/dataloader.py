@@ -1,0 +1,92 @@
+"""Data loading (reference: ``runtime/dataloader.py`` DeepSpeedDataLoader +
+RepeatingLoader). Single-controller JAX consumes *global* batches that the
+engine shards onto the mesh; in multi-controller mode each host loads its
+process-slice (process_index striding stands in for DistributedSampler)."""
+
+import numpy as np
+
+import jax
+
+
+def _stack(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: _stack([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_stack([it[i] for it in items]) for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+class TpuDataLoader:
+    """Wraps an indexable or iterable dataset into global-batch numpy dicts."""
+
+    def __init__(self, dataset, batch_size: int, collate_fn=None, seed: int = 0, shuffle: bool = True, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _stack
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        try:
+            self._len = len(dataset)
+        except TypeError:
+            self._len = None
+
+    def __len__(self):
+        if self._len is None:
+            raise TypeError("underlying dataset has no __len__")
+        return self._len // self.batch_size if self.drop_last else -(-self._len // self.batch_size)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __iter__(self):
+        if self._len is None:
+            return self._iter_iterable()
+        return self._iter_indexable()
+
+    def _iter_indexable(self):
+        n = self._len
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        # process-level slice for multi-host: contiguous stride partitioning
+        pcount, pidx = jax.process_count(), jax.process_index()
+        per_proc = self.batch_size // pcount if self.batch_size % pcount == 0 else self.batch_size
+        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if pcount > 1 and self.batch_size % pcount == 0:
+                idx = idx[pidx * per_proc : (pidx + 1) * per_proc]
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def _iter_iterable(self):
+        buf = []
+        for item in self.dataset:
+            buf.append(item)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference:
+    runtime/dataloader.py RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,99 @@
+"""Dynamic and static loss scaling.
+
+Reference: ``runtime/fp16/loss_scaler.py`` (``DynamicLossScaler`` :90 — on
+overflow halve the scale with hysteresis, after ``scale_window`` clean steps
+double it). Re-expressed as a jit-compatible pure state transition so the
+whole thing lives inside the compiled optimizer step (no host sync needed to
+decide skip-vs-apply; the skip is a ``lax.cond``/``where`` select)."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 since last overflow/raise
+    hysteresis: jnp.ndarray  # i32 remaining tolerated overflows before lowering
+
+
+class DynamicLossScaler:
+    def __init__(
+        self,
+        init_scale: float = 2.0**16,
+        scale_factor: float = 2.0,
+        scale_window: int = 1000,
+        min_scale: float = 1.0,
+        delayed_shift: int = 2,
+        consecutive_hysteresis: bool = False,
+        raise_error_at_min_scale: bool = False,
+    ):
+        self.init_scale = init_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = max(delayed_shift, 1)
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dynamic = True
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Pure transition; ``overflow`` is a traced bool scalar."""
+        hysteresis_spent = state.hysteresis <= 1
+        new_scale_on_ovf = jnp.where(
+            hysteresis_spent,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale,
+        )
+        new_hyst_on_ovf = jnp.where(hysteresis_spent, state.hysteresis, state.hysteresis - 1)
+
+        grown = state.good_steps + 1 >= self.scale_window
+        new_scale_ok = jnp.where(grown, state.scale * self.scale_factor, state.scale)
+        new_good_ok = jnp.where(grown, 0, state.good_steps + 1)
+        new_hyst_ok = (
+            jnp.asarray(self.delayed_shift, jnp.int32) if not self.consecutive_hysteresis else state.hysteresis
+        )
+
+        return LossScaleState(
+            scale=jnp.where(overflow, new_scale_on_ovf, new_scale_ok),
+            good_steps=jnp.where(overflow, 0, new_good_ok),
+            hysteresis=jnp.where(overflow, new_hyst_on_ovf, new_hyst_ok),
+        )
+
+
+class StaticLossScaler:
+    def __init__(self, scale: float = 1.0):
+        self.scale = scale
+        self.dynamic = False
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.ones((), jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        return state
+
+
+def create_loss_scaler(fp16_config, fp16_enabled: bool):
+    """Map the fp16 config block to a scaler (reference: engine.py loss-scale
+    wiring via fp16.loss_scale==0 => dynamic)."""
+    if not fp16_enabled:
+        return StaticLossScaler(1.0)
+    if fp16_config.loss_scale and fp16_config.loss_scale > 0:
+        return StaticLossScaler(fp16_config.loss_scale)
+    return DynamicLossScaler(
+        init_scale=2.0**fp16_config.initial_scale_power,
+        scale_window=fp16_config.loss_scale_window,
+        min_scale=fp16_config.min_loss_scale,
+        delayed_shift=fp16_config.hysteresis,
+        consecutive_hysteresis=fp16_config.consecutive_hysteresis,
+    )

@@ -1,0 +1,13 @@
+"""1-bit (compressed-communication) optimizers — implemented in
+onebit/adam.py etc. (reference: runtime/fp16/onebit/)."""
+
+
+def build_onebit_optimizer(name: str, params: dict):
+    from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+    from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+
+    if name == "onebitadam" or name == "zerooneadam":
+        return OnebitAdam(**params)
+    if name == "onebitlamb":
+        return OnebitLamb(**params)
+    raise ValueError(name)

@@ -1,0 +1,52 @@
+"""Pipeline module: stage partitioning of a layer list.
+
+Reference: ``runtime/pipe/module.py`` (PipelineModule :85, LayerSpec :29,
+TiedLayerSpec :76). TPU design: a PipelineModule holds N layer-stage
+callables; the PipelineEngine maps stages onto the ``pipe`` mesh axis and
+runs a 1F1B schedule with collective-permutes between stages (see
+runtime/pipe/engine.py).
+"""
+
+from typing import Callable, List, Optional
+
+
+class LayerSpec:
+    """Deferred layer: (init_fn(rng) -> params, apply_fn(params, x) -> x)."""
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable, name: Optional[str] = None):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.name = name or apply_fn.__name__
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with another stage (e.g. embedding and
+    lm-head); gradients are summed across the tie group at step time."""
+
+    def __init__(self, key: str, init_fn, apply_fn, name=None):
+        super().__init__(init_fn, apply_fn, name)
+        self.key = key
+
+
+class PipelineModule:
+    """A sequence of LayerSpecs partitioned into pipeline stages."""
+
+    def __init__(self, layers: List[LayerSpec], num_stages: int = 1, loss_fn=None, partition_method: str = "uniform"):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.parts = self._partition_layers()
+
+    def _partition_layers(self):
+        n, s = len(self.layer_specs), self.num_stages
+        assert n >= s, f"{n} layers cannot fill {s} stages"
+        # uniform contiguous split (reference supports parameter-count and
+        # regex-profiled balancing; uniform is the TPU default because scanned
+        # equal-shape blocks are the common case)
+        bounds = [round(i * n / s) for i in range(s + 1)]
+        return bounds
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self.layer_specs[lo:hi]

@@ -62,7 +62,6 @@ class ZeroConfig:
     max_live_parameters: int = 1_000_000_000
     max_reuse_distance: int = 1_000_000_000
     gather_16bit_weights_on_model_save: bool = False
-    stage3_gather_16bit_weights_on_model_save: bool = False
     ignore_unused_parameters: bool = True
     round_robin_gradients: bool = False
     zero_hpz_partition_size: int = 1
@@ -81,7 +80,7 @@ class ZeroConfig:
         "stage3_model_persistence_threshold": "model_persistence_threshold",
         "stage3_max_live_parameters": "max_live_parameters",
         "stage3_max_reuse_distance": "max_reuse_distance",
-        "stage3_gather_16bit_weights_on_model_save": "stage3_gather_16bit_weights_on_model_save",
+        "stage3_gather_16bit_weights_on_model_save": "gather_16bit_weights_on_model_save",
     }
 
     def __post_init__(self):

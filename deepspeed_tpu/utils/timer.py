@@ -19,11 +19,16 @@ except Exception:  # pragma: no cover
 
 
 def _sync():
-    """Block until all dispatched device work completes (cuda-event analogue)."""
+    """Block until previously dispatched device work completes (cuda-event
+    analogue): execute a trivial program on the local devices — queued FIFO
+    after outstanding work — and fetch the result to host. A bare
+    block_until_ready on a fresh transfer would not drain compute (and some
+    relayed backends ack it early)."""
     import jax
+    import jax.numpy as jnp
 
     try:
-        jax.block_until_ready(jax.device_put(0))
+        float(jax.jit(lambda: jnp.zeros(()))())
     except Exception:  # pragma: no cover
         pass
 
@@ -152,7 +157,7 @@ class ThroughputTimer:
                     f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                     f"global_step={self.global_step_count}, "
                     f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time * max(self.global_step_count % self.steps_per_output, 1):.2f}"
+                    f"CurrSamplesPerSec={self.batch_size * self.steps_per_output / self.step_elapsed_time:.2f}"
                 )
                 self.step_elapsed_time = 0.0
 

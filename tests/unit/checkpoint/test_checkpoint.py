@@ -1,0 +1,91 @@
+"""Checkpoint round-trip tests (reference: tests/unit/checkpoint/ — save/load
+ZeRO states across stages; save at one mesh, load at another = elastic)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from simple_model import SimpleModel, random_batch
+
+HIDDEN = 16
+
+
+def make_engine(tmp, stage, mesh_shape=None, lr=1e-2):
+    comm.destroy()
+    config = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "mesh": mesh_shape or {"data": 1, "fsdp": -1},
+        "zero_optimization": {"stage": stage},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10, "warmup_max_lr": lr}},
+    }
+    model = SimpleModel(HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def train(engine, steps, seed=0):
+    for i in range(steps):
+        batch = random_batch(16, HIDDEN, seed=seed + i)
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+    return loss
+
+
+@pytest.mark.parametrize("stage", [0, 2, 3])
+def test_save_load_roundtrip(tmp_path, stage):
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(tmp_path, stage)
+    train(e1, 3)
+    e1.save_checkpoint(ckpt)
+    w_before = jax.device_get(e1.params["linear_0"]["w"])
+    opt_before = jax.device_get(e1.opt_state.exp_avg["linear_0"]["w"])
+
+    e2 = make_engine(tmp_path, stage)
+    path, client = e2.load_checkpoint(ckpt)
+    assert path is not None
+    assert e2.global_steps == 3
+    np.testing.assert_array_equal(jax.device_get(e2.params["linear_0"]["w"]), w_before)
+    np.testing.assert_array_equal(jax.device_get(e2.opt_state.exp_avg["linear_0"]["w"]), opt_before)
+
+    # continued training must match an uninterrupted run
+    l1 = train(e1, 2, seed=100)
+    l2 = train(e2, 2, seed=100)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+def test_elastic_reshard_load(tmp_path):
+    """Save with fsdp=8, load with fsdp=4+data=2 (different partitioning):
+    the reference needs 'elastic checkpoint' reshaping (engine.py:732); here
+    the on-disk format is logical arrays so it is automatic."""
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(tmp_path, stage=3, mesh_shape={"data": 1, "fsdp": -1})
+    train(e1, 3)
+    w_before = jax.device_get(e1.params["linear_0"]["w"])
+    e1.save_checkpoint(ckpt)
+
+    e2 = make_engine(tmp_path, stage=3, mesh_shape={"data": 2, "fsdp": 4})
+    e2.load_checkpoint(ckpt)
+    np.testing.assert_array_equal(jax.device_get(e2.params["linear_0"]["w"]), w_before)
+    l2 = train(e2, 2, seed=100)
+    assert np.isfinite(float(l2))
+
+
+def test_client_state_and_latest_tag(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(tmp_path, stage=0)
+    train(e1, 2)
+    e1.save_checkpoint(ckpt, tag="my_tag", client_state={"epoch": 7})
+    assert open(os.path.join(ckpt, "latest")).read() == "my_tag"
+    e2 = make_engine(tmp_path, stage=0)
+    _, client = e2.load_checkpoint(ckpt)
+    assert client["epoch"] == 7

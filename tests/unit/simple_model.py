@@ -1,0 +1,60 @@
+"""Test fixtures (reference: tests/unit/simple_model.py — SimpleModel,
+random-data loaders)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Tiny MLP regression model implementing the engine protocol."""
+
+    def __init__(self, hidden_dim=16, nlayers=2, seed=0):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init(self, rng):
+        keys = jax.random.split(rng, self.nlayers + 1)
+        params = {
+            f"linear_{i}": {
+                "w": jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim), jnp.float32) * 0.1,
+                "b": jnp.zeros((self.hidden_dim,), jnp.float32),
+            }
+            for i in range(self.nlayers)
+        }
+        return params
+
+    def apply(self, params, x):
+        for i in range(self.nlayers):
+            p = params[f"linear_{i}"]
+            x = jnp.tanh(x @ p["w"] + p["b"])
+        return x
+
+    def loss(self, params, batch, rng=None):
+        pred = self.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    def logical_specs(self, params):
+        return None
+
+
+def random_batch(batch_size, hidden_dim, seed=0):
+    rs = np.random.RandomState(seed)
+    return {
+        "x": rs.randn(batch_size, hidden_dim).astype(np.float32),
+        # targets inside tanh's range so the model can actually fit them
+        "y": np.tanh(rs.randn(batch_size, hidden_dim)).astype(np.float32),
+    }
+
+
+class RandomDataset:
+    def __init__(self, n, hidden_dim, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randn(n, hidden_dim).astype(np.float32)
+        self.y = rs.randn(n, hidden_dim).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
