@@ -54,7 +54,8 @@ def initialize(
 
     cfg = TpuConfig(config)
 
-    if cfg.pipeline.stages > 1 or _is_pipeline_model(model):
+    pipe_axis = cfg.mesh_axis_sizes().get("pipe", 1)
+    if cfg.pipeline.stages > 1 or pipe_axis > 1 or _is_pipeline_model(model):
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
         engine = PipelineEngine(

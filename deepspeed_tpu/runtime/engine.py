@@ -180,7 +180,7 @@ class TpuEngine:
         self.param_shardings = self.policy.param_shardings(abstract_params)
         self.grad_shardings = self.policy.grad_shardings(abstract_params)
         self.opt_shardings = self.policy.opt_shardings(abstract_params)
-        self.batch_sharding = self.policy.batch_sharding()
+        self.batch_sharding = NamedSharding(mesh, self._batch_pspec())
         self.replicated = self.policy.replicated()
 
         # --- precision plan (reference: bf16_optimizer / fp16 fused_optimizer)
@@ -277,6 +277,10 @@ class TpuEngine:
             f"gas={self.gradient_accumulation_steps}",
             ranks=[0],
         )
+
+    def _batch_pspec(self) -> PartitionSpec:
+        """Sharding of batch leaves; PipelineEngine overrides (microbatch dim)."""
+        return self.policy.batch_spec()
 
     # ------------------------------------------------------------------
     # compiled programs

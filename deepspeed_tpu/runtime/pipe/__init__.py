@@ -1,0 +1,11 @@
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.pipe.pipelining import (
+    pipeline_apply_sequential,
+    pipeline_apply_stacked,
+)
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology,
+    PipelineParallelGrid,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)

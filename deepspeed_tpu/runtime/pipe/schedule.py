@@ -1,0 +1,239 @@
+"""Declarative pipeline schedules (instruction streams).
+
+Reference: ``runtime/pipe/schedule.py`` (PipeSchedule, TrainSchedule :189 —
+1F1B — InferenceSchedule :135, instruction classes :327-475). In the TPU
+build the *executed* schedule is compiled (pipelining.py: one lax.scan whose
+tick is "all stages forward + shift"), so these classes serve two roles:
+
+  1. API parity for code that introspects schedules;
+  2. documentation/validation — tests assert the compiled GPipe tick count
+     equals the instruction stream's forward span.
+
+Each schedule yields, per step, a list of PipeInstruction for one stage.
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Base: derive per-stage instruction streams from (micro_batches,
+    stages, stage_id)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _valid_micro_batch(self, micro_batch_id) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id) -> bool:
+        return 0 <= stage_id < self.stages
+
+    def __iter__(self):
+        return iter(self.steps())
+
+    def __len__(self):
+        return sum(1 for _ in self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference :135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            buffer_id = micro_batch_id % max(self.num_pipe_buffers(), 1)
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id))
+                else:
+                    cmds.append(RecvActivation(buffer_id))
+                cmds.append(ForwardPass(buffer_id))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B interleave (reference :189): warmup forwards, steady-state
+    alternating fwd/bwd, cooldown backwards, then reduce + step."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+
+            # exchange activations/grads with neighbors
+            if self._valid_micro_batch(prev_micro_batch_id):
+                prev_buffer = self._buffer_idx(prev_micro_batch_id)
+                if is_forward and not self.is_first_stage:
+                    cmds.append(SendGrad(prev_buffer))
+                if not is_forward and not self.is_last_stage:
+                    cmds.append(SendActivation(prev_buffer))
+            if self._valid_micro_batch(micro_batch_id):
+                curr_buffer = self._buffer_idx(micro_batch_id)
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(curr_buffer))
+                    else:
+                        cmds.append(RecvActivation(curr_buffer))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(curr_buffer))
+                if is_forward:
+                    cmds.append(ForwardPass(curr_buffer))
+                else:
+                    cmds.append(BackwardPass(curr_buffer))
+
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return min(self.stages - self.stage_id, self.micro_batches)
+
+    def _buffer_idx(self, micro_batch_id) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def _step_to_micro_batch(self, step_id):
+        def _is_even(x):
+            return x % 2 == 0
+
+        def _is_odd(x):
+            return x % 2 != 0
+
+        if _is_even(step_id) and _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id)
+            is_forward = True
+        elif _is_odd(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._odd_step_forward_id(step_id)
+            is_forward = True
+        elif _is_even(step_id) and _is_odd(self.stage_id):
+            micro_batch_id = self._even_step_backward_id(step_id)
+            is_forward = False
+        else:
+            micro_batch_id = self._odd_step_backward_id(step_id)
+            is_forward = False
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        base = (step_id - 1) // 2
+        return base - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        base = step_id // 2
+        return base - self.stages + (self.stage_id + 1) // 2
+
+    def _odd_step_backward_id(self, step_id):
+        base = ((step_id - 1) // 2) - self.stages + 1
+        return base + self.stage_id // 2
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference :475)."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 1

@@ -1,0 +1,155 @@
+"""Cartesian process/device topology.
+
+Reference: ``runtime/pipe/topology.py`` (ProcessTopology :12,
+PipeModelDataParallelTopology :244, PipelineParallelGrid :251). On TPU the
+authoritative topology is the ``jax.sharding.Mesh``; these classes provide the
+same coordinate/rank algebra (axis-major rank mapping, coordinate filtering,
+per-axis "process groups" as device lists) so ported code and the launcher can
+reason about the grid without torch process groups.
+"""
+
+import itertools
+from collections import namedtuple
+from typing import Dict, List, Optional, Sequence
+
+
+class ProcessTopology:
+    """Maps n-dimensional axis coordinates <-> linear ranks (axis-major,
+    first axis varies slowest — same convention as the reference)."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = {axis: coord[i] for i, axis in enumerate(self.axes)}
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            names.append(f"{ax}{inner_sep}{self.get_coord(rank)._asdict()[ax]:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis`` (all other coords
+        equal) — the reference uses these to build process groups; here they
+        feed launcher/debug tooling."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for other in itertools.product(*ranges):
+            fixed = dict(zip(other_axes, other))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(rank for coord, rank in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """2D pipe × data grid (reference :226)."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pipe × data × model grid (reference :244)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis-rank bookkeeping over a topology (reference :251) bridged to the
+    mesh world: stage_id / data-parallel id / sizes, with the mesh axis names
+    used by the engine ('pipe', 'data'×'fsdp', 'tensor')."""
+
+    def __init__(self, topology: Optional[ProcessTopology] = None, process_group=None, mesh=None):
+        if topology is None:
+            from deepspeed_tpu import comm
+
+            m = mesh if mesh is not None else comm.get_mesh()
+            dims = dict(m.shape)
+            topology = PipeModelDataParallelTopology(
+                num_pp=dims.get("pipe", 1),
+                num_mp=dims.get("tensor", 1),
+                num_dp=dims.get("data", 1) * dims.get("fsdp", 1),
+            )
+        self._topo = topology
+        self.data_parallel_size = topology.get_dim("data") or 1
+        self.pipe_parallel_size = topology.get_dim("pipe") or 1
+        self.model_parallel_size = topology.get_dim("model") or 1
+        self.global_rank = 0  # single-controller: host 0 view
+        self.world_size = topology.world_size()
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_stage_id(self, rank: Optional[int] = None) -> int:
+        rank = self.global_rank if rank is None else rank
+        return self._topo.get_coord(rank).pipe
+
+    def get_data_parallel_id(self, rank: Optional[int] = None) -> int:
+        rank = self.global_rank if rank is None else rank
+        return self._topo.get_coord(rank).data
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def stage_to_global(self, stage_id: int, data=0, model=0) -> int:
+        kwargs = {"pipe": stage_id, "data": data}
+        if "model" in self._topo.get_axis_names():
+            kwargs["model"] = model
+        return self._topo.get_rank(**kwargs)
+
+    def is_first_stage(self, rank: Optional[int] = None) -> bool:
+        return self.get_stage_id(rank) == 0
+
+    def is_last_stage(self, rank: Optional[int] = None) -> bool:
+        return self.get_stage_id(rank) == self.pipe_parallel_size - 1

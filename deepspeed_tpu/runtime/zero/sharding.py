@@ -40,6 +40,7 @@ DEFAULT_LOGICAL_AXIS_RULES = (
     ("qkv", "tensor"),
     ("expert", "expert"),
     ("layers", None),
+    ("stage", "pipe"),
     ("norm", None),
 )
 
