@@ -55,6 +55,16 @@ class TransformerConfig:
     attn_impl: str = "xla"  # xla | pallas (flash attention kernel)
     use_bias: bool = True  # linear/ln biases (gpt2 yes, llama no)
     scan_layers: bool = True
+    # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+    moe_drop_tokens: bool = True
+    moe_use_rts: bool = False  # random token selection needs an rng at loss()
+    # --- sequence/context parallelism (parallel/sequence.py) ---
+    seq_parallel: str = "none"  # none | ring | ulysses
 
     @property
     def head_dim(self):
@@ -79,6 +89,8 @@ class TransformerConfig:
         kvd = self.kv_heads * self.head_dim
         attn = D * D + 2 * D * kvd + D * D  # q,k,v,o
         mlp = (3 if self.activation == "silu_glu" else 2) * D * F
+        if self.moe_num_experts > 0:
+            mlp = mlp * self.moe_num_experts + D * self.moe_num_experts  # experts + router
         per_layer = attn + mlp + 2 * D  # + ln scales
         if self.use_bias:
             per_layer += (D + 2 * kvd + D) + (F + D) + 2 * D  # attn/mlp/ln biases
@@ -134,6 +146,30 @@ def init(rng, cfg: TransformerConfig):
     def stack(maker):
         return jnp.stack([maker(k) for k in jax.random.split(next(keys), L)])
 
+    E = cfg.moe_num_experts
+
+    def estack(maker):
+        """Stack over layers AND experts: (L, E, ...)."""
+        return jnp.stack(
+            [jnp.stack([maker(k) for k in jax.random.split(lk, E)]) for lk in jax.random.split(next(keys), L)]
+        )
+
+    if E > 0:
+        mlp = {
+            "gate": stack(lambda k: jax.random.normal(k, (D, E), jnp.float32) * 0.02),
+            "wi": estack(lambda k: dense(k, (D, F), D)),
+            "wo": estack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
+        }
+        if cfg.activation == "silu_glu":
+            mlp["wg"] = estack(lambda k: dense(k, (D, F), D))
+    else:
+        mlp = {
+            "wi": stack(lambda k: dense(k, (D, F), D)),
+            "wo": stack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
+        }
+        if cfg.activation == "silu_glu":
+            mlp["wg"] = stack(lambda k: dense(k, (D, F), D))
+
     params = {
         "embed": {"tok": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02},
         "layers": {
@@ -143,17 +179,12 @@ def init(rng, cfg: TransformerConfig):
                 "wv": stack(lambda k: dense(k, (D, nkv * hd), D)),
                 "wo": stack(lambda k: dense(k, (nh * hd, D), nh * hd) / math.sqrt(2 * L)),
             },
-            "mlp": {
-                "wi": stack(lambda k: dense(k, (D, F), D)),
-                "wo": stack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
-            },
+            "mlp": mlp,
             "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
             "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
         },
         "final_norm": {"scale": jnp.ones((D,), jnp.float32)},
     }
-    if cfg.activation == "silu_glu":
-        params["layers"]["mlp"]["wg"] = stack(lambda k: dense(k, (D, F), D))
     if cfg.pos_embedding == "learned":
         params["embed"]["pos"] = jax.random.normal(next(keys), (S, D), jnp.float32) * 0.02
     if not cfg.tie_embeddings:
@@ -163,8 +194,12 @@ def init(rng, cfg: TransformerConfig):
         params["layers"]["attn"]["bk"] = jnp.zeros((L, nkv * hd), jnp.float32)
         params["layers"]["attn"]["bv"] = jnp.zeros((L, nkv * hd), jnp.float32)
         params["layers"]["attn"]["bo"] = jnp.zeros((L, D), jnp.float32)
-        params["layers"]["mlp"]["bi"] = jnp.zeros((L, F), jnp.float32)
-        params["layers"]["mlp"]["bo"] = jnp.zeros((L, D), jnp.float32)
+        if E > 0:
+            params["layers"]["mlp"]["bi"] = jnp.zeros((L, E, F), jnp.float32)
+            params["layers"]["mlp"]["bo"] = jnp.zeros((L, E, D), jnp.float32)
+        else:
+            params["layers"]["mlp"]["bi"] = jnp.zeros((L, F), jnp.float32)
+            params["layers"]["mlp"]["bo"] = jnp.zeros((L, D), jnp.float32)
         params["layers"]["ln1"]["bias"] = jnp.zeros((L, D), jnp.float32)
         params["layers"]["ln2"]["bias"] = jnp.zeros((L, D), jnp.float32)
         params["final_norm"]["bias"] = jnp.zeros((D,), jnp.float32)
@@ -190,8 +225,12 @@ def logical_specs(params, cfg: TransformerConfig):
             }
             return pre + table[last]
         if "mlp" in names:
+            if cfg.moe_num_experts > 0 and last != "gate":
+                table = {"wi": ("expert", "embed", "mlp"), "wg": ("expert", "embed", "mlp"),
+                         "wo": ("expert", "mlp", "embed"), "bi": ("expert", "mlp"), "bo": ("expert", "embed")}
+                return pre + table[last]
             table = {"wi": ("embed", "mlp"), "wg": ("embed", "mlp"), "wo": ("mlp", "embed"),
-                     "bi": ("mlp",), "bo": ("embed",)}
+                     "bi": ("mlp",), "bo": ("embed",), "gate": ("embed", None)}
             return pre + table[last]
         if "ln1" in names or "ln2" in names:
             return pre + ("norm",)
@@ -292,19 +331,50 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     x = x + attn_out
 
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
-    if cfg.activation == "silu_glu":
-        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
-        act = jax.nn.silu(gate) * up
+    if cfg.moe_num_experts > 0:
+        from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+        def expert_fn(ep, t):
+            if cfg.activation == "silu_glu":
+                a = jax.nn.silu(t @ ep["wg"]) * (t @ ep["wi"])
+            else:
+                a = t @ ep["wi"]
+                if cfg.use_bias:
+                    a = a + ep["bi"]
+                a = jax.nn.gelu(a)
+            out = a @ ep["wo"]
+            if cfg.use_bias:
+                out = out + ep["bo"]
+            return out
+
+        expert_params = {k: v for k, v in mlp_p.items() if k != "gate"}
+        mlp_out, aux, _ = moe_forward(
+            h,
+            mlp_p["gate"],
+            expert_fn,
+            expert_params,
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor,
+            min_capacity=cfg.moe_min_capacity,
+            rng=dropout_rng if cfg.moe_use_rts else None,
+            use_rts=cfg.moe_use_rts,
+            drop_tokens=cfg.moe_drop_tokens,
+        )
     else:
-        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        aux = jnp.float32(0.0)
+        if cfg.activation == "silu_glu":
+            up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+            gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
+            act = jax.nn.silu(gate) * up
+        else:
+            act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+            if cfg.use_bias:
+                act = act + mlp_p["bi"]
+            act = jax.nn.gelu(act)
+        mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
         if cfg.use_bias:
-            act = act + mlp_p["bi"]
-        act = jax.nn.gelu(act)
-    mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
-    if cfg.use_bias:
-        mlp_out = mlp_out + mlp_p["bo"]
-    return x + mlp_out
+            mlp_out = mlp_out + mlp_p["bo"]
+    return x + mlp_out, aux
 
 
 _REMAT_POLICIES = {
@@ -315,8 +385,8 @@ _REMAT_POLICIES = {
 }
 
 
-def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
-    """tokens (B, S) int32 -> logits (B, S, V)."""
+def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
+    """tokens (B, S) int32 -> (logits (B, S, V), moe_aux_loss scalar)."""
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
@@ -329,37 +399,47 @@ def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
         layer_fn = jax.checkpoint(layer_fn, policy=_REMAT_POLICIES[cfg.remat_policy], static_argnums=())
 
     layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+    needs_rng = (cfg.dropout > 0.0 or cfg.moe_use_rts) and dropout_rng is not None
     if cfg.scan_layers:
-        if cfg.dropout > 0.0 and dropout_rng is not None:
+        if needs_rng:
             layer_rngs = jax.random.split(dropout_rng, cfg.num_layers)
         else:
             layer_rngs = jnp.zeros((cfg.num_layers, 2), jnp.uint32)
 
         def scan_step(carry, inp):
             layer_p, rng = inp
-            rng = rng if cfg.dropout > 0.0 and dropout_rng is not None else None
-            return layer_fn(carry, layer_p, dropout_rng=rng), None
+            rng = rng if needs_rng else None
+            new_x, aux = layer_fn(carry, layer_p, dropout_rng=rng)
+            return new_x, aux
 
-        x, _ = jax.lax.scan(scan_step, x, (layers, layer_rngs))
+        x, auxs = jax.lax.scan(scan_step, x, (layers, layer_rngs))
+        aux_total = jnp.sum(auxs)
     else:
+        aux_total = jnp.float32(0.0)
         for i in range(cfg.num_layers):
             layer_p = jax.tree.map(lambda p: p[i], layers)
-            rng = jax.random.fold_in(dropout_rng, i) if (cfg.dropout > 0.0 and dropout_rng is not None) else None
-            x = layer_fn(x, layer_p, dropout_rng=rng)
+            rng = jax.random.fold_in(dropout_rng, i) if needs_rng else None
+            x, aux = layer_fn(x, layer_p, dropout_rng=rng)
+            aux_total = aux_total + aux
 
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
-    return logits
+    return logits, aux_total
+
+
+def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
+    """tokens (B, S) int32 -> logits (B, S, V)."""
+    return forward(params, cfg, tokens, dropout_rng=dropout_rng)[0]
 
 
 def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
     """Next-token cross entropy. batch: {'input_ids': (B,S) int32} and
     optional 'labels' (shifted internally if absent) and 'loss_mask'."""
     tokens = batch["input_ids"]
-    logits = apply(params, cfg, tokens, dropout_rng=rng)
+    logits, moe_aux = forward(params, cfg, tokens, dropout_rng=rng)
     if "labels" in batch:
         labels = batch["labels"]
         logits_for_loss = logits
@@ -372,8 +452,12 @@ def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask[:, : nll.shape[1]].astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        ce = jnp.mean(nll)
+    if cfg.moe_num_experts > 0:
+        ce = ce + cfg.moe_aux_loss_coef * moe_aux
+    return ce
 
 
 class TransformerModel:

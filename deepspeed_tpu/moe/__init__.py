@@ -1,0 +1,9 @@
+from deepspeed_tpu.moe.layer import MLPExpert, MoE
+from deepspeed_tpu.moe.sharded_moe import (
+    GateOutput,
+    compute_capacity,
+    moe_forward,
+    top1_gating,
+    top2_gating,
+    topk_gating,
+)

@@ -1,0 +1,109 @@
+"""User-facing MoE layer.
+
+Reference: ``deepspeed/moe/layer.py`` (MoE :16 — wraps an expert module with
+a TopKGate + MOELayer and exposes ``forward -> (output, l_aux, exp_counts)``)
+and ``moe/experts.py`` (Experts — per-expert replicas). Functional TPU form:
+``MoE.init(rng) -> params`` / ``MoE.apply(params, x, rng) -> (out, l_aux,
+exp_counts)`` with expert params stacked on a leading E dim carrying the
+``expert`` logical axis, so the ShardingPolicy places them on the ``expert``
+mesh axis (the reference's expert-parallel process groups,
+utils/groups.py:108, are that axis)."""
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+
+class MLPExpert:
+    """Default expert: 2-layer MLP (reference experts are arbitrary modules;
+    this mirrors the common FFN expert)."""
+
+    def __init__(self, hidden_size: int, ffn_size: int, activation=jax.nn.gelu):
+        self.hidden_size = hidden_size
+        self.ffn_size = ffn_size
+        self.activation = activation
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        D, F = self.hidden_size, self.ffn_size
+        return {
+            "wi": jax.random.normal(k1, (D, F), jnp.float32) / math.sqrt(D),
+            "wo": jax.random.normal(k2, (F, D), jnp.float32) / math.sqrt(F),
+        }
+
+    def apply(self, params, x):
+        return self.activation(x @ params["wi"]) @ params["wo"]
+
+    def logical_specs(self):
+        return {"wi": ("expert", "embed", "mlp"), "wo": ("expert", "mlp", "embed")}
+
+
+class MoE:
+    """Mixture of experts over a stacked expert module.
+
+    Args mirror the reference MoE (moe/layer.py:16): num_experts, k (top-k),
+    capacity_factor, eval_capacity_factor, min_capacity, drop_tokens, use_rts,
+    noisy_gate_policy. ``ep_size`` is implicit: the ``expert`` mesh axis.
+    """
+
+    def __init__(
+        self,
+        hidden_size: int,
+        expert=None,
+        num_experts: int = 1,
+        k: int = 1,
+        capacity_factor: float = 1.0,
+        eval_capacity_factor: float = 1.0,
+        min_capacity: int = 4,
+        drop_tokens: bool = True,
+        use_rts: bool = True,
+        noisy_gate_policy: Optional[str] = None,
+        ffn_size: Optional[int] = None,
+    ):
+        assert k in (1, 2), "only top-1 / top-2 gating supported (reference TopKGate :358)"
+        self.hidden_size = hidden_size
+        self.expert = expert if expert is not None else MLPExpert(hidden_size, ffn_size or 4 * hidden_size)
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.drop_tokens = drop_tokens
+        self.use_rts = use_rts
+        self.noisy_gate_policy = noisy_gate_policy
+
+    def init(self, rng):
+        gate_rng, exp_rng = jax.random.split(rng)
+        expert_params = jax.vmap(self.expert.init)(jax.random.split(exp_rng, self.num_experts))
+        gate_w = jax.random.normal(gate_rng, (self.hidden_size, self.num_experts), jnp.float32) * 0.02
+        return {"gate": {"w": gate_w}, "experts": expert_params}
+
+    def logical_specs(self):
+        specs = {"gate": {"w": ("embed", None)}}
+        if hasattr(self.expert, "logical_specs"):
+            specs["experts"] = self.expert.logical_specs()
+        else:
+            specs["experts"] = None
+        return specs
+
+    def apply(self, params, x, rng=None, training: bool = True):
+        cf = self.capacity_factor if training else self.eval_capacity_factor
+        return moe_forward(
+            x,
+            params["gate"]["w"],
+            self.expert.apply,
+            params["experts"],
+            k=self.k,
+            capacity_factor=cf,
+            min_capacity=self.min_capacity,
+            rng=rng,
+            use_rts=self.use_rts and rng is not None,
+            drop_tokens=self.drop_tokens,
+            noisy_gate_policy=self.noisy_gate_policy,
+        )
+
+    __call__ = apply

@@ -108,14 +108,15 @@ def topk_gating(
             rng, sub = jax.random.split(rng)
             priority = jax.random.uniform(sub, (N,))
         pos = _assign_positions(mask, priority)  # (N, E)
-        # offset by tokens already buffered from earlier choices
-        already = jnp.sum(jnp.stack(selected_masks), axis=0) if selected_masks else 0.0
         if selected_masks:
-            pos = pos + jnp.sum(already, axis=0, keepdims=True) * 0  # choices route to distinct experts per token; capacity shared below
+            # later choices start after slots taken by earlier choices in the
+            # same expert buffer (reference top2gating :307 locations2 offset)
+            offset = sum(jnp.sum(m, axis=0) for m in selected_masks)  # (E,)
+            pos = pos + offset[None, :]
         keep = (pos < C) & (mask > 0)
         expert_counts = expert_counts + jnp.sum(mask, axis=0).astype(jnp.int32)
         gate_i = jnp.sum(gates * mask, axis=-1)  # (N,)
-        oh_pos = jax.nn.one_hot(jnp.clip(pos, 0, C - 1), C, dtype=jnp.float32)  # (N, E, C)
+        oh_pos = jax.nn.one_hot(jnp.clip(pos, 0, C - 1).astype(jnp.int32), C, dtype=jnp.float32)  # (N, E, C)
         sel = (mask[..., None] * oh_pos) * keep[..., None].astype(jnp.float32)
         selected_gates.append(gate_i)
         selected_masks.append(mask * keep.astype(jnp.float32))

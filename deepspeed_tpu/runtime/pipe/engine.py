@@ -104,12 +104,15 @@ class PipelinedTransformer:
 
         def stage_fn(stage_layers, h):
             def body(carry, lp):
-                return layer_fn(carry, lp), None
+                h2, aux = layer_fn(carry, lp)
+                return h2, aux
 
-            h, _ = jax.lax.scan(body, h, stage_layers)
-            return h
+            h, auxs = jax.lax.scan(body, h, stage_layers)
+            return h, jnp.sum(auxs)
 
-        outs = pipeline_apply_stacked(layers, x, stage_fn, state_sharding=self._state_sharding())
+        outs, moe_aux = pipeline_apply_stacked(
+            layers, x, stage_fn, state_sharding=self._state_sharding(), with_aux=True
+        )
 
         x = tf._norm(outs, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
         if cfg.tie_embeddings:
@@ -128,8 +131,12 @@ class PipelinedTransformer:
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[..., : nll.shape[-1]].astype(jnp.float32)
-            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return jnp.mean(nll)
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            ce = jnp.mean(nll)
+        if cfg.moe_num_experts > 0:
+            ce = ce + cfg.moe_aux_loss_coef * moe_aux / self.num_microbatches
+        return ce
 
 
 class PipelineModuleModel:

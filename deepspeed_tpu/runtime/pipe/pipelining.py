@@ -43,41 +43,58 @@ def pipeline_apply_stacked(
     x_microbatches: jnp.ndarray,
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     state_sharding=None,
-) -> jnp.ndarray:
+    with_aux: bool = False,
+):
     """Run M microbatches through P homogeneous stages (the TPU fast path).
 
     Args:
       stage_params: pytree whose leaves have leading dim P (stage-stacked),
         sharded over the ``pipe`` mesh axis.
       x_microbatches: (M, *act_shape) pipeline inputs, one slice per microbatch.
-      stage_fn: (stage_param_slice, activation) -> activation, applied to every
-        stage in parallel via vmap over the stacked dim.
+      stage_fn: (stage_param_slice, activation) -> activation (or
+        (activation, aux_scalar) when ``with_aux``), applied to every stage in
+        parallel via vmap over the stacked dim.
       state_sharding: optional NamedSharding for the (P, *act_shape) rotating
         buffer (keeps GSPMD from re-laying-out the pipeline state each tick).
+      with_aux: stage_fn also returns a per-stage scalar (e.g. MoE aux loss);
+        contributions from bubble ticks (no real microbatch in the stage) are
+        masked out and the valid ones summed.
 
-    Returns: (M, *act_shape) outputs of the final stage, microbatch-ordered.
+    Returns: (M, *act_shape) final-stage outputs, microbatch-ordered
+    (plus the aux-loss sum when ``with_aux``).
     """
     M = x_microbatches.shape[0]
     P = jax.tree.leaves(stage_params)[0].shape[0]
     state0 = jnp.zeros((P,) + x_microbatches.shape[1:], x_microbatches.dtype)
     state0 = _constrain(state0, state_sharding)
     vstage = jax.vmap(stage_fn)
+    stage_ids = jnp.arange(P)
 
-    def tick(state, t):
+    def tick(carry, t):
+        state, aux_tot = carry
         # inject microbatch t into stage 0 (clamped index: tail ticks re-feed
         # the last microbatch; its extra outputs are discarded below)
         inp = jax.lax.dynamic_index_in_dim(x_microbatches, jnp.minimum(t, M - 1), axis=0, keepdims=False)
         state = jax.lax.dynamic_update_index_in_dim(state, inp, 0, axis=0)
-        y = vstage(stage_params, state)
+        if with_aux:
+            y, aux = vstage(stage_params, state)
+            mb_id = t - stage_ids  # microbatch in stage s at tick t
+            valid = ((mb_id >= 0) & (mb_id < M)).astype(jnp.float32)
+            aux_tot = aux_tot + jnp.sum(aux.astype(jnp.float32) * valid)
+        else:
+            y = vstage(stage_params, state)
         y = _constrain(y, state_sharding)
         out = jax.lax.index_in_dim(y, P - 1, axis=0, keepdims=False)
         # shift stage i's output to stage i+1's input slot -> collective
         # permute over the 'pipe' axis under GSPMD
         nxt = jnp.roll(y, 1, axis=0)
-        return nxt, out
+        return (nxt, aux_tot), out
 
-    _, ys = jax.lax.scan(tick, state0, jnp.arange(num_pipeline_ticks(M, P)))
-    return ys[P - 1:]
+    (_, aux_total), ys = jax.lax.scan(tick, (state0, jnp.float32(0.0)), jnp.arange(num_pipeline_ticks(M, P)))
+    outs = ys[P - 1:]
+    if with_aux:
+        return outs, aux_total
+    return outs
 
 
 def pipeline_apply_sequential(
