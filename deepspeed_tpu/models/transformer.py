@@ -287,6 +287,13 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
     """
     B, S, nh, hd = q.shape
     nkv = k.shape[2]
+    if cfg.seq_parallel in ("ring", "ulysses"):
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.parallel.sequence import sequence_parallel_attention
+
+        mesh = comm.get_mesh()
+        if mesh.shape.get("sequence", 1) > 1:
+            return sequence_parallel_attention(q, k, v, impl=cfg.seq_parallel, causal=True, mesh=mesh)
     if cfg.attn_impl == "pallas":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -1,0 +1,154 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+The reference (v0.9.1) has NO sequence-parallel axis (SURVEY.md §2.2: its
+long-sequence story is Triton block-sparse attention + curriculum seqlen +
+random-LTD). This module provides the modern first-class equivalent the
+capability list requires, shaped for TPU ICI:
+
+  - **Ring attention** (`ring_attention`): activations stay sharded over the
+    ``sequence`` mesh axis; KV blocks rotate around the ring via
+    ``ppermute`` while each device accumulates its queries' attention with an
+    online (flash-style) softmax. Memory per device is O(S/n · S/n) per step
+    and the ppermute overlaps with the block matmul — the pattern ICI's
+    torus topology is built for.
+  - **Ulysses attention** (`ulysses_attention`): DeepSpeed-Ulysses-style
+    all-to-all that re-shards from sequence-split to head-split, runs plain
+    (or flash) attention on full sequences for a head subset, and
+    all-to-alls back. Cheaper at moderate sequence lengths; requires
+    num_heads % axis_size == 0.
+
+Both are written as *local* functions to be wrapped in a partial-manual
+``jax.shard_map`` over only the ``sequence`` axis (other mesh axes stay under
+GSPMD), via ``sequence_parallel_attention``.
+"""
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+NEG_INF = -1e30
+
+
+def _pcast_varying(tree, axis_name):
+    """Mark arrays as device-varying over ``axis_name`` (JAX >= 0.9 VMA
+    typing for shard_map carries); no-op on older versions."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, (axis_name,), to="varying")
+    return tree
+
+
+def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sequence"):
+    """Blockwise ring attention over ``axis_name`` (call inside shard_map).
+
+    q: (B, S_local, H, hd); k/v: (B, S_local, Hkv, hd). Returns
+    (B, S_local, H, hd). GQA is handled by repeating KV heads locally.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Sq, H, hd = q.shape
+    nkv = k.shape[2]
+    if nkv != H:
+        rep = H // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    q32 = q.astype(jnp.float32)
+    qpos = my * Sq + jnp.arange(Sq)
+
+    m0 = jnp.full((B, H, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sq, hd), jnp.float32)
+    m0, l0, acc0 = _pcast_varying((m0, l0, acc0), axis_name)
+    perm = None  # built lazily from n (static under jit)
+
+    def step(carry, i):
+        kb, vb, m, l, acc = carry
+        src = (my - i) % n  # global block index of the KV we currently hold
+        kpos = src * Sq + jnp.arange(Sq)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32)) * scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+        shift = [(j, (j + 1) % n) for j in range(n)]
+        kb = jax.lax.ppermute(kb, axis_name, shift)
+        vb = jax.lax.ppermute(vb, axis_name, shift)
+        return (kb, vb, m_new, l_new, acc_new), None
+
+    (kb, vb, m, l, acc), _ = jax.lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l[..., None], 1e-20)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sequence", attn_fn=None):
+    """DeepSpeed-Ulysses-style all-to-all attention (call inside shard_map).
+
+    Re-shards (B, S/n, H, hd) -> (B, S, H/n, hd), runs full-sequence
+    attention on the local head subset, then re-shards back.
+    """
+    H = q.shape[2]
+    nkv = k.shape[2]
+    if nkv != H:
+        rep = H // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # scatter heads, gather sequence
+    a2a = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+    if attn_fn is None:
+        attn_fn = _full_causal_attention if causal else partial(_full_causal_attention, causal=False)
+    out = attn_fn(qh, kh, vh)
+    # scatter sequence, gather heads
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _full_causal_attention(q, k, v, causal: bool = True):
+    B, S, H, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def sequence_parallel_attention(
+    q,
+    k,
+    v,
+    impl: str = "ring",
+    causal: bool = True,
+    mesh=None,
+    seq_axis: str = "sequence",
+):
+    """Top-level SPMD entry: q/k/v are (B, S, H, hd) global arrays; the
+    attention runs sequence-parallel over ``seq_axis`` via partial-manual
+    shard_map (other mesh axes remain under GSPMD)."""
+    if mesh is None:
+        from deepspeed_tpu import comm
+
+        mesh = comm.get_mesh()
+    n = mesh.shape[seq_axis]
+    if n <= 1:
+        return _full_causal_attention(q, k, v, causal=causal)
+    S = q.shape[1]
+    assert S % n == 0, f"seq len {S} must divide over {n} sequence shards"
+    if impl == "ulysses":
+        assert q.shape[2] % n == 0, f"num_heads {q.shape[2]} must divide over {n} for Ulysses"
+        local = partial(ulysses_attention, causal=causal, axis_name=seq_axis)
+    elif impl == "ring":
+        local = partial(ring_attention, causal=causal, axis_name=seq_axis)
+    else:
+        raise ValueError(f"unknown sequence-parallel impl '{impl}' (ring | ulysses)")
+    spec = PartitionSpec(None, seq_axis, None, None)
+    fn = jax.shard_map(local, mesh=mesh, axis_names={seq_axis}, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

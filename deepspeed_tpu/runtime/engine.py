@@ -395,12 +395,16 @@ class TpuEngine:
         )
 
     def _shard_batch(self, batch):
-        return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self.batch_sharding)
-            if getattr(x, "ndim", 0) > 0
-            else jnp.asarray(x),
-            batch,
-        )
+        spec = self._batch_pspec()
+
+        def put(x):
+            x = jnp.asarray(x)
+            if x.ndim == 0:
+                return x
+            leaf_spec = PartitionSpec(*tuple(spec)[: x.ndim])
+            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+
+        return jax.tree.map(put, batch)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)

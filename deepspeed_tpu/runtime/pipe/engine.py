@@ -219,8 +219,8 @@ class PipelineEngine(TpuEngine):
         )
 
     def _batch_pspec(self):
-        # (microbatch, batch, ...): microbatch dim unsharded, batch over DP
-        return PartitionSpec(None, ("data", "fsdp"))
+        # (microbatch, batch, seq): microbatch dim unsharded, batch over DP
+        return PartitionSpec(None, ("data", "fsdp"), "sequence")
 
     def _shard_batch(self, batch):
         def fix(x):

@@ -203,7 +203,9 @@ class ShardingPolicy:
         return self._to_shardings(self.opt_pspecs(abstract_params))
 
     def batch_spec(self) -> PartitionSpec:
-        return PartitionSpec(("data", "fsdp"))
+        # batch rows over DP; the seq dim over the sequence axis (harmless
+        # when that axis is size 1; required for ring/Ulysses attention)
+        return PartitionSpec(("data", "fsdp"), "sequence")
 
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.batch_spec())
