@@ -1,0 +1,179 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels (fwd + bwd).
+
+Reference: ``csrc/transformer/normalize_kernels.cu`` (training) and
+``csrc/transformer/inference/csrc/layer_norm.cu`` (+residual variants) —
+SURVEY.md §2.4 #5/#6. XLA fuses unfused norm chains well already; this kernel
+exists for the residual-fused and kernel-benchmark paths and for API parity.
+
+Row-tiled: grid over row blocks, full feature dim resident in VMEM; stats in
+f32. Backward recomputes xhat and emits per-block partial (dscale, dbias)
+reduced outside (cross-row reductions don't fit the sequential-grid model).
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _auto_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() == "cpu"
+
+
+def _pick_block_rows(n: int, cap: int) -> int:
+    """Largest multiple-of-8 divisor of n up to cap (TPU sublane tiling), or
+    n itself when none exists (block == whole array is always legal)."""
+    best = 0
+    for br in range(8, min(cap, n) + 1, 8):
+        if n % br == 0:
+            best = br
+    return best if best else n
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, o_ref, mu_ref, rstd_ref, *, eps, rms):
+    x = x_ref[...].astype(jnp.float32)  # (br, D)
+    if rms:
+        mu = jnp.zeros((x.shape[0], 1), jnp.float32)
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+    else:
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mu) * rstd
+    out = xhat * scale_ref[...].astype(jnp.float32)
+    if bias_ref is not None:
+        out = out + bias_ref[...].astype(jnp.float32)
+    o_ref[...] = out.astype(o_ref.dtype)
+    mu_ref[...] = mu
+    rstd_ref[...] = rstd
+
+
+def _bwd_kernel(x_ref, scale_ref, mu_ref, rstd_ref, do_ref, dx_ref, dscale_ref, dbias_ref, *, rms):
+    x = x_ref[...].astype(jnp.float32)
+    scale = scale_ref[...].astype(jnp.float32)
+    mu = mu_ref[...]
+    rstd = rstd_ref[...]
+    do = do_ref[...].astype(jnp.float32)
+    xhat = (x - mu) * rstd
+    dscale_ref[...] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    dbias_ref[...] = jnp.sum(do, axis=0, keepdims=True)
+    dxhat = do * scale
+    D = x.shape[-1]
+    if rms:
+        dx = rstd * (dxhat - xhat * jnp.mean(dxhat * xhat, axis=-1, keepdims=True))
+    else:
+        dx = rstd * (
+            dxhat
+            - jnp.mean(dxhat, axis=-1, keepdims=True)
+            - xhat * jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+        )
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _run_fwd(x2, scale, bias, eps, rms, block_rows, interpret):
+    N, D = x2.shape
+    br = _pick_block_rows(N, block_rows)
+    grid = (N // br,)
+    args = [x2, scale.reshape(1, D)]
+    in_specs = [
+        pl.BlockSpec((br, D), lambda i: (i, 0)),
+        pl.BlockSpec((1, D), lambda i: (0, 0)),
+    ]
+    if bias is not None:
+        args.append(bias.reshape(1, D))
+        in_specs.append(pl.BlockSpec((1, D), lambda i: (0, 0)))
+        kernel = functools.partial(_fwd_kernel, eps=eps, rms=rms)
+    else:
+        kernel = functools.partial(
+            lambda x_ref, s_ref, o_ref, mu_ref, r_ref, **kw: _fwd_kernel(x_ref, s_ref, None, o_ref, mu_ref, r_ref, **kw),
+            eps=eps,
+            rms=rms,
+        )
+    o, mu, rstd = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x2.dtype),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return o, mu, rstd
+
+
+def _run_bwd(x2, scale, mu, rstd, do2, rms, block_rows, interpret):
+    N, D = x2.shape
+    br = _pick_block_rows(N, block_rows)
+    nb = N // br
+    dx, dscale_p, dbias_p = pl.pallas_call(
+        functools.partial(_bwd_kernel, rms=rms),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x2.dtype),
+            jax.ShapeDtypeStruct((nb, D), jnp.float32),
+            jax.ShapeDtypeStruct((nb, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, scale.reshape(1, D), mu, rstd, do2)
+    return dx, dscale_p.sum(axis=0), dbias_p.sum(axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused_norm(x, scale, bias, eps, rms, block_rows, interpret):
+    o, _, _ = _run_fwd(x, scale, bias, eps, rms, block_rows, interpret)
+    return o
+
+
+def _fused_norm_fwd(x, scale, bias, eps, rms, block_rows, interpret):
+    o, mu, rstd = _run_fwd(x, scale, bias, eps, rms, block_rows, interpret)
+    return o, (x, scale, bias, mu, rstd)
+
+
+def _fused_norm_bwd(eps, rms, block_rows, interpret, res, do):
+    x, scale, bias, mu, rstd = res
+    dx, dscale, dbias = _run_bwd(x, scale, mu, rstd, do, rms, block_rows, interpret)
+    dscale = dscale.astype(scale.dtype)
+    dbias_out = dbias.astype(bias.dtype) if bias is not None else None
+    return dx, dscale, dbias_out
+
+
+_fused_norm.defvjp(_fused_norm_fwd, _fused_norm_bwd)
+
+
+def fused_layernorm(x, scale, bias=None, eps: float = 1e-5, block_rows: int = 256, interpret: Optional[bool] = None):
+    """LayerNorm over the last dim of x (any leading shape)."""
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    out = _fused_norm(x2, scale, bias, eps, False, block_rows, _auto_interpret(interpret))
+    return out.reshape(lead + (x.shape[-1],))
+
+
+def fused_rmsnorm(x, scale, eps: float = 1e-5, block_rows: int = 256, interpret: Optional[bool] = None):
+    """RMSNorm over the last dim of x."""
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    out = _fused_norm(x2, scale, None, eps, True, block_rows, _auto_interpret(interpret))
+    return out.reshape(lead + (x.shape[-1],))

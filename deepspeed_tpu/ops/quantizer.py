@@ -1,0 +1,99 @@
+"""Groupwise quantization ops.
+
+Reference: ``csrc/quantization/pt_binding.cpp:141-160`` (quantize/dequantize,
+symmetric & asymmetric, stochastic rounding; ``fake_quantizer.cu`` for QAT) —
+SURVEY.md §2.4 #7. These are elementwise+reduction chains that XLA fuses into
+single kernels on TPU, so the implementation is jnp (the Pallas win is in
+attention/norm, not here); the API mirrors the reference's op surface.
+
+Layout convention: the tensor is flattened to (num_groups, group_size) and
+each group gets its own scale (and zero-point if asymmetric).
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x, num_groups):
+    n = x.size
+    assert n % num_groups == 0, f"{n} elements not divisible into {num_groups} groups"
+    return x.reshape(num_groups, n // num_groups)
+
+
+def quantize(
+    x: jnp.ndarray,
+    num_bits: int = 8,
+    num_groups: int = 1,
+    symmetric: bool = True,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """Quantize to ints. Returns (q int8/int32, scales (G,1), zero_points or None)."""
+    g = _grouped(x.astype(jnp.float32), num_groups)
+    qmax = 2 ** (num_bits - 1) - 1
+    qmin = -(2 ** (num_bits - 1))
+    if symmetric:
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.maximum(absmax / qmax, 1e-12)
+        t = g / scale
+        zp = None
+    else:
+        gmax = jnp.max(g, axis=-1, keepdims=True)
+        gmin = jnp.min(g, axis=-1, keepdims=True)
+        scale = jnp.maximum((gmax - gmin) / (2**num_bits - 1), 1e-12)
+        zp = jnp.round(qmin - gmin / scale)
+        t = g / scale + zp
+    if stochastic:
+        assert rng is not None, "stochastic rounding needs an rng key"
+        noise = jax.random.uniform(rng, t.shape) - 0.5
+        q = jnp.floor(t + 0.5 + noise)
+    else:
+        q = jnp.round(t)
+    q = jnp.clip(q, qmin, qmax)
+    dtype = jnp.int8 if num_bits <= 8 else jnp.int32
+    return q.astype(dtype), scale, zp
+
+
+def dequantize(q, scale, zero_point=None, num_groups: int = 1, out_shape=None):
+    g = _grouped(q.astype(jnp.float32), num_groups)
+    if zero_point is not None:
+        g = g - zero_point
+    out = g * scale
+    return out.reshape(out_shape) if out_shape is not None else out.reshape(-1)
+
+
+def fake_quantize(
+    x: jnp.ndarray,
+    num_bits: int = 8,
+    num_groups: int = 1,
+    symmetric: bool = True,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Quantize-dequantize round trip with a straight-through gradient
+    (reference fake_quantizer.cu — the QAT building block)."""
+
+    def ste(x):
+        q, scale, zp = quantize(x, num_bits, num_groups, symmetric, stochastic, rng)
+        return dequantize(q, scale, zp, num_groups, out_shape=x.shape).astype(x.dtype)
+
+    zero = x - jax.lax.stop_gradient(x)
+    return zero + jax.lax.stop_gradient(ste(x))
+
+
+def quantize_per_channel(w: jnp.ndarray, num_bits: int = 8, axis: int = 0):
+    """Per-output-channel symmetric weight quantization (int8 inference path,
+    reference module_inject weight_quantizer.py)."""
+    w32 = w.astype(jnp.float32)
+    qmax = 2 ** (num_bits - 1) - 1
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax / qmax, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -(2 ** (num_bits - 1)), qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_per_channel(q, scale, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)

@@ -1,0 +1,167 @@
+"""Kernel numerical-parity tests (reference: tests/unit/ops/ — custom kernels
+vs torch reference; here Pallas/jnp kernels vs jnp reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention, mha_reference
+from deepspeed_tpu.ops.pallas.fused_norm import fused_layernorm, fused_rmsnorm
+from deepspeed_tpu.ops.quantizer import (
+    dequantize,
+    fake_quantize,
+    quantize,
+    quantize_per_channel,
+    dequantize_per_channel,
+)
+
+
+def _qkv(B=2, S=128, H=4, hd=64, nkv=None, seed=0, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    nkv = nkv or H
+    return (
+        jnp.asarray(rs.randn(B, S, H, hd).astype(dtype)),
+        jnp.asarray(rs.randn(B, S, nkv, hd).astype(dtype)),
+        jnp.asarray(rs.randn(B, S, nkv, hd).astype(dtype)),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_parity(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(H=8, nkv=2)
+        out = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = mha_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_gradients(self):
+        q, k, v = _qkv(S=64)
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, block_q=32, block_k=32) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v) ** 2)
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+    def test_gqa_gradients(self):
+        q, k, v = _qkv(S=64, H=4, nkv=2)
+        gf = jax.grad(lambda *a: jnp.sum(flash_attention(*a, block_q=32, block_k=32) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: jnp.sum(mha_reference(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+    def test_transformer_pallas_attn_matches_xla(self):
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        base = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4, max_seq_len=32)
+        pal = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4, max_seq_len=32,
+                                attn_impl="pallas")
+        m0, m1 = TransformerModel(base), TransformerModel(pal)
+        params = m0.init(jax.random.PRNGKey(0))
+        tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 32)).astype(np.int32))
+        l0, l1 = m0.loss(params, {"input_ids": tokens}), m1.loss(params, {"input_ids": tokens})
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
+
+
+class TestFusedNorm:
+    def test_layernorm_parity(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(4, 16, 128).astype(np.float32))
+        scale = jnp.asarray(rs.randn(128).astype(np.float32))
+        bias = jnp.asarray(rs.randn(128).astype(np.float32))
+        out = fused_layernorm(x, scale, bias)
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        ref = (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_rmsnorm_parity(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(64, 256).astype(np.float32))
+        scale = jnp.asarray(rs.randn(256).astype(np.float32))
+        out = fused_rmsnorm(x, scale)
+        ref = x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-5) * scale
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_layernorm_gradients(self):
+        rs = np.random.RandomState(2)
+        x = jnp.asarray(rs.randn(32, 128).astype(np.float32))
+        scale = jnp.asarray(1.0 + 0.1 * rs.randn(128).astype(np.float32))
+        bias = jnp.asarray(0.1 * rs.randn(128).astype(np.float32))
+
+        def f_fused(x, s, b):
+            return jnp.sum(fused_layernorm(x, s, b) ** 2)
+
+        def f_ref(x, s, b):
+            mu = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            return jnp.sum(((x - mu) * jax.lax.rsqrt(var + 1e-5) * s + b) ** 2)
+
+        gf = jax.grad(f_fused, argnums=(0, 1, 2))(x, scale, bias)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(x, scale, bias)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+    def test_rmsnorm_gradients(self):
+        rs = np.random.RandomState(3)
+        x = jnp.asarray(rs.randn(16, 128).astype(np.float32))
+        scale = jnp.asarray(1.0 + 0.1 * rs.randn(128).astype(np.float32))
+        gf = jax.grad(lambda x, s: jnp.sum(fused_rmsnorm(x, s) ** 2), argnums=(0, 1))(x, scale)
+        gr = jax.grad(
+            lambda x, s: jnp.sum((x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-5) * s) ** 2),
+            argnums=(0, 1),
+        )(x, scale)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+
+class TestQuantizer:
+    def test_symmetric_roundtrip(self):
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(4, 256).astype(np.float32))
+        q, scale, zp = quantize(x, num_bits=8, num_groups=4, symmetric=True)
+        assert q.dtype == jnp.int8 and zp is None
+        back = dequantize(q, scale, num_groups=4, out_shape=x.shape)
+        err = np.abs(np.asarray(back - x))
+        assert err.max() < np.abs(np.asarray(x)).max() / 127 * 1.01
+
+    def test_asymmetric_roundtrip(self):
+        rs = np.random.RandomState(1)
+        x = jnp.asarray((rs.rand(8, 128) * 5 + 3).astype(np.float32))  # shifted range
+        q, scale, zp = quantize(x, num_bits=8, num_groups=8, symmetric=False)
+        back = dequantize(q, scale, zp, num_groups=8, out_shape=x.shape)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=float(scale.max()) * 1.01)
+
+    def test_stochastic_rounding_unbiased(self):
+        x = jnp.full((1, 1024), 0.5004, jnp.float32) * 127 / 127  # between grid points
+        keys = jax.random.split(jax.random.PRNGKey(0), 64)
+        vals = []
+        for k in keys:
+            q, scale, _ = quantize(x, num_bits=8, num_groups=1, stochastic=True, rng=k)
+            vals.append(float(dequantize(q, scale, num_groups=1).mean()))
+        assert abs(np.mean(vals) - 0.5004) < 2e-3
+
+    def test_fake_quantize_straight_through(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 64).astype(np.float32))
+        g = jax.grad(lambda x: jnp.sum(fake_quantize(x, num_bits=4, num_groups=4) * 2.0))(x)
+        np.testing.assert_allclose(np.asarray(g), 2.0 * np.ones_like(g), rtol=1e-6)
+
+    def test_per_channel(self):
+        rs = np.random.RandomState(2)
+        w = jnp.asarray(rs.randn(64, 32).astype(np.float32))
+        q, scale = quantize_per_channel(w, axis=0)
+        back = dequantize_per_channel(q, scale, dtype=jnp.float32)
+        rel = np.abs(np.asarray(back - w)).max() / np.abs(np.asarray(w)).max()
+        assert rel < 0.02
