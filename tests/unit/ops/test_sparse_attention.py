@@ -1,0 +1,103 @@
+"""Block-sparse attention tests (reference: tests/unit/ops/sparse_attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+    SparseSelfAttention,
+    block_sparse_attention,
+    sparse_attention_reference,
+)
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+)
+
+
+def _qkv(B=1, S=128, H=2, hd=32, seed=0):
+    rs = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rs.randn(B, S, H, hd).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+BLOCK = 32
+
+
+class TestSparsityConfigs:
+    def test_dense(self):
+        layout = DenseSparsityConfig(num_heads=2, block=BLOCK).make_layout(128)
+        assert layout.shape == (2, 4, 4) and layout.sum() == 32
+
+    def test_fixed_causal(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2, attention="unidirectional")
+        layout = cfg.make_layout(256)
+        assert np.all(np.triu(layout[0], 1) == 0)  # strictly causal
+        assert np.all(np.diagonal(layout[0]) == 1)  # self blocks live
+
+    def test_bigbird_has_window_and_globals(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=BLOCK, num_sliding_window_blocks=3,
+                                    num_random_blocks=1, num_global_blocks=1)
+        layout = cfg.make_layout(256)
+        nb = 256 // BLOCK
+        for i in range(nb):
+            assert layout[0, i, i] == 1
+        assert np.all(layout[0, 0, :] == 1) and np.all(layout[0, :, 0] == 1)
+
+    def test_longformer(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=BLOCK, num_sliding_window_blocks=3,
+                                         global_block_indices=[0])
+        layout = cfg.make_layout(256)
+        assert np.all(layout[0, :, 0] == 1) and np.all(layout[0, 0, :] == 1)
+
+    def test_variable(self):
+        cfg = VariableSparsityConfig(num_heads=1, block=BLOCK, local_window_blocks=[1, 2])
+        layout = cfg.make_layout(256)
+        assert layout[0, 0, 0] == 1 and layout[0, 1, 2] == 1 and layout[0, 2, 1] == 1
+
+
+class TestBlockSparseAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_masked_dense(self, causal):
+        q, k, v = _qkv()
+        cfg = BigBirdSparsityConfig(num_heads=2, block=BLOCK, num_sliding_window_blocks=3,
+                                    num_random_blocks=1)
+        layout = cfg.make_layout(128)
+        out = block_sparse_attention(q, k, v, layout, causal=causal, block=BLOCK)
+        ref = sparse_attention_reference(q, k, v, layout, BLOCK, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_dense_layout_equals_full_attention(self):
+        from deepspeed_tpu.ops.pallas.flash_attention import mha_reference
+
+        q, k, v = _qkv()
+        layout = DenseSparsityConfig(num_heads=2, block=BLOCK).make_layout(128)
+        out = block_sparse_attention(q, k, v, layout, causal=True, block=BLOCK)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_gradients(self):
+        q, k, v = _qkv(S=64)
+        cfg = FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2)
+        layout = cfg.make_layout(64)
+
+        def f_sparse(q, k, v):
+            return jnp.sum(block_sparse_attention(q, k, v, layout, block=BLOCK) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(sparse_attention_reference(q, k, v, layout, BLOCK) ** 2)
+
+        gs = jax.grad(f_sparse, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gs, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+    def test_sparse_self_attention_wrapper(self):
+        q, k, v = _qkv()
+        attn = SparseSelfAttention(BSLongformerSparsityConfig(num_heads=2, block=BLOCK), causal=True)
+        out = attn(q, k, v)
+        assert out.shape == q.shape
