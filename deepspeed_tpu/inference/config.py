@@ -1,0 +1,75 @@
+"""Inference config (reference: ``deepspeed/inference/config.py``
+DeepSpeedInferenceConfig — dtype, tensor_parallel, moe, quant,
+replace_with_kernel_inject, max_out_tokens)."""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.runtime.config_utils import from_dict
+
+
+@dataclass
+class QuantConfig:
+    enabled: bool = False
+    num_bits: int = 8
+
+
+@dataclass
+class TensorParallelConfig:
+    tp_size: int = 1
+    enabled: bool = True
+
+
+@dataclass
+class MoEInferenceConfig:
+    enabled: bool = False
+    ep_size: int = 1
+
+
+@dataclass
+class InferenceConfig:
+    dtype: str = "bfloat16"  # float32 | float16 | bfloat16 | int8 (weight quant)
+    tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
+    moe: MoEInferenceConfig = field(default_factory=MoEInferenceConfig)
+    quant: QuantConfig = field(default_factory=QuantConfig)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 1024  # alias accepted from reference configs
+    replace_with_kernel_inject: bool = False  # TPU: kernels come from XLA/Pallas
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False  # no-op: XLA compiles whole programs
+    profile_model_time: bool = False
+    mesh: Optional[Dict[str, int]] = None
+
+    @classmethod
+    def parse(cls, config) -> "InferenceConfig":
+        if isinstance(config, cls):
+            return config
+        config = dict(config or {})
+        # reference compat: mp_size / tensor_parallel.tp_size
+        if "mp_size" in config:
+            config.setdefault("tensor_parallel", {})
+            if isinstance(config["tensor_parallel"], dict):
+                config["tensor_parallel"].setdefault("tp_size", config.pop("mp_size"))
+            else:
+                config.pop("mp_size")
+        tp = config.get("tensor_parallel", {})
+        moe = config.get("moe", {})
+        if isinstance(moe, bool):
+            moe = {"enabled": moe}
+        quant = config.get("quant", {})
+        if isinstance(quant, bool):
+            quant = {"enabled": quant}
+        dtype = config.get("dtype", "bfloat16")
+        if not isinstance(dtype, str):
+            dtype = {"torch.float32": "float32", "torch.float16": "float16",
+                     "torch.bfloat16": "bfloat16", "torch.int8": "int8"}.get(str(dtype), "bfloat16")
+        known = {f for f in cls.__dataclass_fields__}
+        base = {k: v for k, v in config.items() if k in known and k not in ("tensor_parallel", "moe", "quant", "dtype")}
+        return cls(
+            dtype=dtype,
+            tensor_parallel=from_dict(TensorParallelConfig, tp if isinstance(tp, dict) else {}),
+            moe=from_dict(MoEInferenceConfig, moe),
+            quant=from_dict(QuantConfig, quant),
+            **base,
+        )

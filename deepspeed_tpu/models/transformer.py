@@ -442,6 +442,131 @@ def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
     return forward(params, cfg, tokens, dropout_rng=dropout_rng)[0]
 
 
+# ---------------------------------------------------------------------------
+# KV-cache decode path (reference: csrc/transformer/inference softmax_context
+# kernels + InferenceEngine token loop, inference/engine.py:560)
+# ---------------------------------------------------------------------------
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] = None):
+    """Per-layer KV cache: (L, B, T, kv_heads, head_dim) in model dtype."""
+    T = max_len or cfg.max_seq_len
+    shape = (cfg.num_layers, batch_size, T, cfg.kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.jnp_dtype),
+        "v": jnp.zeros(shape, cfg.jnp_dtype),
+    }
+
+
+def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos):
+    """One decoder layer over a segment of S new tokens with KV cache.
+
+    x: (B, S, D); k_cache/v_cache: (B, T, nkv, hd) for THIS layer; pos: scalar
+    count of tokens already cached. Returns (x, new_k_cache, new_v_cache).
+    """
+    attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
+    ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
+    B, S, D = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    T = k_cache.shape[1]
+
+    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg)
+    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
+    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
+    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
+    if cfg.use_bias:
+        q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+
+    kk, vv = k_cache, v_cache
+    if nkv != nh:
+        kk = jnp.repeat(kk, nh // nkv, axis=2)
+        vv = jnp.repeat(vv, nh // nkv, axis=2)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale  # (B,nh,S,T)
+    kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
+    qpos = positions[0][:, None]  # (S, 1): absolute positions of new tokens
+    mask = kpos <= qpos  # attend to everything written up to and incl. self
+    logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+    attn_out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(B, S, nh * hd)
+    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
+    if cfg.use_bias:
+        attn_out = attn_out + attn_p["bo"]
+    x = x + attn_out
+
+    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
+    if cfg.moe_num_experts > 0:
+        from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+        def expert_fn(ep, t):
+            if cfg.activation == "silu_glu":
+                a = jax.nn.silu(t @ ep["wg"]) * (t @ ep["wi"])
+            else:
+                a = t @ ep["wi"]
+                if cfg.use_bias:
+                    a = a + ep["bi"]
+                a = jax.nn.gelu(a)
+            out = a @ ep["wo"]
+            if cfg.use_bias:
+                out = out + ep["bo"]
+            return out
+
+        expert_params = {kk2: v2 for kk2, v2 in mlp_p.items() if kk2 != "gate"}
+        mlp_out, _, _ = moe_forward(
+            h, mlp_p["gate"], expert_fn, expert_params, k=cfg.moe_top_k,
+            capacity_factor=cfg.moe_capacity_factor * 2, min_capacity=cfg.moe_min_capacity,
+            drop_tokens=cfg.moe_drop_tokens,
+        )
+    elif cfg.activation == "silu_glu":
+        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
+        mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, mlp_p["wo"])
+    else:
+        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        if cfg.use_bias:
+            act = act + mlp_p["bi"]
+        mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.gelu(act), mlp_p["wo"])
+        if cfg.use_bias:
+            mlp_out = mlp_out + mlp_p["bo"]
+    return x + mlp_out, k_cache, v_cache
+
+
+def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
+    """Segment forward with KV cache (prefill: S = prompt len, pos = 0;
+    decode: S = 1). Returns (logits (B,S,V), updated cache)."""
+    dtype = cfg.jnp_dtype
+    B, S = tokens.shape
+    x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
+    positions = pos + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if cfg.pos_embedding == "learned":
+        pos_table = params["embed"]["pos"].astype(dtype)
+        x = x + jnp.take(pos_table, jnp.minimum(positions[0], pos_table.shape[0] - 1), axis=0)
+
+    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+
+    def body(carry, inp):
+        h = carry
+        layer_p, k_c, v_c = inp
+        h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos)
+        return h, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+    return logits, {"k": new_k, "v": new_v}
+
+
 def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
     """Next-token cross entropy. batch: {'input_ids': (B,S) int32} and
     optional 'labels' (shifted internally if absent) and 'loss_mask'."""
