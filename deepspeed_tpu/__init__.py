@@ -15,13 +15,6 @@ from deepspeed_tpu.runtime.engine import TpuEngine, DeepSpeedEngine
 from deepspeed_tpu.utils.logging import logger, log_dist
 
 
-def init_inference(model, config=None, **kwargs):
-    """Create an InferenceEngine (reference: deepspeed/__init__.py:251)."""
-    from deepspeed_tpu.inference.engine import init_inference as _init
-
-    return _init(model, config=config, **kwargs)
-
-
 def initialize(
     args=None,
     model=None,

@@ -46,6 +46,9 @@ class InferenceConfig:
         if isinstance(config, cls):
             return config
         config = dict(config or {})
+        # reference compat: max_tokens is the old name for max_out_tokens
+        if "max_tokens" in config and "max_out_tokens" not in config:
+            config["max_out_tokens"] = config["max_tokens"]
         # reference compat: mp_size / tensor_parallel.tp_size
         if "mp_size" in config:
             config.setdefault("tensor_parallel", {})

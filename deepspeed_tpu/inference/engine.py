@@ -38,27 +38,33 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 class InferenceEngine:
     def __init__(self, model, config=None, params=None, mesh=None, seed: int = 0):
         self.config = InferenceConfig.parse(config)
-        if isinstance(model, tf.TransformerModel):
-            self.model = model
-        elif isinstance(model, tf.TransformerConfig):
-            self.model = tf.TransformerModel(model)
-        else:
-            self.model = model  # any object with cfg/init/apply protocol
+        builtin = isinstance(model, (tf.TransformerModel, tf.TransformerConfig))
+        if isinstance(model, tf.TransformerConfig):
+            model = tf.TransformerModel(model)
+        self.model = model  # builtin or any object with cfg/init/apply protocol
         cfg = self.model.cfg
 
         dtype_name = self.config.dtype
         self._weight_quant = dtype_name == "int8" or self.config.quant.enabled
+        want_dtype = None
         if dtype_name in ("float32", "float16", "bfloat16") and dtype_name != cfg.dtype:
-            import dataclasses
-
-            cfg = dataclasses.replace(cfg, dtype=dtype_name)
-            self.model = tf.TransformerModel(cfg)
+            want_dtype = dtype_name
         elif self._weight_quant and cfg.dtype == "float32":
+            want_dtype = "bfloat16"
+        if want_dtype is not None:
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, dtype="bfloat16")
-            self.model = tf.TransformerModel(cfg)
-        self.cfg = cfg
+            cfg = dataclasses.replace(cfg, dtype=want_dtype)
+            if builtin:
+                self.model = tf.TransformerModel(cfg)
+            else:
+                # custom model object: keep it (its apply defines the network);
+                # only the cast of loaded params below changes
+                logger.warning(
+                    f"config dtype {want_dtype} != model cfg dtype {self.model.cfg.dtype}; "
+                    "casting params, keeping the custom model's forward"
+                )
+        self.cfg = cfg if builtin else self.model.cfg
 
         # mesh: inference default is pure tensor-parallel over available chips
         if mesh is None:
@@ -90,6 +96,7 @@ class InferenceEngine:
 
         self._prefill_fn = None
         self._decode_fn = None
+        self._forward_fn = None
         self._model_times = []
         log_dist(
             f"InferenceEngine ready: dtype={cfg.dtype} quant={self._weight_quant} "
@@ -109,7 +116,7 @@ class InferenceEngine:
             names = [getattr(x, "key", "") for x in path]
             if p.ndim >= 2 and any(n in ("attn", "mlp", "lm_head") for n in names):
                 groups = max(1, p.shape[-1] // 128) if p.size % max(1, p.shape[-1] // 128) == 0 else 1
-                return fake_quantize(p, num_bits=nbits, num_groups=1)
+                return fake_quantize(p, num_bits=nbits, num_groups=groups)
             return p
 
         return jax.tree_util.tree_map_with_path(q, params)
@@ -160,7 +167,10 @@ class InferenceEngine:
         """Full-sequence logits (HF-pipeline parity surface)."""
         t0 = time.time()
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
-        logits = jax.jit(lambda p, t: tf.apply(p, self.cfg, t))(self.params, tokens)
+        if self._forward_fn is None:
+            cfg = self.cfg
+            self._forward_fn = jax.jit(lambda p, t: tf.apply(p, cfg, t))
+        logits = self._forward_fn(self.params, tokens)
         if self.config.profile_model_time:
             jax.block_until_ready(logits)
             self._model_times.append(time.time() - t0)
@@ -186,8 +196,12 @@ class InferenceEngine:
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = tokens.shape
         total = S + max_new_tokens
-        max_len = self.cfg.max_seq_len
-        assert total <= max_len, f"prompt {S} + {max_new_tokens} new > max_seq_len {max_len}"
+        assert total <= self.cfg.max_seq_len, (
+            f"prompt {S} + {max_new_tokens} new > max_seq_len {self.cfg.max_seq_len}"
+        )
+        # KV-cache allocation bounded by max_out_tokens (reference
+        # inference/config.py max_out_tokens), grown only if the request needs it
+        max_len = max(total, min(self.cfg.max_seq_len, self.config.max_out_tokens))
         self._ensure_compiled(B, max_len)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -230,7 +244,7 @@ class InferenceEngine:
 
     @staticmethod
     def _truncate_eos(tokens, prompt_len, eos_id):
-        arr = np.asarray(tokens)
+        arr = np.array(tokens)  # copy: np.asarray on a jax.Array is read-only
         for b in range(arr.shape[0]):
             hits = np.where(arr[b, prompt_len:] == eos_id)[0]
             if hits.size:

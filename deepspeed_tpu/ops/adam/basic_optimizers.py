@@ -7,6 +7,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
 
 class SGDState(NamedTuple):
     momentum_buf: Any
@@ -31,11 +33,11 @@ class SGD:
                 g = g + self.weight_decay * p.astype(jnp.float32)
             buf_new = self.momentum * buf + g
             d = g + self.momentum * buf_new if self.nesterov else buf_new
-            return -lr * d, buf_new
+            return LeafTuple((-lr * d, buf_new))
 
         out = jax.tree.map(leaf, grads, state.momentum_buf, params)
-        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), SGDState(momentum_buf=pick(1))
+        upd, buf = unpack_leaves(out, 2)
+        return upd, SGDState(momentum_buf=buf)
 
 
 class AdagradState(NamedTuple):
@@ -63,11 +65,11 @@ class Adagrad:
             if self.weight_decay > 0.0:
                 g = g + self.weight_decay * p.astype(jnp.float32)
             s_new = s + g * g
-            return -lr * g / (jnp.sqrt(s_new) + self.eps), s_new
+            return LeafTuple((-lr * g / (jnp.sqrt(s_new) + self.eps), s_new))
 
         out = jax.tree.map(leaf, grads, state.sum_sq, params)
-        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), AdagradState(step=state.step + 1, sum_sq=pick(1))
+        upd, ssq = unpack_leaves(out, 2)
+        return upd, AdagradState(step=state.step + 1, sum_sq=ssq)
 
 
 class LionState(NamedTuple):
@@ -93,8 +95,8 @@ class Lion:
             if self.weight_decay > 0.0:
                 upd = upd - lr * self.weight_decay * p.astype(jnp.float32)
             m_new = b2 * m + (1.0 - b2) * g
-            return upd, m_new
+            return LeafTuple((upd, m_new))
 
         out = jax.tree.map(leaf, grads, state.exp_avg, params)
-        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), LionState(exp_avg=pick(1))
+        upd, m = unpack_leaves(out, 2)
+        return upd, LionState(exp_avg=m)

@@ -14,6 +14,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
 
 class AdamState(NamedTuple):
     step: jnp.ndarray  # i32 scalar
@@ -60,12 +62,10 @@ class FusedAdam:
             upd = -lr * (m_new / bc1) / denom
             if self.adam_w_mode and self.weight_decay > 0.0:
                 upd = upd - lr * self.weight_decay * p.astype(jnp.float32)
-            return upd, m_new, v_new
+            return LeafTuple((upd, m_new, v_new))
 
         out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
-        updates = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
-        exp_avg = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
-        exp_avg_sq = jax.tree.map(lambda o: o[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        updates, exp_avg, exp_avg_sq = unpack_leaves(out, 3)
         return updates, AdamState(step=step, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq)
 
 

@@ -9,6 +9,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
 
 class LambState(NamedTuple):
     step: jnp.ndarray
@@ -52,8 +54,8 @@ class FusedLamb:
                 jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
                 1.0,
             )
-            return -lr * trust * adam_step, m_new, v_new
+            return LeafTuple((-lr * trust * adam_step, m_new, v_new))
 
         out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, params)
-        pick = lambda i: jax.tree.map(lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple))
-        return pick(0), LambState(step=step, exp_avg=pick(1), exp_avg_sq=pick(2))
+        upd, m, v = unpack_leaves(out, 3)
+        return upd, LambState(step=step, exp_avg=m, exp_avg_sq=v)

@@ -1,0 +1,23 @@
+"""Specialized communication paths (reference: deepspeed/runtime/comm/).
+
+The reference keeps NCCL/MPI compressed-allreduce backends here
+(runtime/comm/nccl.py:15, mpi.py) plus coalesced collectives
+(coalesced_collectives.py:28). TPU-native: coalescing is XLA's job (GSPMD
+fuses/schedules collectives); what remains worth building is the
+*compressed* path — error-feedback sign-scale collectives with an int8 wire
+format — in compressed.py.
+"""
+
+from deepspeed_tpu.runtime.comm.compressed import (
+    CompressionState,
+    compressed_allreduce,
+    init_compression_state,
+    quantize_signscale,
+)
+
+__all__ = [
+    "CompressionState",
+    "compressed_allreduce",
+    "init_compression_state",
+    "quantize_signscale",
+]

@@ -1,0 +1,105 @@
+"""Error-feedback compressed collectives (1-bit Adam's communication core).
+
+TPU-native counterpart of the reference's ``NcclBackend.compressed_allreduce``
+(runtime/comm/nccl.py:15) / ``MpiBackend`` (runtime/comm/mpi.py): the two-phase
+compressed allreduce —
+
+  phase 1 (reduce-scatter of compressed chunks): every member compresses its
+    error-compensated tensor into sign bits + one scale per destination chunk,
+    then an ``all_to_all`` delivers to member *k* every member's copy of chunk
+    *k*; the receiver decompresses and sums ("server" role for its chunk).
+  phase 2 (allgather of re-compressed result): the summed chunk is compressed
+    again with a *server* error-feedback buffer and ``all_gather``-ed back.
+
+Where the reference packs bits with cupy and moves them over NCCL p2p
+(nccl.py) or mpi4py, here the wire format is an int8 sign tensor + f32 scales
+moved by XLA collectives over ICI — 4x smaller than f32 on the wire (int8 is
+the natural compressed element type on TPU; sub-byte packing would burn VPU
+cycles to save ICI bytes that int8 already makes a non-bottleneck).
+
+These functions are written for use inside ``shard_map`` where ``axis_name``
+is bound (the engine's grad path is GSPMD-scheduled, so 1-bit optimizers use
+the deterministic single-program quantization in fp16/onebit/ — same numerics;
+this module is the explicit-collective path for shard_map training loops).
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CompressionState(NamedTuple):
+    """Per-tensor error-feedback buffers (flattened, padded)."""
+
+    worker_error: jnp.ndarray  # [padded]
+    server_error: jnp.ndarray  # [padded // world]
+
+
+def _padded_size(n: int, world: int) -> int:
+    return int(-(-n // world) * world)
+
+
+def init_compression_state(shape, world: int, dtype=jnp.float32) -> CompressionState:
+    n = int(np.prod(shape or (1,)))
+    padded = _padded_size(n, world)
+    return CompressionState(
+        worker_error=jnp.zeros((padded,), dtype),
+        server_error=jnp.zeros((padded // world,), dtype),
+    )
+
+
+def quantize_signscale(x: jnp.ndarray, error: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Error-compensated sign/scale quantization of a 1-D tensor.
+
+    Returns (signs int8, scale f32 scalar, new_error). The scale is the mean
+    magnitude of the compensated tensor, which makes ``scale * sign`` the
+    l1-optimal 1-bit approximation (reference nccl.py compensated buffers).
+    """
+    comp = x + error
+    scale = jnp.mean(jnp.abs(comp))
+    signs = jnp.where(comp >= 0, 1, -1).astype(jnp.int8)
+    new_error = comp - scale * signs.astype(comp.dtype)
+    return signs, scale, new_error
+
+
+def compressed_allreduce(
+    x: jnp.ndarray,
+    state: CompressionState,
+    axis_name: str,
+) -> Tuple[jnp.ndarray, CompressionState]:
+    """Two-phase error-feedback compressed allreduce (SUM) over ``axis_name``.
+
+    Call inside ``shard_map``. ``x`` may be any shape; error buffers must come
+    from ``init_compression_state(x.shape, world)``. Returns the *sum* over
+    the axis (divide by the axis size for averaging, as OnebitAdam does with
+    momentum — reference onebit/adam.py).
+    """
+    world = jax.lax.psum(1, axis_name)  # static under jit (mesh axis size)
+    orig_shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    padded = state.worker_error.shape[0]
+    flat = jnp.pad(flat, (0, padded - flat.shape[0]))
+
+    # -- phase 1: worker-side compress, one scale per destination chunk
+    chunks = (flat + state.worker_error).reshape(world, padded // world)
+    scales = jnp.mean(jnp.abs(chunks), axis=1)  # [W]
+    signs = jnp.where(chunks >= 0, 1, -1).astype(jnp.int8)  # [W, C]
+    new_worker_error = (chunks - scales[:, None] * signs.astype(jnp.float32)).reshape(padded)
+
+    # wire: int8 signs + f32 scales, scattered so member k receives chunk k
+    recv_signs = jax.lax.all_to_all(signs, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_scales = jax.lax.all_to_all(scales[:, None], axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_signs = recv_signs.reshape(world, padded // world)
+    recv_scales = recv_scales.reshape(world)
+    chunk_sum = jnp.sum(recv_signs.astype(jnp.float32) * recv_scales[:, None], axis=0)  # [C]
+
+    # -- phase 2: server-side compress of the summed chunk, then allgather
+    srv_signs, srv_scale, new_server_error = quantize_signscale(chunk_sum, state.server_error)
+    all_signs = jax.lax.all_gather(srv_signs, axis_name, axis=0, tiled=True)  # [P] int8
+    all_scales = jax.lax.all_gather(srv_scale[None], axis_name, axis=0, tiled=True)  # [W]
+    result = all_signs.astype(jnp.float32).reshape(world, padded // world) * all_scales[:, None]
+    result = result.reshape(padded)[: int(np.prod(orig_shape or (1,)))].reshape(orig_shape)
+
+    return result, CompressionState(worker_error=new_worker_error, server_error=new_server_error)

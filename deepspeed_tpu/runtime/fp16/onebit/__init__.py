@@ -1,13 +1,18 @@
-"""1-bit (compressed-communication) optimizers — implemented in
-onebit/adam.py etc. (reference: runtime/fp16/onebit/)."""
+"""1-bit (compressed-communication) optimizers (reference: runtime/fp16/onebit/)."""
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
+from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneAdam
 
 
 def build_onebit_optimizer(name: str, params: dict):
-    from deepspeed_tpu.runtime.fp16.onebit.adam import OnebitAdam
-    from deepspeed_tpu.runtime.fp16.onebit.lamb import OnebitLamb
+    registry = {"onebitadam": OnebitAdam, "onebitlamb": OnebitLamb, "zerooneadam": ZeroOneAdam}
+    cls = registry.get(name)
+    if cls is None:
+        raise ValueError(f"unknown 1-bit optimizer '{name}'; supported: {sorted(registry)}")
+    if "betas" in params:
+        params = dict(params, betas=tuple(params["betas"]))
+    return cls(**params)
 
-    if name == "onebitadam" or name == "zerooneadam":
-        return OnebitAdam(**params)
-    if name == "onebitlamb":
-        return OnebitLamb(**params)
-    raise ValueError(name)
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam", "build_onebit_optimizer"]

@@ -1,0 +1,87 @@
+"""1-bit LAMB.
+
+TPU-native counterpart of the reference's ``OnebitLamb``
+(runtime/fp16/onebit/lamb.py): LAMB with layerwise trust ratios during the
+``freeze_step`` warmup; afterwards momentum is 1-bit quantized with error
+feedback and the per-layer *scaling coefficients are frozen* at their warmup
+values (the reference keeps a ``scaling_coeff`` per parameter and stops
+recomputing it after compression starts, bounding the drift the lossy
+momentum could cause in the trust ratio).
+"""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import _quantize_ef
+
+
+class OnebitLambState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    error: Any
+    scaling_coeff: Any  # frozen per-leaf trust ratio (0 until freeze)
+
+
+@dataclass(frozen=True)
+class OnebitLamb:
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    cuda_aware: bool = False
+    comm_backend_name: str = "xla"
+
+    def init(self, params) -> OnebitLambState:
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        coeff = jax.tree.map(lambda p: jnp.ones((), jnp.float32), params)
+        return OnebitLambState(
+            step=jnp.zeros((), jnp.int32), exp_avg=z(), exp_avg_sq=z(), error=z(), scaling_coeff=coeff
+        )
+
+    def update(self, grads, state: OnebitLambState, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+
+        def leaf(g, m, v, e, coeff, p):
+            g = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * g * g)
+            m_q, e_new = _quantize_ef(m_new, e)
+            m_used = jnp.where(frozen, m_q, m_new)
+            e_out = jnp.where(frozen, e_new, e)
+
+            u = m_used / (jnp.sqrt(v_new) + self.eps)
+            if self.weight_decay > 0.0:
+                u = u + self.weight_decay * pf
+            w_norm = jnp.linalg.norm(pf)
+            u_norm = jnp.linalg.norm(u)
+            live_ratio = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                jnp.float32(1.0),
+            )
+            # freeze the coefficient at its last warmup value
+            ratio = jnp.where(frozen, coeff, live_ratio)
+            new_coeff = jnp.where(frozen, coeff, live_ratio)
+            upd = -lr * ratio * u
+            return LeafTuple((upd, m_used, v_new, e_out, new_coeff))
+
+        out = jax.tree.map(
+            leaf, grads, state.exp_avg, state.exp_avg_sq, state.error, state.scaling_coeff, params
+        )
+        upd, m, v, e, coeff = unpack_leaves(out, 5)
+        return upd, OnebitLambState(
+            step=step, exp_avg=m, exp_avg_sq=v, error=e, scaling_coeff=coeff
+        )

@@ -1,0 +1,94 @@
+"""0/1 Adam.
+
+TPU-native counterpart of the reference's ``ZeroOneAdam``
+(runtime/fp16/onebit/zoadam.py): instead of a hard warmup/compression split,
+variance updates happen on an exponentially-stretching schedule
+(``var_update_scaler``) until ``var_freeze_step``, after which the variance is
+frozen for good; momentum communication is 1-bit-compressed from the start
+(the "0" in 0/1: learning-rate-freeze intervals allow skipping communication
+entirely on local steps — here the quantizer runs every step, which on TPU is
+free relative to the collective it stands in for).
+"""
+
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
+from deepspeed_tpu.runtime.fp16.onebit.adam import _quantize_ef
+
+
+class ZeroOneAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: Any
+    exp_avg_sq: Any
+    error: Any
+    next_var_update: jnp.ndarray  # i32: next step at which variance updates
+    var_interval: jnp.ndarray  # i32: current interval (doubles each update)
+    var_updates_done: jnp.ndarray  # i32: firings so far (drives the doubling)
+
+
+@dataclass(frozen=True)
+class ZeroOneAdam:
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    var_freeze_step: int = 100000
+    var_update_scaler: int = 16
+    local_step_scaler: int = 32678
+    local_step_clipper: int = 16
+    cuda_aware: bool = False
+    comm_backend_name: str = "xla"
+
+    def init(self, params) -> ZeroOneAdamState:
+        z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return ZeroOneAdamState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=z(),
+            exp_avg_sq=z(),
+            error=z(),
+            next_var_update=jnp.ones((), jnp.int32),
+            var_interval=jnp.ones((), jnp.int32),
+            var_updates_done=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, grads, state: ZeroOneAdamState, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = state.step + 1
+        # variance update gate: fires when step reaches the scheduled point and
+        # we're before the hard freeze (reference zoadam.py variance schedule)
+        do_var = (step >= state.next_var_update) & (step <= self.var_freeze_step)
+        # interval doubles every var_update_scaler firings (explicit counter:
+        # a step-modulo test would stop firing once steps drift off the
+        # interval grid and freeze the stretch)
+        new_done = jnp.where(do_var, state.var_updates_done + 1, state.var_updates_done)
+        grew = do_var & (new_done % self.var_update_scaler == 0)
+        new_interval = jnp.where(grew, state.var_interval * 2, state.var_interval)
+        new_next = jnp.where(do_var, step + new_interval, state.next_var_update)
+
+        def leaf(g, m, v, e, p):
+            g = g.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = jnp.where(do_var, b2 * v + (1.0 - b2) * g * g, v)
+            m_q, e_new = _quantize_ef(m_new, e)
+            upd = -lr * m_q / (jnp.sqrt(v_new) + self.eps)
+            return LeafTuple((upd, m_q, v_new, e_new))
+
+        out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, state.error, params)
+        upd, m, v, e = unpack_leaves(out, 4)
+        return upd, ZeroOneAdamState(
+            step=step,
+            exp_avg=m,
+            exp_avg_sq=v,
+            error=e,
+            next_var_update=new_next,
+            var_interval=new_interval,
+            var_updates_done=new_done,
+        )

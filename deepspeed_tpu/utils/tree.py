@@ -1,0 +1,20 @@
+"""Pytree helpers shared by the functional optimizers."""
+
+import jax
+
+
+class LeafTuple(tuple):
+    """Marker for a per-leaf multi-output bundle.
+
+    Optimizer `update` fns map a leaf -> (update, new_m, new_v, ...) over the
+    param pytree; unpacking the result needs an ``is_leaf`` predicate that
+    stops at these bundles but NOT at tuples the user's param tree may itself
+    contain (a bare ``isinstance(x, tuple)`` check misfires on tuple/NamedTuple
+    param containers). A dedicated subclass makes the predicate unambiguous.
+    """
+
+
+def unpack_leaves(out, n: int):
+    """Split a pytree of LeafTuple bundles into n parallel pytrees."""
+    is_leaf = lambda x: isinstance(x, LeafTuple)
+    return tuple(jax.tree.map(lambda o: o[i], out, is_leaf=is_leaf) for i in range(n))
