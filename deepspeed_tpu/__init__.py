@@ -79,16 +79,19 @@ def _is_pipeline_model(model) -> bool:
     return isinstance(model, PipelineModule)
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Create an inference engine (reference: deepspeed/__init__.py:251)."""
-    from deepspeed_tpu.inference.engine import InferenceEngine
-    from deepspeed_tpu.inference.config import TpuInferenceConfig
+def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
+    """Create an inference engine (reference: deepspeed/__init__.py:251).
 
-    if isinstance(config, dict) or config is None:
+    ``kwargs`` are reference-style config fields (mp_size=, dtype=, ...)
+    merged into ``config``; ``params``/``mesh`` pass through to the engine.
+    """
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    if kwargs:
         merged = dict(config or {})
         merged.update(kwargs)
-        config = TpuInferenceConfig.from_dict(merged)
-    return InferenceEngine(model, config)
+        config = merged
+    return InferenceEngine(model, config=config, params=params, mesh=mesh)
 
 
 def add_config_arguments(parser):

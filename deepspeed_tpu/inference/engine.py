@@ -59,12 +59,12 @@ class InferenceEngine:
                 self.model = tf.TransformerModel(cfg)
             else:
                 # custom model object: keep it (its apply defines the network);
-                # only the cast of loaded params below changes
+                # cfg carries the override so caches/compute use the new dtype
                 logger.warning(
                     f"config dtype {want_dtype} != model cfg dtype {self.model.cfg.dtype}; "
                     "casting params, keeping the custom model's forward"
                 )
-        self.cfg = cfg if builtin else self.model.cfg
+        self.cfg = cfg
 
         # mesh: inference default is pure tensor-parallel over available chips
         if mesh is None:
@@ -91,7 +91,9 @@ class InferenceEngine:
             params = self._quantize_weights(params)
         # cast to model dtype (fp32 master irrelevant at inference)
         dt = cfg.jnp_dtype
-        params = jax.tree.map(lambda p: p.astype(dt) if p.dtype == jnp.float32 else p, params)
+        params = jax.tree.map(
+            lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+        )
         self.params = params
 
         self._prefill_fn = None

@@ -30,7 +30,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.ad_checkpoint import checkpoint_policies as cp
 
 
 @dataclass(frozen=True)
@@ -384,12 +383,9 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     return x + mlp_out, aux
 
 
-_REMAT_POLICIES = {
-    "nothing_saveable": cp.nothing_saveable,
-    "dots_saveable": cp.dots_saveable,
-    "dots_with_no_batch_dims": cp.dots_with_no_batch_dims_saveable,
-    "full": cp.everything_saveable,
-}
+# policy registry lives in runtime/activation_checkpointing (shared with the
+# engine's configure() surface; adds host-offload as policy name "offload")
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import resolve_policy as _resolve_remat_policy  # noqa: E402
 
 
 def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
@@ -403,7 +399,7 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
 
     layer_fn = partial(_layer_body, cfg=cfg, positions=positions)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, policy=_REMAT_POLICIES[cfg.remat_policy], static_argnums=())
+        layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy), static_argnums=())
 
     layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
     needs_rng = (cfg.dropout > 0.0 or cfg.moe_use_rts) and dropout_rng is not None

@@ -1,0 +1,17 @@
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    is_configured,
+    resolve_policy,
+)
+
+__all__ = [
+    "checkpointing",
+    "checkpoint",
+    "checkpoint_wrapper",
+    "configure",
+    "is_configured",
+    "resolve_policy",
+]

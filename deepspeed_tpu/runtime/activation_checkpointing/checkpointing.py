@@ -1,0 +1,194 @@
+"""Activation checkpointing (rematerialisation).
+
+TPU-native counterpart of the reference's Megatron-style checkpointing
+(``deepspeed/runtime/activation_checkpointing/checkpointing.py``:
+``checkpoint()`` :708, ``configure()`` :789, ``partition_activations`` :366,
+``CudaRNGStatesTracker`` :121). The mechanics collapse on TPU:
+
+  - ``checkpoint(fn, *args)`` is ``jax.checkpoint`` (remat): XLA recomputes
+    the wrapped region in the backward pass instead of saving residuals. The
+    reference's hand-rolled autograd.Function + stashed-args machinery is the
+    AD transform itself here.
+  - *partition_activations* (reference :366 — shard saved activations across
+    model-parallel ranks to avoid replication) is placement, not code: saved
+    residuals inherit the shardings of the values they're computed from, so
+    under a tensor/sequence-sharded mesh the saved tensors are already
+    partitioned. The flag is accepted and validated for config parity.
+  - *cpu_checkpointing* (reference :57 ``checkpoint_in_cpu``) maps to a remat
+    policy that saves residuals to pinned host memory
+    (``save_and_offload_only_these_names`` / offload variants), letting XLA
+    stream them back during backward.
+  - RNG reproducibility across the recompute (reference
+    ``CudaRNGStatesTracker``) is structural in JAX: dropout keys are explicit
+    arguments, so the replay is bit-identical by construction. The tracker
+    class is kept as a functional named-key registry for Megatron-style
+    callers.
+
+``configure()`` reads the same JSON block (runtime/config.py
+``activation_checkpointing``): partition_activations, cpu_checkpointing,
+contiguous_memory_optimization (no-op: XLA owns layout), number_checkpoints,
+profile, synchronize_checkpoint_boundary.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+import jax
+from jax.ad_checkpoint import checkpoint_policies as _cp
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# Named remat policies. "offload_dots" saves matmul outputs to host memory —
+# the cpu_checkpointing tier; "nothing" is full recompute (max memory saving).
+POLICIES: Dict[str, Any] = {
+    "nothing_saveable": _cp.nothing_saveable,
+    "dots_saveable": _cp.dots_saveable,
+    "dots_with_no_batch_dims": _cp.dots_with_no_batch_dims_saveable,
+    "full": _cp.everything_saveable,
+}
+
+
+def _offload_policy():
+    """Residual-offload-to-host policy (reference checkpoint_in_cpu)."""
+    return _cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+
+
+@dataclass
+class CheckpointConfig:
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False  # XLA owns layout; accepted
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    policy: str = "nothing_saveable"
+
+
+_CONFIG = CheckpointConfig()
+_CONFIGURED = False
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference: checkpointing.configure (checkpointing.py:789).
+
+    Accepts either the kwargs or a config object with an
+    ``activation_checkpointing`` block (TpuConfig works).
+    """
+    global _CONFIG, _CONFIGURED
+    block = {}
+    if deepspeed_config is not None:
+        block = getattr(deepspeed_config, "activation_checkpointing", None)
+        if block is None and isinstance(deepspeed_config, dict):
+            block = deepspeed_config.get("activation_checkpointing", {})
+        if hasattr(block, "__dict__"):
+            block = dict(block.__dict__)
+        block = dict(block or {})
+    cfg = CheckpointConfig(
+        partition_activations=_pick(partition_activations, block, "partition_activations", False),
+        cpu_checkpointing=_pick(checkpoint_in_cpu, block, "cpu_checkpointing", False),
+        contiguous_memory_optimization=_pick(
+            contiguous_checkpointing, block, "contiguous_memory_optimization", False
+        ),
+        number_checkpoints=_pick(num_checkpoints, block, "number_checkpoints", None),
+        synchronize_checkpoint_boundary=_pick(synchronize, block, "synchronize_checkpoint_boundary", False),
+        profile=_pick(profile, block, "profile", False),
+        policy=block.get("policy", "nothing_saveable"),
+    )
+    _CONFIG = cfg
+    _CONFIGURED = True
+    log_dist(
+        f"activation checkpointing configured: policy={cfg.policy} "
+        f"cpu={cfg.cpu_checkpointing} partition={cfg.partition_activations}",
+        ranks=[0],
+    )
+
+
+def _pick(arg, block, key, default):
+    if arg is not None:
+        return arg
+    return block.get(key, default)
+
+
+def is_configured() -> bool:
+    return _CONFIGURED
+
+
+def reset():
+    """Reference: checkpointing.reset (clears stashed buffers; here, config)."""
+    global _CONFIG, _CONFIGURED
+    _CONFIG = CheckpointConfig()
+    _CONFIGURED = False
+
+
+def resolve_policy(name: Optional[str] = None, cpu_checkpointing: Optional[bool] = None):
+    """Map a policy name (+ cpu flag) to a jax.checkpoint policy callable."""
+    cpu = _CONFIG.cpu_checkpointing if cpu_checkpointing is None else cpu_checkpointing
+    if cpu or name == "offload":
+        return _offload_policy()
+    return POLICIES[name or _CONFIG.policy]
+
+
+def checkpoint_wrapper(fn: Callable, policy: Optional[str] = None,
+                       prevent_cse: bool = True, static_argnums=()) -> Callable:
+    """Wrap ``fn`` so its activations are rematerialised in backward."""
+    return jax.checkpoint(
+        fn, policy=resolve_policy(policy), prevent_cse=prevent_cse, static_argnums=static_argnums
+    )
+
+
+def checkpoint(function: Callable, *args):
+    """Reference API (checkpointing.py:708): run ``function(*args)`` under
+    rematerialisation. Unlike the torch version there is no hidden state: the
+    transform applies to the traced computation."""
+    return checkpoint_wrapper(function)(*args)
+
+
+# ---------------------------------------------------------------------------
+# RNG tracking (reference CudaRNGStatesTracker :121). JAX PRNG keys are
+# explicit values, so "tracking" is a named-key registry; forked keys are
+# deterministic functions of the seed, and remat replays reproduce dropout
+# exactly because the key is an argument of the recomputed region.
+# ---------------------------------------------------------------------------
+
+class RNGStatesTracker:
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def get_states(self):
+        return dict(self._states)
+
+    def set_states(self, states):
+        self._states = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise Exception(f"rng state {name} already exists")
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name: str = "model-parallel-rng") -> jax.Array:
+        """Split off a fresh key from the named stream (the ctx-manager shape
+        of the reference collapses to an explicit key handoff)."""
+        if name not in self._states:
+            raise Exception(f"rng state {name} not added")
+        self._states[name], sub = jax.random.split(self._states[name])
+        return sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_TRACKER
+
+
+def model_parallel_seed(seed: int, tp_rank: int = 0):
+    """Reference model_parallel_cuda_manual_seed: distinct dropout streams per
+    TP rank (offset), shared default stream."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("default", seed)
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718 + tp_rank)

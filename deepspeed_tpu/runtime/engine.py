@@ -270,6 +270,13 @@ class TpuEngine:
 
         self.checkpoint_engine = OrbaxCheckpointEngine()
 
+        # --- activation checkpointing (reference: engine.py:872
+        # _configure_checkpointing); models read the policy via
+        # runtime/activation_checkpointing.resolve_policy
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as _act_ckpt
+
+        _act_ckpt.configure(deepspeed_config=config)
+
         self._compile_step_fns()
         log_dist(
             f"TpuEngine ready: zero_stage={self.zero_stage} dtype={self.model_dtype.__name__} "

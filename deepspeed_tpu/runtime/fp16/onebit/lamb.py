@@ -72,10 +72,10 @@ class OnebitLamb:
                 jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
                 jnp.float32(1.0),
             )
-            # freeze the coefficient at its last warmup value
-            ratio = jnp.where(frozen, coeff, live_ratio)
+            # freeze the coefficient at its last warmup value; the applied
+            # ratio and the stored coefficient are the same quantity
             new_coeff = jnp.where(frozen, coeff, live_ratio)
-            upd = -lr * ratio * u
+            upd = -lr * new_coeff * u
             return LeafTuple((upd, m_used, v_new, e_out, new_coeff))
 
         out = jax.tree.map(

@@ -1,0 +1,23 @@
+"""Shared utilities (reference: deepspeed/utils/)."""
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.tensor_fragment import (
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_set_full_fp32_param,
+)
+from deepspeed_tpu.utils.init_on_device import OnDevice
+from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
+
+__all__ = [
+    "log_dist",
+    "logger",
+    "safe_get_full_fp32_param",
+    "safe_get_full_grad",
+    "safe_get_full_optimizer_state",
+    "safe_set_full_fp32_param",
+    "OnDevice",
+    "LeafTuple",
+    "unpack_leaves",
+]

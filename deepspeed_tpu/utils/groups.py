@@ -1,0 +1,133 @@
+"""Process-group registry as mesh-axis bookkeeping.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/groups.py``
+(392 LoC of torch process-group creation for expert / expert-data / model /
+data parallelism). On TPU a "group" is a set of named mesh axes: collectives
+address axes, not rank lists, so group creation is metadata validation plus a
+name → axes mapping. The reference's group-crossing invariants (EP groups
+within DP groups, `groups.py:108,202`) become divisibility checks on the mesh.
+
+Reference API kept: ``initialize(ep_size, mpu)``, ``_get_expert_parallel_group``,
+``_get_expert_data_parallel_group``, ``_get_data_parallel_group``,
+``_get_model_parallel_group``, ``_get_expert_parallel_world_size`` etc. Group
+handles are axis tuples usable directly with deepspeed_tpu.comm collectives.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.utils.logging import log_dist
+
+# name -> axis tuple registries (reference: _EXPERT_PARALLEL_GROUP dicts)
+_EXPERT_PARALLEL_GROUP: Dict[str, Tuple[str, ...]] = {}
+_EXPERT_DATA_PARALLEL_GROUP: Dict[str, Tuple[str, ...]] = {}
+_MAX_EP_SIZE: Optional[int] = None
+
+
+def _ensure_mesh():
+    return comm.get_mesh()
+
+
+def initialize(ep_size: int = 1, mpu=None):
+    """Create expert (+ expert-data) groups for ``ep_size`` experts
+    (reference groups.py:59 initialize / :108 _create_expert_and_data_parallel).
+
+    On the mesh this validates that the ``expert`` axis can host ``ep_size``-way
+    expert parallelism: ep_size must divide the expert-axis size or equal it;
+    the remaining data-parallel extent forms the expert-data group.
+    """
+    mesh = _ensure_mesh()
+    expert_axis = mesh.shape.get("expert", 1)
+    dp = comm.dp_world_size()
+    world = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    if ep_size > world:
+        raise ValueError(f"ep_size {ep_size} > world size {world}")
+    if ep_size not in (1, expert_axis):
+        raise ValueError(
+            f"mesh expert axis is {expert_axis}; ep_size {ep_size} must match it "
+            "(shape the mesh with {'expert': ep_size} to enable expert parallelism)"
+        )
+    name = f"ep_size_{ep_size}"
+    if ep_size <= 1:
+        _EXPERT_PARALLEL_GROUP[name] = ()
+        _EXPERT_DATA_PARALLEL_GROUP[name] = comm.batch_axes()
+    else:
+        _EXPERT_PARALLEL_GROUP[name] = ("expert",)
+        # expert-data group: DP ranks holding the same expert shard
+        _EXPERT_DATA_PARALLEL_GROUP[name] = comm.batch_axes()
+    global _MAX_EP_SIZE
+    _MAX_EP_SIZE = max(_MAX_EP_SIZE or 1, ep_size)
+    log_dist(f"expert groups ready: {name} -> axes {_EXPERT_PARALLEL_GROUP[name]}", ranks=[0])
+    return _EXPERT_PARALLEL_GROUP[name]
+
+
+def _get_expert_parallel_group(name: str = None) -> Tuple[str, ...]:
+    name = name or _default_name()
+    if name not in _EXPERT_PARALLEL_GROUP:
+        raise KeyError(f"expert group {name} not initialized; call groups.initialize(ep_size)")
+    return _EXPERT_PARALLEL_GROUP[name]
+
+
+def _get_expert_data_parallel_group(name: str = None) -> Tuple[str, ...]:
+    name = name or _default_name()
+    if name not in _EXPERT_DATA_PARALLEL_GROUP:
+        raise KeyError(f"expert-data group {name} not initialized")
+    return _EXPERT_DATA_PARALLEL_GROUP[name]
+
+
+def _default_name() -> str:
+    if _MAX_EP_SIZE is None:
+        raise KeyError("no expert groups initialized")
+    return f"ep_size_{_MAX_EP_SIZE}"
+
+
+def _get_data_parallel_group() -> Tuple[str, ...]:
+    return comm.batch_axes()
+
+
+def _get_model_parallel_group() -> Tuple[str, ...]:
+    return ("tensor",)
+
+
+def _get_sequence_parallel_group() -> Tuple[str, ...]:
+    return ("sequence",)
+
+
+def _get_expert_parallel_world_size(name: str = None) -> int:
+    axes = _get_expert_parallel_group(name)
+    return comm.get_world_size(axes) if axes else 1
+
+
+def _get_expert_data_parallel_world_size(name: str = None) -> int:
+    axes = _get_expert_data_parallel_group(name)
+    return comm.get_world_size(axes) if axes else 1
+
+
+def _get_data_parallel_world_size() -> int:
+    return comm.dp_world_size()
+
+
+def _get_model_parallel_world_size() -> int:
+    return comm.get_world_size(("tensor",))
+
+
+def _get_sequence_parallel_world_size() -> int:
+    return comm.get_world_size(("sequence",))
+
+
+def _get_data_parallel_rank() -> int:
+    return comm.get_rank(comm.batch_axes())
+
+
+def _get_expert_parallel_rank(name: str = None) -> int:
+    axes = _get_expert_parallel_group(name)
+    return comm.get_rank(axes) if axes else 0
+
+
+def _clear():
+    global _MAX_EP_SIZE
+    _EXPERT_PARALLEL_GROUP.clear()
+    _EXPERT_DATA_PARALLEL_GROUP.clear()
+    _MAX_EP_SIZE = None

@@ -1,0 +1,57 @@
+"""Abstract / targeted-device initialization context.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/init_on_device.py``
+(``OnDevice`` ctx: construct a torch model with params on meta device or a
+target device). JAX separates model *code* from *arrays*, so "meta device"
+construction is ``jax.eval_shape`` (shape/dtype only, zero memory) and
+"target device" construction is ``jax.jit(init, out_shardings=...)``. The
+ctx-manager shape is kept for API familiarity.
+"""
+
+import contextlib
+from typing import Optional
+
+import jax
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    """with OnDevice(dtype=jnp.bfloat16, device="meta"): params = abstract(model.init, rng)
+
+    device="meta" → eval_shape (ShapeDtypeStruct tree, no allocation);
+    anything else → real init jitted with default placement.
+    """
+
+    _current: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        OnDevice._current = self if self.enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._current = None
+        return False
+
+    def init(self, init_fn, *args):
+        """Run an init function under this context's placement rule."""
+        if self.device == "meta":
+            tree = jax.eval_shape(init_fn, *args)
+        else:
+            tree = jax.jit(init_fn)(*args)
+        if self.dtype is not None:
+            cast = lambda x: (
+                jax.ShapeDtypeStruct(x.shape, self.dtype)
+                if isinstance(x, jax.ShapeDtypeStruct)
+                else x.astype(self.dtype)
+            )
+            tree = jax.tree.map(cast, tree)
+        return tree
+
+
+def on_device_init(init_fn, *args, dtype=None, device: str = "meta"):
+    """Functional form: abstract or placed initialization in one call."""
+    return OnDevice(dtype=dtype, device=device).init(init_fn, *args)

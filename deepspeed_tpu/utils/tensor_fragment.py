@@ -1,0 +1,134 @@
+"""High-precision fragment mapping + safe full-tensor access.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/tensor_fragment.py``
+(fragment_address / tensor_fragment dataclasses, ``safe_get_full_fp32_param``,
+``safe_get_full_grad``, ``safe_get_full_optimizer_state``). Under ZeRO the
+fp32 master ("hp") copy of each parameter lives sharded across ranks; the
+reference keeps byte-offset fragment records per rank so checkpoints can be
+re-stitched. Under JAX the sharded master IS a global jax.Array whose
+addressable shards carry their index ranges, so the fragment map is read off
+``array.addressable_shards`` and "get full tensor" is a gather to host.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class FragmentAddress:
+    """Where one device's fragment sits in the logical tensor
+    (reference fragment_address: numel/start offsets)."""
+
+    device: str
+    index: Tuple[slice, ...]  # numpy-style index into the global array
+    shape: Tuple[int, ...]
+
+
+def get_hp_fragment_mapping(arr: jax.Array) -> List[FragmentAddress]:
+    """Per-device fragment records for a (possibly sharded) array."""
+    out = []
+    for shard in arr.addressable_shards:
+        out.append(
+            FragmentAddress(
+                device=str(shard.device),
+                index=tuple(shard.index),
+                shape=tuple(shard.data.shape),
+            )
+        )
+    return out
+
+
+def _tree_get(tree, path):
+    node = tree
+    for key in path:
+        if isinstance(node, (list, tuple)):
+            node = node[int(key)]
+        elif hasattr(node, "_fields") and not isinstance(node, dict):  # NamedTuple
+            node = getattr(node, key)
+        else:
+            node = node[key]
+    return node
+
+
+def _parse_path(name) -> List[str]:
+    if isinstance(name, (list, tuple)):
+        return list(name)
+    return [p for p in str(name).replace("]", "").replace("[", ".").split(".") if p]
+
+
+def safe_get_full_fp32_param(engine, name) -> Optional[np.ndarray]:
+    """Full (unsharded) fp32 master value of a parameter
+    (reference tensor_fragment.py safe_get_full_fp32_param).
+
+    ``name`` is a dotted path into the param pytree, e.g. "layers.attn.wq".
+    """
+    tree = engine.master_params if engine.master_params is not None else engine.params
+    try:
+        leaf = _tree_get(tree, _parse_path(name))
+    except (KeyError, IndexError, AttributeError, TypeError):
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_grad(engine, name) -> Optional[np.ndarray]:
+    """Full accumulated gradient for a parameter (reference safe_get_full_grad;
+    here the grad accumulation buffer is the persistent grad store)."""
+    try:
+        leaf = _tree_get(engine.grad_acc, _parse_path(name))
+    except (KeyError, IndexError, AttributeError, TypeError):
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_optimizer_state(engine, name, state_key: str) -> Optional[np.ndarray]:
+    """Full optimizer-state tensor, e.g. state_key='exp_avg'
+    (reference safe_get_full_optimizer_state)."""
+    if engine.opt_state is None:
+        return None
+    state = engine.opt_state
+    sub = getattr(state, state_key, None)
+    if sub is None and isinstance(state, dict):
+        sub = state.get(state_key)
+    if sub is None:
+        return None
+    try:
+        leaf = _tree_get(sub, _parse_path(name))
+    except (KeyError, IndexError, AttributeError, TypeError):
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name, value) -> bool:
+    """Overwrite one master parameter from a full-host value (resharding to
+    the existing placement). Reference: safe_set_full_fp32_param."""
+    target_master = engine.master_params is not None
+    tree = engine.master_params if target_master else engine.params
+    path = _parse_path(name)
+    try:
+        leaf = _tree_get(tree, path)
+    except (KeyError, IndexError, AttributeError, TypeError):
+        return False
+    new_leaf = jax.device_put(np.asarray(value, dtype=leaf.dtype), leaf.sharding)
+
+    def rebuild(node, keys):
+        if not keys:
+            return new_leaf
+        k, rest = keys[0], keys[1:]
+        if isinstance(node, dict):
+            return {**node, k: rebuild(node[k], rest)}
+        if isinstance(node, (list, tuple)):
+            i = int(k)
+            items = list(node)
+            items[i] = rebuild(items[i], rest)
+            return type(node)(items)
+        raise TypeError(f"cannot rebuild through {type(node)}")
+
+    rebuilt = rebuild(tree, path)
+    if target_master:
+        engine.master_params = rebuilt
+    else:
+        engine.params = rebuilt
+    return True

@@ -1,0 +1,84 @@
+"""Offline checkpoint → full fp32 weights, engine-free.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/zero_to_fp32.py``
+(:158 ``get_fp32_state_dict_from_zero_checkpoint`` — stitch the flat fp32
+partitions every DP rank saved back into full parameter tensors). The Orbax
+format already stores arrays logically (not rank-shaped), so "consolidation"
+is a host-side restore of the master (or param) subtree — no partition-merge
+math. Usable standalone:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output.npz>
+"""
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _latest_tag(checkpoint_dir: str) -> Optional[str]:
+    latest = os.path.join(checkpoint_dir, "latest")
+    if os.path.exists(latest):
+        with open(latest) as fh:
+            return fh.read().strip()
+    return None
+
+
+def _flatten(tree, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+        return out
+    if isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}."))
+        return out
+    key = prefix[:-1] if prefix.endswith(".") else prefix
+    out[key] = np.asarray(tree)
+    return out
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Restore full fp32 weights as {dotted_name: ndarray}
+    (reference zero_to_fp32.py:158)."""
+    import orbax.checkpoint as ocp
+
+    tag = tag or _latest_tag(checkpoint_dir)
+    path = os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path)  # host numpy arrays, full shape
+    # prefer the fp32 master copy; fall back to model params
+    tree = restored.get("master_params") or restored.get("params")
+    if tree is None:
+        raise ValueError(f"checkpoint at {path} has no params/master_params")
+    return {k: v.astype(np.float32) for k, v in _flatten(tree).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str, tag: Optional[str] = None):
+    """Write consolidated fp32 weights to ``output_file`` (.npz)
+    (reference zero_to_fp32.py convert_zero_checkpoint_to_fp32_state_dict)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    meta = {"num_tensors": len(sd), "total_params": int(sum(v.size for v in sd.values()))}
+    with open(output_file + ".meta.json", "w") as fh:
+        json.dump(meta, fh)
+    return sd
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args()
+    sd = convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, args.tag)
+    print(f"wrote {len(sd)} tensors to {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,3 +151,34 @@ class TestInferenceEngine:
         c = InferenceConfig.parse({"mp_size": 4, "dtype": "float16"})
         assert c.tensor_parallel.tp_size == 4
         assert c.dtype == "float16"
+
+
+class TestTopLevelAPI:
+    def test_package_init_inference(self):
+        """deepspeed_tpu.init_inference must forward params/mesh and accept
+        reference-style kwargs (regression: a broken duplicate once shadowed
+        the working definition)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+        import jax
+
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                                max_seq_len=16, dtype="float32")
+        model = TransformerModel(cfg)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0))
+        engine = deepspeed_tpu.init_inference(model, dtype="float32", params=params)
+        out = engine(jnp.zeros((1, 4), jnp.int32))
+        assert out.shape == (1, 4, 64)
+        # params= must actually reach the engine (not be swallowed into config)
+        imported = engine.params["embed"]["tok"]
+        np.testing.assert_allclose(np.asarray(imported), np.asarray(params["embed"]["tok"]))
+
+    def test_eos_truncation(self):
+        """generate(eos_token_id=...) must not crash on the read-only host
+        buffer (regression) and must pad past-eos positions."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        tokens = jnp.array([[5, 6, 7, 2, 9, 9], [5, 6, 7, 8, 9, 9]], jnp.int32)
+        out = InferenceEngine._truncate_eos(tokens, prompt_len=3, eos_id=2)
+        assert list(np.asarray(out[0])) == [5, 6, 7, 2, 2, 2]
+        assert list(np.asarray(out[1])) == [5, 6, 7, 8, 9, 9]

@@ -1,0 +1,222 @@
+"""Tests for runtime/utils + utils/{groups,tensor_fragment,init_on_device,zero_to_fp32}."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime import utils as U
+
+
+class TestOverflowAndNorms:
+    def test_has_overflow(self):
+        clean = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+        assert not bool(U.has_overflow(clean))
+        bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros((2,))}
+        assert bool(U.has_overflow(bad))
+        nan = {"a": jnp.array([jnp.nan])}
+        assert bool(U.has_overflow(nan))
+
+    def test_check_overflow_class(self):
+        co = U.CheckOverflow({"w": jnp.ones((3,))})
+        assert not co.check()
+        assert co.check({"w": jnp.array([jnp.nan])})
+
+    def test_global_norm(self):
+        tree = {"a": jnp.full((4,), 2.0), "b": jnp.full((9,), 1.0)}
+        np.testing.assert_allclose(float(U.global_norm(tree)), 5.0, rtol=1e-6)
+        assert float(U.global_norm(tree, ord=float("inf"))) == 2.0
+
+    def test_clip_grad_norm(self):
+        grads = {"a": jnp.full((4,), 3.0)}
+        clipped, norm = U.clip_grad_norm_(grads, max_norm=1.0)
+        np.testing.assert_allclose(float(norm), 6.0, rtol=1e-6)
+        np.testing.assert_allclose(float(U.global_norm(clipped)), 1.0, rtol=1e-4)
+        # under max_norm: unchanged
+        clipped2, _ = U.clip_grad_norm_(grads, max_norm=100.0)
+        np.testing.assert_allclose(clipped2["a"], grads["a"])
+
+
+class TestFlatten:
+    def test_roundtrip(self):
+        ts = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,)), jnp.zeros((1, 1))]
+        flat = U.flatten_dense_tensors(ts)
+        assert flat.shape == (11,)
+        back = U.unflatten_dense_tensors(flat, ts)
+        for a, b in zip(ts, back):
+            np.testing.assert_allclose(a, b)
+
+    def test_tree_roundtrip(self):
+        tree = {"w": jnp.arange(4.0).reshape(2, 2), "b": jnp.ones((3,), jnp.bfloat16)}
+        flat, spec = U.flatten_tree(tree)
+        back = U.unflatten_tree(flat, spec)
+        assert back["b"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(back["w"]), np.asarray(tree["w"]))
+
+
+class TestPartition:
+    def test_uniform(self):
+        assert U.partition_uniform(10, 3) == [0, 4, 7, 10]
+        assert U.partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+
+    def test_balanced(self):
+        # heavy head: first part should be smaller in count
+        w = [10, 1, 1, 1, 1, 1, 1, 1]
+        parts = U.partition_balanced(w, 2)
+        assert parts[0] == 0 and parts[-1] == 8
+        assert parts[1] <= 4
+
+    def test_balanced_monotone(self):
+        parts = U.partition_balanced([1] * 12, 4)
+        assert parts == [0, 3, 6, 9, 12]
+
+
+class TestGroups:
+    def test_expert_groups(self, mesh8):
+        from deepspeed_tpu.utils import groups
+
+        groups._clear()
+        groups.initialize(ep_size=1)
+        assert groups._get_expert_parallel_group() == ()
+        assert groups._get_data_parallel_group() == ("data", "fsdp")
+        assert groups._get_expert_parallel_world_size() == 1
+        groups._clear()
+
+    def test_expert_axis_mesh(self):
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.utils import groups
+
+        comm.destroy()
+        comm.init_distributed(mesh_shape={"data": 2, "expert": 4}, verbose=False)
+        groups._clear()
+        groups.initialize(ep_size=4)
+        assert groups._get_expert_parallel_group() == ("expert",)
+        assert groups._get_expert_parallel_world_size() == 4
+        with pytest.raises(ValueError):
+            groups.initialize(ep_size=3)
+        groups._clear()
+
+    def test_uninitialized_raises(self):
+        from deepspeed_tpu.utils import groups
+
+        groups._clear()
+        with pytest.raises(KeyError):
+            groups._get_expert_parallel_group()
+
+
+class TestOnDevice:
+    def test_meta_init(self):
+        from deepspeed_tpu.utils.init_on_device import OnDevice
+
+        def init_fn(rng):
+            return {"w": jax.random.normal(rng, (128, 128))}
+
+        with OnDevice(device="meta") as ctx:
+            tree = ctx.init(init_fn, jax.random.PRNGKey(0))
+        assert isinstance(tree["w"], jax.ShapeDtypeStruct)
+        assert tree["w"].shape == (128, 128)
+
+    def test_meta_init_dtype_cast(self):
+        from deepspeed_tpu.utils.init_on_device import on_device_init
+
+        tree = on_device_init(
+            lambda r: {"w": jax.random.normal(r, (4, 4))}, jax.random.PRNGKey(0), dtype=jnp.bfloat16
+        )
+        assert tree["w"].dtype == jnp.bfloat16
+
+    def test_real_init(self):
+        from deepspeed_tpu.utils.init_on_device import on_device_init
+
+        tree = on_device_init(lambda r: {"w": jnp.ones((2, 2))}, jax.random.PRNGKey(0), device="device")
+        assert isinstance(tree["w"], jax.Array)
+
+
+class TestTensorFragment:
+    def test_fragment_mapping(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from deepspeed_tpu.utils.tensor_fragment import get_hp_fragment_mapping
+
+        arr = jax.device_put(jnp.arange(64.0).reshape(8, 8), NamedSharding(mesh8, PartitionSpec("fsdp")))
+        frags = get_hp_fragment_mapping(arr)
+        assert len(frags) == 8
+        assert all(f.shape == (1, 8) for f in frags)
+
+    def test_safe_getters_on_engine(self, mesh8, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.utils.tensor_fragment import (
+            safe_get_full_fp32_param,
+            safe_get_full_grad,
+            safe_get_full_optimizer_state,
+            safe_set_full_fp32_param,
+        )
+
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 1, "fsdp": -1},
+        }
+        rng = np.random.default_rng(0)
+
+        def loss_fn(params, batch, rng_):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        params = {"w": jnp.ones((8, 8), jnp.float32)}
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=cfg)
+        batch = {"x": rng.normal(size=(8, 8)).astype(np.float32), "y": rng.normal(size=(8, 8)).astype(np.float32)}
+        loss = engine(batch)
+        engine.backward(loss)
+
+        w = safe_get_full_fp32_param(engine, "w")
+        assert w is not None and w.shape == (8, 8)
+        g = safe_get_full_grad(engine, "w")
+        assert g is not None and np.abs(g).sum() > 0
+        engine.step()
+        m = safe_get_full_optimizer_state(engine, "w", "exp_avg")
+        assert m is not None and m.shape == (8, 8)
+        assert safe_get_full_fp32_param(engine, "nope") is None
+
+        ok = safe_set_full_fp32_param(engine, "w", np.zeros((8, 8), np.float32))
+        assert ok
+        np.testing.assert_allclose(safe_get_full_fp32_param(engine, "w"), 0.0)
+
+
+class TestZeroToFp32:
+    def test_consolidate(self, mesh8, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.utils.zero_to_fp32 import (
+            convert_zero_checkpoint_to_fp32_state_dict,
+            get_fp32_state_dict_from_zero_checkpoint,
+        )
+
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 3},
+            "bf16": {"enabled": True},
+            "mesh": {"data": 1, "fsdp": -1},
+        }
+
+        def loss_fn(params, batch, rng_):
+            return jnp.mean((batch["x"] @ params["w"]) ** 2)
+
+        params = {"w": jnp.full((8, 8), 0.5, jnp.float32)}
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=cfg)
+        ckpt_dir = str(tmp_path / "ckpt")
+        engine.save_checkpoint(ckpt_dir, tag="step0")
+
+        sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag="step0")
+        assert "w" in sd
+        assert sd["w"].dtype == np.float32
+        np.testing.assert_allclose(sd["w"], 0.5, rtol=1e-2)
+
+        out = str(tmp_path / "weights.npz")
+        convert_zero_checkpoint_to_fp32_state_dict(ckpt_dir, out, tag="step0")
+        assert os.path.exists(out)
+        loaded = np.load(out)
+        np.testing.assert_allclose(loaded["w"], 0.5, rtol=1e-2)
