@@ -1,0 +1,3 @@
+"""Profiling (reference: deepspeed/profiling/): flops profiler over XLA cost
+analysis; wall-clock timers live in utils/timer.py; jax.profiler traces are
+the NVTX/nsys equivalent."""

@@ -1,0 +1,23 @@
+"""Data efficiency pipeline (reference: deepspeed/runtime/data_pipeline/):
+curriculum learning, difficulty-indexed sampling, mmap indexed datasets,
+random-LTD token routing."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    make_builder,
+    make_dataset,
+)
+
+__all__ = [
+    "CurriculumScheduler",
+    "DataAnalyzer",
+    "DeepSpeedDataSampler",
+    "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder",
+    "make_builder",
+    "make_dataset",
+]

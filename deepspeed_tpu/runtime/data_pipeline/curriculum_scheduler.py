@@ -1,0 +1,77 @@
+"""Curriculum difficulty schedules.
+
+TPU-native counterpart of the reference's ``CurriculumScheduler``
+(runtime/data_pipeline/curriculum_scheduler.py, 158 LoC): maps the global
+step to a difficulty value (typically a sequence length). Schedule types
+mirror the reference: ``fixed_linear``, ``fixed_root``, ``fixed_discrete``,
+``custom``.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        cfg = dict(config)
+        self.min_difficulty = int(cfg.get("min_difficulty", 8))
+        self.max_difficulty = int(cfg.get("max_difficulty", 1024))
+        self.schedule_type = cfg.get("schedule_type", FIXED_LINEAR)
+        sched = dict(cfg.get("schedule_config", {}))
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            self.total_step = int(sched.get("total_curriculum_step", 10000))
+            # difficulty moves on a grid so seqlen changes land on clean
+            # multiples (the reference's difficulty_step, default 8 — also
+            # bounds the number of distinct compiled shapes under jit)
+            self.difficulty_step = int(sched.get("difficulty_step", 8))
+            self.root_degree = int(sched.get("root_degree", 2)) if self.schedule_type == FIXED_ROOT else 1
+        elif self.schedule_type == FIXED_DISCRETE:
+            self.difficulties = list(sched.get("difficulty", [self.max_difficulty]))
+            self.max_steps = list(sched.get("max_step", []))
+            assert len(self.max_steps) == len(self.difficulties) - 1 or len(self.max_steps) == len(
+                self.difficulties
+            ), "fixed_discrete needs max_step per difficulty transition"
+        elif self.schedule_type == CUSTOM:
+            pass  # set_custom_get_difficulty must be called
+        else:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type}")
+        self.current_difficulty = self.min_difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_get_difficulty = fn
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == CUSTOM:
+            assert self.custom_get_difficulty is not None, "custom schedule requires a callback"
+            return int(self.custom_get_difficulty(global_steps))
+        if self.schedule_type == FIXED_DISCRETE:
+            for i, boundary in enumerate(self.max_steps):
+                if global_steps <= boundary:
+                    return int(self.difficulties[i])
+            return int(self.difficulties[-1])
+        # fixed_linear / fixed_root (reference: __fixed_root_get_difficulty)
+        frac = min(1.0, global_steps / max(1, self.total_step))
+        frac = frac ** (1.0 / self.root_degree)
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        diff = self.difficulty_step * math.floor(diff / self.difficulty_step)
+        return int(max(self.min_difficulty, min(self.max_difficulty, diff)))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def get_state(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state):
+        self.current_difficulty = state.get("current_difficulty", self.min_difficulty)

@@ -1,0 +1,74 @@
+"""Dataset difficulty analysis.
+
+TPU-native counterpart of the reference's ``DataAnalyzer``
+(runtime/data_pipeline/data_sampling/data_analyzer.py, 417 LoC): map a metric
+function over every sample (sharded across workers), then reduce into a
+difficulty index consumable by ``DeepSpeedDataSampler``. The reference runs
+this as a distributed map-reduce writing Megatron index files; here the map
+runs over host processes (multiprocessing) and the reduce is a sort — the
+output (metric values + sorted order) is saved as .npy next to the dataset.
+"""
+
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+METRIC_SEQLEN = "seqlen"
+
+
+def seqlen_metric(sample) -> float:
+    """Default difficulty: token count (reference curriculum seqlen metric)."""
+    if isinstance(sample, dict):
+        for key in ("input_ids", "tokens", "text"):
+            if key in sample:
+                return float(len(sample[key]))
+        sample = next(iter(sample.values()))
+    return float(len(sample))
+
+
+class DataAnalyzer:
+    def __init__(
+        self,
+        dataset,
+        metric_fn: Callable = seqlen_metric,
+        metric_name: str = METRIC_SEQLEN,
+        num_workers: int = 1,
+        save_path: Optional[str] = None,
+    ):
+        self.dataset = dataset
+        self.metric_fn = metric_fn
+        self.metric_name = metric_name
+        self.num_workers = max(1, num_workers)
+        self.save_path = save_path
+
+    def _map_range(self, lo: int, hi: int) -> np.ndarray:
+        return np.asarray([self.metric_fn(self.dataset[i]) for i in range(lo, hi)], np.float64)
+
+    def run_map_reduce(self) -> np.ndarray:
+        """Compute the metric for every sample; returns the values array and
+        writes {metric_name}_values.npy / {metric_name}_order.npy if save_path."""
+        n = len(self.dataset)
+        if self.num_workers <= 1:
+            values = self._map_range(0, n)
+        else:
+            # thread pool: metric fns are numpy/IO bound (mmap reads release
+            # the GIL); worker processes would re-mmap the dataset per fork
+            from concurrent.futures import ThreadPoolExecutor
+
+            bounds = np.linspace(0, n, self.num_workers + 1, dtype=int)
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                chunks = list(pool.map(lambda se: self._map_range(se[0], se[1]), zip(bounds[:-1], bounds[1:])))
+            values = np.concatenate(chunks) if chunks else np.zeros((0,), np.float64)
+        if self.save_path:
+            os.makedirs(self.save_path, exist_ok=True)
+            np.save(os.path.join(self.save_path, f"{self.metric_name}_values.npy"), values)
+            np.save(
+                os.path.join(self.save_path, f"{self.metric_name}_order.npy"),
+                np.argsort(values, kind="stable"),
+            )
+        return values
+
+    @staticmethod
+    def load_values(save_path: str, metric_name: str = METRIC_SEQLEN) -> np.ndarray:
+        return np.load(os.path.join(save_path, f"{metric_name}_values.npy"))

@@ -1,0 +1,72 @@
+"""Random layerwise token dropping (random-LTD).
+
+TPU-native counterpart of the reference's random-LTD layer
+(runtime/data_pipeline/data_routing/basic_layer.py, 113 LoC + the
+``csrc/random_ltd`` CUDA kernels: comparison-free token sort,
+gather/scatter, mask gather — SURVEY §2.4 #8). The CUDA kernel inventory
+collapses into three static-shape jnp ops XLA fuses:
+
+  - ``random_keep_indices``: sample-without-replacement via argsort of
+    uniform keys (the "comparison-free token sort" is a sort on random keys
+    here too), then re-sort ascending so kept tokens preserve causal order;
+  - ``gather_tokens`` / ``scatter_tokens``: take_along_axis and an index
+    scatter over the sequence dim.
+
+Everything is static-shape: ``keep_len`` is a Python int per compile
+(the scheduler steps it between jit calls, giving a bounded set of compiled
+shapes — same recompile granularity as curriculum seqlen).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_keep_indices(rng, batch: int, seq_len: int, keep_len: int) -> jnp.ndarray:
+    """(B, keep_len) sorted indices of kept tokens, uniform without replacement."""
+    keys = jax.random.uniform(rng, (batch, seq_len))
+    picked = jnp.argsort(keys, axis=-1)[:, :keep_len]  # random subset
+    return jnp.sort(picked, axis=-1)  # restore temporal order
+
+
+def gather_tokens(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """x (B, S, D), indices (B, K) -> (B, K, D) (csrc gather_scatter.cu fwd)."""
+    return jnp.take_along_axis(x, indices[:, :, None], axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, kept: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Write kept tokens back into the full-length sequence (bwd path of the
+    reference's gather: untouched positions keep ``full``'s values)."""
+    B = full.shape[0]
+    batch_idx = jnp.arange(B)[:, None]
+    return full.at[batch_idx, indices].set(kept)
+
+
+def gather_attention_mask(mask: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Mask gather (csrc slice_gpt_mask / slice_bert_mask): (B, S) or
+    (B, 1, S, S) masks restricted to kept positions."""
+    if mask.ndim == 2:
+        return jnp.take_along_axis(mask, indices, axis=1)
+    if mask.ndim == 4:
+        m = jnp.take_along_axis(mask, indices[:, None, :, None], axis=2)
+        return jnp.take_along_axis(m, indices[:, None, None, :], axis=3)
+    raise ValueError(f"unsupported mask rank {mask.ndim}")
+
+
+class RandomLayerTokenDrop:
+    """Per-layer token dropping wrapper (reference basic_layer.py
+    RandomLayerTokenDrop): wraps a layer fn; in training, runs it on a random
+    token subset and scatters outputs back (identity for dropped tokens)."""
+
+    def __init__(self, layer_fn):
+        self.layer_fn = layer_fn
+
+    def __call__(self, x: jnp.ndarray, keep_len: int, rng, *args, **kwargs) -> jnp.ndarray:
+        B, S = x.shape[0], x.shape[1]
+        if keep_len >= S:
+            return self.layer_fn(x, *args, **kwargs)
+        idx = random_keep_indices(rng, B, S, keep_len)
+        kept = gather_tokens(x, idx)
+        out = self.layer_fn(kept, *args, **kwargs)
+        return scatter_tokens(x, out, idx)

@@ -1,0 +1,37 @@
+"""Random-LTD schedule.
+
+TPU-native counterpart of the reference's random-LTD scheduler
+(runtime/data_pipeline/data_routing/scheduler.py): the kept-token count per
+layer starts at ``random_ltd_layer_token`` and grows linearly to the full
+sequence over ``total_layer_token_steps``; a subset of layers participates
+(reference: random_ltd_layer_id list).
+"""
+
+from typing import Any, Dict, List
+
+
+class RandomLTDScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        cfg = dict(config)
+        self.total_steps = int(cfg.get("total_layer_token_steps", 10000))
+        self.start_tokens = int(cfg.get("random_ltd_layer_token_start", 128))
+        self.max_tokens = int(cfg.get("seq_length", 1024))
+        self.layer_ids: List[int] = list(cfg.get("random_ltd_layer_id", []))
+        self.step_size = int(cfg.get("token_step_size", 16))
+        self.current_steps = 0
+
+    def get_current_seq(self) -> int:
+        frac = min(1.0, self.current_steps / max(1, self.total_steps))
+        tokens = self.start_tokens + frac * (self.max_tokens - self.start_tokens)
+        tokens = self.step_size * int(tokens // self.step_size)
+        return int(min(self.max_tokens, max(self.start_tokens, tokens)))
+
+    def update_seq(self, global_steps: int) -> int:
+        self.current_steps = global_steps
+        return self.get_current_seq()
+
+    def state_dict(self):
+        return {"current_steps": self.current_steps}
+
+    def load_state_dict(self, state):
+        self.current_steps = state.get("current_steps", 0)

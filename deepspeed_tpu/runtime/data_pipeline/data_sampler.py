@@ -1,0 +1,105 @@
+"""Curriculum-aware data sampling.
+
+TPU-native counterpart of the reference's ``DeepSpeedDataSampler``
+(runtime/data_pipeline/data_sampling/data_sampler.py, 338 LoC): sample
+indices each step restricted to examples whose difficulty metric is within
+the curriculum's current threshold. The reference pages through an on-disk
+index built by the DataAnalyzer; here the metric→samples index is a sorted
+numpy array (built by ``data_analyzer.DataAnalyzer`` or passed directly),
+and eligibility is a ``searchsorted`` prefix — O(log n) per difficulty
+update, zero per-step host work.
+"""
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(
+        self,
+        total_samples: int,
+        batch_size: int,
+        metric_values: Optional[Sequence[float]] = None,
+        curriculum: Optional[CurriculumScheduler] = None,
+        seed: int = 1234,
+        drop_last: bool = True,
+        global_rank: int = 0,
+        world_size: int = 1,
+    ):
+        self.total_samples = total_samples
+        self.batch_size = batch_size
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_rank = global_rank
+        self.world_size = world_size
+        assert batch_size % world_size == 0, (
+            f"batch_size {batch_size} not divisible by world_size {world_size}: "
+            "the remainder would be sampled but never trained on"
+        )
+        self.consumed_samples = 0
+        self.epoch = 0
+        if metric_values is not None:
+            values = np.asarray(metric_values, dtype=np.float64)
+            assert values.shape[0] == total_samples
+            self._order_by_metric = np.argsort(values, kind="stable")
+            self._sorted_values = values[self._order_by_metric]
+        else:
+            self._order_by_metric = None
+            self._sorted_values = None
+
+    # -- eligibility -----------------------------------------------------
+    def eligible_count(self) -> int:
+        if self.curriculum is None or self._sorted_values is None:
+            return self.total_samples
+        threshold = self.curriculum.get_current_difficulty()
+        n = int(np.searchsorted(self._sorted_values, threshold, side="right"))
+        # always keep at least one batch eligible (reference clamps likewise)
+        return max(n, min(self.batch_size, self.total_samples))
+
+    def eligible_indices(self) -> np.ndarray:
+        if self._order_by_metric is None:
+            return np.arange(self.total_samples)
+        return self._order_by_metric[: self.eligible_count()]
+
+    # -- iteration -------------------------------------------------------
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def state_dict(self):
+        return {
+            "consumed_samples": self.consumed_samples,
+            "epoch": self.epoch,
+            "curriculum": self.curriculum.get_state() if self.curriculum else None,
+        }
+
+    def load_state_dict(self, state):
+        self.consumed_samples = state.get("consumed_samples", 0)
+        self.epoch = state.get("epoch", 0)
+        if self.curriculum is not None and state.get("curriculum"):
+            self.curriculum.set_state(state["curriculum"])
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yields per-step global-batch index arrays (this rank's slice).
+
+        The RNG is keyed per (seed, epoch, step) so resuming from a restored
+        ``consumed_samples`` continues the stream instead of replaying batches
+        already trained on.
+        """
+        per_rank = self.batch_size // self.world_size
+        while True:
+            step = self.consumed_samples // self.batch_size
+            rng = np.random.default_rng((self.seed, self.epoch, step))
+            pool = self.eligible_indices()
+            if len(pool) < self.batch_size and self.drop_last:
+                pool = np.resize(pool, self.batch_size)
+            batch = rng.choice(pool, size=self.batch_size, replace=len(pool) < self.batch_size)
+            self.consumed_samples += self.batch_size
+            if self.curriculum is not None:
+                # step-granular difficulty advance (engine also calls
+                # update_difficulty at its own boundary; idempotent)
+                self.curriculum.update_difficulty(self.consumed_samples // self.batch_size)
+            yield batch[self.global_rank * per_rank : (self.global_rank + 1) * per_rank]

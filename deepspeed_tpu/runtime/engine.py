@@ -250,6 +250,7 @@ class TpuEngine:
         self.train_batch_size = config.train_batch_size
         self._last_metrics: Optional[StepMetrics] = None
         self._pending_loss = None
+        self._flops_profiled = False
 
         # --- timers / monitor
         self.timers = EngineTimers(enable=config.wall_clock_breakdown)
@@ -259,6 +260,21 @@ class TpuEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config)
+
+        # --- curriculum learning (reference: engine.py:1673-1676 seqlen
+        # truncation per step; schedule in data_pipeline/curriculum_scheduler)
+        self.curriculum_scheduler = None
+        if config.curriculum.enabled:
+            from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                {
+                    "min_difficulty": config.curriculum.min_difficulty,
+                    "max_difficulty": config.curriculum.max_difficulty,
+                    "schedule_type": config.curriculum.schedule_type,
+                    "schedule_config": config.curriculum.schedule_config,
+                }
+            )
 
         # --- dataloader
         self.training_dataloader = None
@@ -420,11 +436,34 @@ class TpuEngine:
     # ------------------------------------------------------------------
     # train loop surface (forward / backward / step)
     # ------------------------------------------------------------------
+    _SEQ_KEYS = ("input_ids", "labels", "tokens", "attention_mask", "position_ids")
+
+    def _curriculum_truncate(self, batch):
+        """Truncate the sequence dim to the curriculum difficulty (reference
+        engine.py:1673-1676). Distinct lengths land on the schedule's
+        difficulty_step grid, bounding recompiles."""
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        if not isinstance(batch, dict):
+            return batch
+        out = dict(batch)
+        for key in self._SEQ_KEYS:
+            if key in out and getattr(out[key], "ndim", 0) >= 2 and out[key].shape[1] > seqlen:
+                out[key] = out[key][:, :seqlen]
+        return out
+
     def forward(self, batch, rng=None):
         self.timers(EngineTimers.FORWARD).start()
         self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            batch = self._curriculum_truncate(batch)
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else self._next_rng()
+        if (
+            self.config.flops_profiler.enabled
+            and not self._flops_profiled
+            and self.global_steps + 1 >= self.config.flops_profiler.profile_step
+        ):
+            self._profile_flops(batch, rng)
         loss, self.grad_acc = self._micro_fn(
             self.params, self.grad_acc, batch, rng, self.scale_state.scale
         )
@@ -485,6 +524,42 @@ class TpuEngine:
         self._write_monitor()
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
             self.timers.log(normalizer=self.gradient_accumulation_steps)
+
+    def _profile_flops(self, batch, rng):
+        """One-shot micro-step cost report (reference: engine.py:1646-1664
+        flops-profiler trigger at profile_step)."""
+        from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler, count_params
+
+        self._flops_profiled = True
+        prof = FlopsProfiler(self.model, engine=self)
+        try:
+            compiled = self._micro_fn.lower(
+                self.params, self.grad_acc, batch, rng, self.scale_state.scale
+            ).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            prof.flops = float((cost or {}).get("flops", 0.0))
+            prof.bytes_accessed = float((cost or {}).get("bytes accessed", 0.0))
+            # timed run on a throwaway grad buffer (the real one is donated to
+            # the subsequent training call); host fetch forces completion
+            zeros = jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t), out_shardings=self.grad_shardings
+            )(self.grad_acc)
+            t0 = time.time()
+            out_loss, _ = compiled(self.params, zeros, batch, rng, self.scale_state.scale)
+            float(out_loss)
+            prof.duration = time.time() - t0
+            prof.params = count_params(self.params)
+            prof.print_model_profile(
+                profile_step=self.global_steps + 1,
+                module_depth=self.config.flops_profiler.module_depth,
+                top_modules=self.config.flops_profiler.top_modules,
+                detailed=self.config.flops_profiler.detailed,
+                output_file=self.config.flops_profiler.output_file,
+            )
+        except Exception as e:  # profiling must never kill training
+            logger.warning(f"flops profiling failed: {e}")
 
     def train_batch(self, data_iter=None):
         """Full accumulation cycle (PipelineEngine.train_batch parity)."""

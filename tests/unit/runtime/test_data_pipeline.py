@@ -1,0 +1,250 @@
+"""Data efficiency pipeline tests (reference: tests/unit/runtime/test_data.py,
+data_efficiency suites)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer, seqlen_metric
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    RandomLayerTokenDrop,
+    gather_attention_mask,
+    gather_tokens,
+    random_keep_indices,
+    scatter_tokens,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import RandomLTDScheduler
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 128, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        })
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 64
+        assert s.get_difficulty(100) == 128
+        assert s.get_difficulty(10**6) == 128
+        # grid-aligned
+        assert all(s.get_difficulty(t) % 8 == 0 for t in range(0, 100, 7))
+
+    def test_fixed_root(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 128, "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8, "root_degree": 2},
+        })
+        # sqrt schedule grows faster early than linear
+        assert s.get_difficulty(25) >= 8 + 0.5 * (128 - 8) - 8
+        assert s.get_difficulty(100) == 128
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [16, 32, 64], "max_step": [10, 20]},
+        })
+        assert s.get_difficulty(5) == 16
+        assert s.get_difficulty(15) == 32
+        assert s.get_difficulty(25) == 64
+
+    def test_custom(self):
+        s = CurriculumScheduler({"schedule_type": "custom"})
+        s.set_custom_get_difficulty(lambda step: 42 + step)
+        assert s.get_difficulty(8) == 50
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10}})
+        s.update_difficulty(5)
+        state = s.get_state()
+        s2 = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                  "schedule_type": "fixed_linear",
+                                  "schedule_config": {"total_curriculum_step": 10}})
+        s2.set_state(state)
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+class TestIndexedDataset:
+    def test_roundtrip(self, tmp_path):
+        prefix = str(tmp_path / "corpus")
+        builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        docs = [np.arange(5), np.arange(100, 103), np.arange(7) * 2]
+        for d in docs:
+            builder.add_item(d)
+        builder.end_document()
+        builder.finalize()
+
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 3
+        for want, got in zip(docs, [ds[i] for i in range(3)]):
+            np.testing.assert_array_equal(want.astype(np.int32), got)
+        np.testing.assert_array_equal(ds.sizes, [5, 3, 7])
+        assert MMapIndexedDataset.exists(prefix)
+
+    def test_get_with_offset(self, tmp_path):
+        prefix = str(tmp_path / "c2")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        b.add_item(np.arange(10))
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds.get(0, offset=3, length=4), [3, 4, 5, 6])
+        assert ds[0].dtype == np.uint16
+
+
+class TestDataAnalyzer:
+    def test_seqlen_metric_and_sampler(self, tmp_path):
+        data = [{"input_ids": np.zeros(l, np.int32)} for l in [4, 16, 64, 8, 32, 128, 4, 16]]
+        analyzer = DataAnalyzer(data, metric_fn=seqlen_metric, save_path=str(tmp_path), num_workers=2)
+        values = analyzer.run_map_reduce()
+        np.testing.assert_array_equal(values, [4, 16, 64, 8, 32, 128, 4, 16])
+        assert (tmp_path / "seqlen_values.npy").exists()
+
+        cur = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 128, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        })
+        sampler = DeepSpeedDataSampler(
+            total_samples=len(data), batch_size=4, metric_values=values, curriculum=cur, seed=0
+        )
+        cur.update_difficulty(0)  # difficulty 8
+        eligible = sampler.eligible_indices()
+        assert set(eligible).issubset({0, 3, 6, 1, 7})  # lengths <= 8 (clamped to >= batch)
+        cur.update_difficulty(100)  # difficulty 128: everything eligible
+        assert len(sampler.eligible_indices()) == len(data)
+
+    def test_sampler_iteration(self):
+        sampler = DeepSpeedDataSampler(total_samples=100, batch_size=8, seed=1)
+        it = iter(sampler)
+        b1, b2 = next(it), next(it)
+        assert b1.shape == (8,)
+        assert sampler.consumed_samples == 16
+        state = sampler.state_dict()
+        s2 = DeepSpeedDataSampler(total_samples=100, batch_size=8, seed=1)
+        s2.load_state_dict(state)
+        assert s2.consumed_samples == 16
+
+    def test_sampler_resume_does_not_replay(self):
+        """Restoring consumed_samples must continue the index stream, not
+        replay batches already trained on (regression)."""
+        a = DeepSpeedDataSampler(total_samples=1000, batch_size=8, seed=7)
+        it = iter(a)
+        first_run = [next(it) for _ in range(6)]
+        state = a.state_dict()
+
+        b = DeepSpeedDataSampler(total_samples=1000, batch_size=8, seed=7)
+        b.load_state_dict(state)
+        resumed = next(iter(b))
+        # resumed batch must equal the *7th* batch of an uninterrupted run
+        c = DeepSpeedDataSampler(total_samples=1000, batch_size=8, seed=7)
+        itc = iter(c)
+        for _ in range(6):
+            next(itc)
+        seventh = next(itc)
+        np.testing.assert_array_equal(resumed, seventh)
+        assert not any(np.array_equal(resumed, fb) for fb in first_run)
+
+    def test_sampler_world_size_divisibility(self):
+        with pytest.raises(AssertionError):
+            DeepSpeedDataSampler(total_samples=10, batch_size=8, world_size=3)
+
+    def test_sampler_rank_slicing(self):
+        s = DeepSpeedDataSampler(total_samples=64, batch_size=8, seed=3, global_rank=1, world_size=4)
+        batch = next(iter(s))
+        assert batch.shape == (2,)
+
+
+class TestRandomLTD:
+    def test_keep_indices_sorted_unique(self):
+        idx = random_keep_indices(jax.random.PRNGKey(0), batch=4, seq_len=32, keep_len=8)
+        assert idx.shape == (4, 8)
+        arr = np.asarray(idx)
+        for row in arr:
+            assert len(set(row.tolist())) == 8
+            assert list(row) == sorted(row)
+
+    def test_gather_scatter_roundtrip(self):
+        x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+        idx = random_keep_indices(jax.random.PRNGKey(1), 2, 8, 5)
+        kept = gather_tokens(x, idx)
+        assert kept.shape == (2, 5, 4)
+        back = scatter_tokens(x, kept, idx)
+        np.testing.assert_allclose(back, x)  # unchanged tokens scattered back
+
+    def test_mask_gather(self):
+        mask2 = jnp.ones((2, 8))
+        idx = random_keep_indices(jax.random.PRNGKey(2), 2, 8, 4)
+        assert gather_attention_mask(mask2, idx).shape == (2, 4)
+        mask4 = jnp.ones((2, 1, 8, 8))
+        assert gather_attention_mask(mask4, idx).shape == (2, 1, 4, 4)
+
+    def test_layer_wrapper_grads_flow(self):
+        layer = RandomLayerTokenDrop(lambda h: h * 2.0)
+
+        def loss(x):
+            out = layer(x, keep_len=4, rng=jax.random.PRNGKey(0))
+            return jnp.sum(out)
+
+        x = jnp.ones((2, 8, 3))
+        g = jax.grad(loss)(x)
+        # kept tokens have grad 2, dropped have grad 1 (identity path)
+        vals = set(np.unique(np.asarray(g)).tolist())
+        assert vals == {1.0, 2.0}
+        # exactly keep_len tokens per batch row took the layer path
+        assert int((np.asarray(g)[0, :, 0] == 2.0).sum()) == 4
+
+    def test_full_keep_is_identity_path(self):
+        layer = RandomLayerTokenDrop(lambda h: h + 1.0)
+        x = jnp.zeros((1, 4, 2))
+        out = layer(x, keep_len=4, rng=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_scheduler(self):
+        s = RandomLTDScheduler({"total_layer_token_steps": 100, "random_ltd_layer_token_start": 64,
+                                "seq_length": 256, "token_step_size": 16})
+        assert s.update_seq(0) == 64
+        assert s.update_seq(100) == 256
+        mid = s.update_seq(50)
+        assert 64 < mid < 256 and mid % 16 == 0
+
+
+class TestEngineCurriculum:
+    def test_seqlen_truncation(self, mesh8):
+        import deepspeed_tpu
+
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 1, "fsdp": -1},
+            "curriculum_learning": {
+                "enabled": True,
+                "min_difficulty": 8,
+                "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+            },
+        }
+        seen = []
+
+        def loss_fn(params, batch, rng):
+            seen.append(batch["input_ids"].shape[1])
+            x = batch["input_ids"].astype(jnp.float32)
+            return jnp.mean((x @ params["w"][: x.shape[1]]) ** 2)
+
+        params = {"w": jnp.ones((16, 4), jnp.float32)}
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=cfg)
+        batch = {"input_ids": np.ones((8, 16), np.int32), "labels": np.ones((8, 16), np.int32)}
+        for _ in range(5):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+        # early steps truncated to 8, late steps full 16
+        assert 8 in seen and 16 in seen
