@@ -1,0 +1,12 @@
+"""Checkpoint conversion & inspection (reference: deepspeed/checkpoint/):
+universal interchange format, ds_to_universal conversion, cross-mesh resume.
+Rank-shaped reshape utilities (reshape_meg_2d/3d) have no TPU analogue — the
+Orbax engine format is logical-array-shaped and reshards on load."""
+
+from deepspeed_tpu.checkpoint.universal_checkpoint import (
+    UniversalCheckpoint,
+    ds_to_universal,
+    load_universal_into_engine,
+)
+
+__all__ = ["UniversalCheckpoint", "ds_to_universal", "load_universal_into_engine"]

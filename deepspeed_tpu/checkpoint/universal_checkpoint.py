@@ -1,0 +1,184 @@
+"""Universal checkpoint format + conversion.
+
+TPU-native counterpart of the reference's ``deepspeed/checkpoint/`` package
+(``deepspeed_checkpoint.py:33`` DeepSpeedCheckpoint, ``universal_checkpoint.py``
+hp-fragment loading, ``ds_to_universal`` flow, ``reshape_meg_2d/3d`` utils).
+
+The reference needs 1,065 LoC because its on-disk shards are *rank-shaped*
+(mp_rank_XX / zero_pp_rank_N files) — converting between TP/PP/DP layouts
+means slicing and re-gluing flat fp32 fragments. The Orbax engine checkpoint
+is already logical-array-shaped, so:
+
+  - cross-mesh / cross-zero-stage resume needs no conversion (restore
+    re-shards to the target NamedShardings) — covered by the engine's
+    load_checkpoint;
+  - the *universal* format here is the portable interchange layer: one
+    ``.npz``-backed directory of {dotted_name: full fp32 ndarray} for model
+    weights and each optimizer-state component, plus a JSON manifest with
+    shapes, dtypes, logical-axis metadata and training counters. It is
+    engine-independent (loadable into HF/Flax/other frameworks) and is the
+    analogue of the reference's ``ds_to_universal.py`` output tree.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.zero_to_fp32 import _flatten, _latest_tag
+
+MODEL_FILE = "model_states.npz"
+OPT_PREFIX = "optim_"
+MANIFEST = "universal_manifest.json"
+
+
+def ds_to_universal(checkpoint_dir: str, output_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Convert an engine checkpoint into the universal layout
+    (reference: checkpoint/ds_to_universal.py main flow)."""
+    import orbax.checkpoint as ocp
+
+    tag = tag or _latest_tag(checkpoint_dir)
+    src = os.path.abspath(os.path.join(checkpoint_dir, tag) if tag else checkpoint_dir)
+    restored = ocp.PyTreeCheckpointer().restore(src)
+
+    os.makedirs(output_dir, exist_ok=True)
+    manifest: Dict[str, Any] = {"source": src, "tag": tag, "tensors": {}, "optimizer": {}}
+
+    # model weights: prefer fp32 master
+    weights_tree = restored.get("master_params") or restored.get("params")
+    if weights_tree is None:
+        raise ValueError(f"{src} has no params/master_params")
+    weights = {k: np.asarray(v, np.float32) for k, v in _flatten(weights_tree).items()}
+    np.savez(os.path.join(output_dir, MODEL_FILE), **weights)
+    manifest["tensors"] = {k: {"shape": list(v.shape), "dtype": "float32"} for k, v in weights.items()}
+
+    # optimizer state: each param-shaped component gets its own npz
+    opt = restored.get("opt_state")
+    if opt is not None:
+        flat_opt = _flatten(opt)
+        by_component: Dict[str, Dict[str, np.ndarray]] = {}
+        scalars: Dict[str, float] = {}
+        for key, val in flat_opt.items():
+            arr = np.asarray(val)
+            head, _, rest = key.partition(".")
+            if rest and arr.ndim > 0:
+                by_component.setdefault(head, {})[rest] = arr.astype(np.float32)
+            else:
+                scalars[key] = arr.item() if arr.size == 1 else arr.tolist()
+        for comp, tensors in by_component.items():
+            np.savez(os.path.join(output_dir, f"{OPT_PREFIX}{comp}.npz"), **tensors)
+            manifest["optimizer"][comp] = sorted(tensors)
+        manifest["optimizer_scalars"] = scalars
+
+    # training counters / engine metadata travel along
+    meta_path = os.path.join(src, "ds_metadata.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            manifest["engine_metadata"] = json.load(fh)
+
+    with open(os.path.join(output_dir, MANIFEST), "w") as fh:
+        json.dump(manifest, fh, indent=1, default=str)
+    return manifest
+
+
+class UniversalCheckpoint:
+    """Inspect / load a universal checkpoint directory (reference:
+    DeepSpeedCheckpoint deepspeed_checkpoint.py:33 — minus the rank-file
+    geometry, which doesn't exist in this format)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        with open(os.path.join(self.path, MANIFEST)) as fh:
+            self.manifest = json.load(fh)
+
+    def tensor_names(self):
+        return sorted(self.manifest["tensors"])
+
+    def get_tensor(self, name: str) -> np.ndarray:
+        with np.load(os.path.join(self.path, MODEL_FILE)) as z:
+            return z[name]
+
+    def load_weights(self) -> Dict[str, np.ndarray]:
+        with np.load(os.path.join(self.path, MODEL_FILE)) as z:
+            return {k: z[k] for k in z.files}
+
+    def optimizer_components(self):
+        return sorted(self.manifest.get("optimizer", {}))
+
+    def load_optimizer_component(self, comp: str) -> Dict[str, np.ndarray]:
+        with np.load(os.path.join(self.path, f"{OPT_PREFIX}{comp}.npz")) as z:
+            return {k: z[k] for k in z.files}
+
+    @property
+    def engine_metadata(self) -> Dict[str, Any]:
+        return self.manifest.get("engine_metadata", {})
+
+
+def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix: str = ""):
+    """Rebuild a pytree shaped like ``template`` from dotted-name arrays."""
+    if isinstance(template, dict):
+        return {k: _unflatten_into(v, flat, f"{prefix}{k}.") for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        return type(template)(
+            _unflatten_into(v, flat, f"{prefix}{i}.") for i, v in enumerate(template)
+        )
+    key = prefix[:-1]
+    if key not in flat:
+        raise KeyError(f"universal checkpoint missing tensor '{key}'")
+    return flat[key]
+
+
+def load_universal_into_engine(engine, path: str, load_optimizer_states: bool = True):
+    """Resume an engine from a universal checkpoint, resharding to the
+    engine's current mesh/stage (reference: engine.load_checkpoint with
+    --universal-checkpoint flag; reshape is jax.device_put here)."""
+    import jax
+
+    ckpt = UniversalCheckpoint(path)
+    weights = ckpt.load_weights()
+
+    target = engine.master_params if engine.master_params is not None else engine.params
+    rebuilt = _unflatten_into(target, weights)
+    placed = jax.tree.map(
+        lambda leaf, arr: jax.device_put(np.asarray(arr, np.float32), leaf.sharding), target, rebuilt
+    )
+    if engine.master_params is not None:
+        engine.master_params = placed
+        engine.params = jax.jit(
+            lambda p: jax.tree.map(lambda x: x.astype(engine.model_dtype), p),
+            out_shardings=engine.param_shardings,
+        )(placed)
+    else:
+        engine.params = jax.tree.map(
+            lambda leaf, arr: jax.device_put(np.asarray(arr, leaf.dtype), leaf.sharding),
+            engine.params,
+            rebuilt,
+        )
+
+    if load_optimizer_states and engine.opt_state is not None and ckpt.optimizer_components():
+        state = engine.opt_state
+        replaced = {}
+        for comp in ckpt.optimizer_components():
+            sub = getattr(state, comp, None)
+            if sub is None:
+                continue
+            tensors = ckpt.load_optimizer_component(comp)
+            rebuilt_c = _unflatten_into(sub, tensors)
+            replaced[comp] = jax.tree.map(
+                lambda leaf, arr: jax.device_put(np.asarray(arr, np.float32), leaf.sharding),
+                sub,
+                rebuilt_c,
+            )
+        scalars = ckpt.manifest.get("optimizer_scalars", {})
+        kwargs = dict(replaced)
+        for name, val in scalars.items():
+            if hasattr(state, name) and name not in kwargs:
+                leaf = getattr(state, name)
+                kwargs[name] = jax.device_put(np.asarray(val, leaf.dtype), leaf.sharding)
+        engine.opt_state = state._replace(**kwargs) if hasattr(state, "_replace") else state
+
+    meta = ckpt.engine_metadata
+    engine.global_steps = int(meta.get("global_steps", engine.global_steps) or 0)
+    engine.global_samples = int(meta.get("global_samples", engine.global_samples) or 0)
+    return meta

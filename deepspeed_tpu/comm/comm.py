@@ -111,6 +111,7 @@ def init_distributed(
     """
     if _STATE.initialized and mesh_shape is None:
         return _STATE.mesh
+    _maybe_init_multi_controller()
     mesh = build_mesh(mesh_shape, devices)
     _STATE.mesh = mesh
     _STATE.initialized = True
@@ -122,6 +123,34 @@ def init_distributed(
     if verbose:
         log_dist(f"Initialized mesh {dict(mesh.shape)} over {mesh.devices.size} {dist_backend} devices", ranks=[0])
     return mesh
+
+
+_MULTI_CONTROLLER_DONE = False
+
+
+def _maybe_init_multi_controller():
+    """Join the JAX coordinator when launched by dstpu (launcher/launch.py
+    sets DSTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID — the reference's
+    MASTER_ADDR/RANK rendezvous, comm/comm.py:526)."""
+    global _MULTI_CONTROLLER_DONE
+    if _MULTI_CONTROLLER_DONE:
+        return
+    coord = os.environ.get("DSTPU_COORDINATOR")
+    nprocs = int(os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+    if not coord or nprocs <= 1:
+        _MULTI_CONTROLLER_DONE = True
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nprocs,
+            process_id=int(os.environ["DSTPU_PROCESS_ID"]),
+        )
+        log_dist(f"joined coordinator {coord} as process "
+                 f"{os.environ['DSTPU_PROCESS_ID']}/{nprocs}", ranks=[0])
+    except Exception as e:  # already initialized or single-process fallback
+        logger.warning(f"jax.distributed.initialize skipped: {e}")
+    _MULTI_CONTROLLER_DONE = True
 
 
 def destroy():

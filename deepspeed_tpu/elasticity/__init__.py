@@ -1,0 +1,19 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_best_candidate_batch_size,
+    get_valid_gpus,
+)
+
+__all__ = [
+    "ElasticityConfig",
+    "ElasticityConfigError",
+    "ElasticityError",
+    "ElasticityIncompatibleWorldSize",
+    "compute_elastic_config",
+    "get_best_candidate_batch_size",
+    "get_valid_gpus",
+]

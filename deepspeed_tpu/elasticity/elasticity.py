@@ -1,0 +1,128 @@
+"""Elastic training batch-size math.
+
+TPU-native counterpart of the reference's elasticity v1
+(elasticity/elasticity.py:233 ``compute_elastic_config``,
+``get_compatible_gpus``): choose a ``train_batch_size`` that stays valid for
+every chip count in [min, max], so checkpoints survive rescaling of the pod
+slice. The algorithm is the reference's: enumerate candidate batch sizes as
+micro_batch x power-of-two accumulation steps up to the cap, score by
+(divisible chip counts, batch size), pick the best.
+
+v2 (torchelastic agent restarts) maps to re-running the dstpu launcher on the
+new slice and resuming from the universal checkpoint — the resharding that
+torchelastic needs agent machinery for is a plain restore here
+(checkpoint/universal_checkpoint.py).
+"""
+
+from typing import Dict, List, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+class ElasticityConfig:
+    """Parsed 'elasticity' config block (reference elasticity/config.py)."""
+
+    def __init__(self, param_dict: Dict):
+        self.enabled = param_dict.get("enabled", False)
+        self.max_train_batch_size = int(param_dict.get("max_train_batch_size", 2000))
+        mbs = param_dict.get("micro_batch_sizes", [2, 4, 6])
+        self.micro_batches = [int(m) for m in mbs]
+        if any(m <= 0 for m in self.micro_batches):
+            raise ElasticityConfigError(f"micro_batch_sizes must be positive: {self.micro_batches}")
+        self.min_gpus = int(param_dict.get("min_gpus", 1))
+        self.max_gpus = int(param_dict.get("max_gpus", 10000))
+        if self.min_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(f"bad gpu range [{self.min_gpus}, {self.max_gpus}]")
+        self.min_time = int(param_dict.get("min_time", 0))
+        self.version = float(param_dict.get("version", LATEST_ELASTICITY_VERSION))
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get("ignore_non_elastic_batch_info", False)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts that evenly consume ``batch_size`` with some micro batch
+    (reference elasticity.py get_valid_gpus)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        max_steps = batch_size // mb
+        for ngpu in range(min_gpus, min(max_gpus, max_steps) + 1):
+            if max_steps % ngpu == 0:
+                valid.add(ngpu)
+    return sorted(valid)
+
+
+def get_best_candidate_batch_size(
+    max_batch: int, micro_batches: List[int], min_gpus: int, max_gpus: int, prefer_larger: bool = True
+) -> Tuple[int, List[int]]:
+    """Search candidate batch sizes (micro x 2^k, and micro x max_acc grid),
+    maximizing the number of valid chip counts (reference
+    _get_compatible_gpus_v01)."""
+    candidates = set()
+    for mb in micro_batches:
+        steps = 1
+        while mb * steps <= max_batch:
+            candidates.add(mb * steps)
+            steps *= 2
+        if max_batch >= mb:
+            candidates.add((max_batch // mb) * mb)
+    best: Tuple[int, int] = (-1, -1)  # (num_valid, batch)
+    best_valid: List[int] = []
+    for batch in sorted(candidates, reverse=prefer_larger):
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        key = (len(valid), batch if prefer_larger else -batch)
+        if key > (best[0], best[1] if prefer_larger else -best[1]):
+            best = (len(valid), batch)
+            best_valid = valid
+    if best[1] < 0 or not best_valid:
+        raise ElasticityConfigError(
+            f"no feasible batch size <= {max_batch} for micro batches {micro_batches} "
+            f"with chip range [{min_gpus}, {max_gpus}]"
+        )
+    return best[1], best_valid
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "", world_size: int = 0):
+    """Reference API (elasticity.py:233): returns
+    (final_batch_size, valid_gpus, micro_batch_per_gpu[, gradient_accumulation]).
+    If ``world_size`` > 0, also validates it and resolves the micro batch."""
+    block = ds_config.get("elasticity")
+    if block is None:
+        raise ElasticityConfigError("'elasticity' block missing from config")
+    cfg = ElasticityConfig(block)
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity.enabled is false")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(f"unsupported elasticity version {cfg.version}")
+
+    final_batch, valid_gpus = get_best_candidate_batch_size(
+        cfg.max_train_batch_size, cfg.micro_batches, cfg.min_gpus, cfg.max_gpus,
+        prefer_larger=cfg.prefer_larger_batch_size,
+    )
+    if world_size <= 0:
+        return final_batch, valid_gpus, None
+    if world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in elastic-compatible counts {valid_gpus}"
+        )
+    # largest micro batch that fits: batch = micro * gas * world
+    for mb in sorted(cfg.micro_batches, reverse=True):
+        if final_batch % (mb * world_size) == 0:
+            return final_batch, valid_gpus, mb
+    raise ElasticityIncompatibleWorldSize(
+        f"no micro batch in {cfg.micro_batches} divides {final_batch} over {world_size} chips"
+    )

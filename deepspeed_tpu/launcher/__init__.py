@@ -1,0 +1,2 @@
+"""Launcher / CLI (reference: deepspeed/launcher/): dstpu runner spawning
+per-host launchers over ssh / slurm / gcloud TPU pods."""

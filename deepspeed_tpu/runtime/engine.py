@@ -447,8 +447,16 @@ class TpuEngine:
             return batch
         out = dict(batch)
         for key in self._SEQ_KEYS:
-            if key in out and getattr(out[key], "ndim", 0) >= 2 and out[key].shape[1] > seqlen:
-                out[key] = out[key][:, :seqlen]
+            if key not in out:
+                continue
+            arr = out[key]
+            ndim = getattr(arr, "ndim", 0)
+            if ndim == 4 and key == "attention_mask":
+                # broadcastable (B, 1, S, S) mask: truncate both seq dims
+                if arr.shape[2] > seqlen or arr.shape[3] > seqlen:
+                    out[key] = arr[:, :, :seqlen, :seqlen]
+            elif ndim >= 2 and arr.shape[1] > seqlen:
+                out[key] = arr[:, :seqlen]
         return out
 
     def forward(self, batch, rng=None):
