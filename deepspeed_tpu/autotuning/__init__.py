@@ -1,0 +1,7 @@
+"""Autotuning (reference: deepspeed/autotuning/): ZeRO-stage / micro-batch /
+remat search over a model-based memory estimate, optionally measured."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, Candidate
+from deepspeed_tpu.autotuning.estimator import MemoryEstimate, estimate_memory
+
+__all__ = ["Autotuner", "Candidate", "MemoryEstimate", "estimate_memory"]

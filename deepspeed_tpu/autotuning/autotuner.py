@@ -1,0 +1,111 @@
+"""Autotuner: ZeRO-stage / micro-batch / remat search.
+
+TPU-native counterpart of the reference's ``Autotuner``
+(autotuning/autotuner.py: generate experiment configs from tuning space,
+prune by model-based memory, run and rank by metric). The experiment unit
+here is a jit-compile + timed step via a caller-provided ``run_fn`` (no
+subprocess resource manager needed — a compile either fits HBM or raises),
+and "fast" mode ranks purely on the memory model, preferring the lowest
+ZeRO stage that fits with the largest micro batch (less collective traffic,
+bigger MXU batches).
+"""
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.autotuning.estimator import estimate_memory
+from deepspeed_tpu.utils.logging import log_dist
+
+DEFAULT_TUNING_SPACE = {
+    "zero_stage": [0, 1, 2, 3],
+    "micro_batch": [1, 2, 4, 8, 16, 32],
+    "remat": [True, False],
+}
+
+
+@dataclass
+class Candidate:
+    zero_stage: int
+    micro_batch: int
+    remat: bool
+    est_total_gb: float = 0.0
+    measured_metric: Optional[float] = None  # e.g. tokens/sec (higher better)
+
+    def to_config_patch(self) -> Dict[str, Any]:
+        return {
+            "zero_optimization": {"stage": self.zero_stage},
+            "train_micro_batch_size_per_gpu": self.micro_batch,
+            "activation_checkpointing": {"policy": "nothing_saveable" if self.remat else "full"},
+        }
+
+
+@dataclass
+class Autotuner:
+    """mode='fast': memory-model ranking only; mode='measured': call
+    ``run_fn(candidate) -> metric`` for the fitting ones (reference
+    experiment runner)."""
+
+    num_params: float
+    hbm_bytes: float
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    seq_len: int = 2048
+    hidden: int = 4096
+    num_layers: int = 32
+    tuning_space: Dict[str, List] = field(default_factory=lambda: dict(DEFAULT_TUNING_SPACE))
+    headroom: float = 0.9  # usable fraction of HBM (XLA scratch/fragmentation)
+
+    def candidates(self) -> List[Candidate]:
+        out = []
+        for stage, mb, remat in itertools.product(
+            self.tuning_space["zero_stage"],
+            self.tuning_space["micro_batch"],
+            self.tuning_space["remat"],
+        ):
+            est = estimate_memory(
+                self.num_params, fsdp=self.fsdp, tp=self.tp, zero_stage=stage,
+                micro_batch=mb, seq_len=self.seq_len, hidden=self.hidden,
+                num_layers=self.num_layers, remat=remat, sp=self.sp,
+            )
+            out.append(Candidate(stage, mb, remat, est_total_gb=est.total / 1024**3))
+        return out
+
+    def feasible(self) -> List[Candidate]:
+        budget_gb = self.hbm_bytes * self.headroom / 1024**3
+        return [c for c in self.candidates() if c.est_total_gb <= budget_gb]
+
+    @staticmethod
+    def _fast_key(c: Candidate):
+        # prefer: larger micro batch (MXU), then lower stage (fewer
+        # collectives), then no remat (fewer recompute flops)
+        return (c.micro_batch, -c.zero_stage, not c.remat)
+
+    def tune(self, run_fn: Optional[Callable[[Candidate], float]] = None,
+             max_trials: int = 8) -> Candidate:
+        feasible = self.feasible()
+        if not feasible:
+            raise RuntimeError(
+                f"no candidate fits {self.hbm_bytes/1024**3:.1f} GB HBM; "
+                "grow the mesh (fsdp/tp) or shrink the model"
+            )
+        feasible.sort(key=self._fast_key, reverse=True)
+        if run_fn is None:
+            best = feasible[0]
+            log_dist(f"autotuner(fast): {best}", ranks=[0])
+            return best
+        best, best_metric = None, float("-inf")
+        for cand in feasible[:max_trials]:
+            try:
+                metric = run_fn(cand)
+            except Exception as e:  # OOM at compile/run -> infeasible
+                log_dist(f"autotuner: candidate {cand} failed ({e})", ranks=[0])
+                continue
+            cand.measured_metric = metric
+            if metric > best_metric:
+                best, best_metric = cand, metric
+        if best is None:
+            raise RuntimeError("all measured candidates failed")
+        log_dist(f"autotuner(measured): {best} metric={best_metric}", ranks=[0])
+        return best

@@ -1,0 +1,24 @@
+"""Compression (reference: deepspeed/compression/): QAT, pruning (sparse/
+row/head/channel), layer reduction — as functional param transforms."""
+
+from deepspeed_tpu.compression.compress import (
+    CompressedModel,
+    Compressor,
+    init_compression,
+    redundancy_clean,
+)
+from deepspeed_tpu.compression.config import CompressionConfig
+from deepspeed_tpu.compression.helper import (
+    init_student_params_from_teacher,
+    student_layer_map,
+)
+
+__all__ = [
+    "CompressedModel",
+    "Compressor",
+    "CompressionConfig",
+    "init_compression",
+    "redundancy_clean",
+    "init_student_params_from_teacher",
+    "student_layer_map",
+]

@@ -1,0 +1,185 @@
+"""Compression entry points.
+
+TPU-native counterpart of the reference's ``compression/compress.py``
+(:214 — ``init_compression`` walks the module tree swapping layers for
+compress-capable subclasses; ``redundancy_clean`` bakes the masks in). The
+functional redesign: ``init_compression`` wraps the engine-protocol model so
+its loss sees *transformed* params (fake-quant / pruning masks applied to
+matching leaves), and ``redundancy_clean`` applies the same transforms
+destructively to produce a final compressed param tree.
+
+Module matching: reference configs name torch modules; here patterns match
+dotted param paths (fnmatch, e.g. "layers.attn.*" or "*wq").
+"""
+
+import fnmatch
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from deepspeed_tpu.compression import ops
+from deepspeed_tpu.compression.config import CompressionConfig, FeatureBlock
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    return any(fnmatch.fnmatch(path, p) or p in path for p in patterns)
+
+
+def _path_str(path) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+class Compressor:
+    """Param-tree transform assembled from a CompressionConfig.
+
+    ``stacked_keys``: top-level subtrees whose leaves carry leading stack
+    dims (the flagship model stacks per-layer params as (L, ...) and MoE
+    experts as (L, E, ...), models/transformer.py). Techniques are vmapped
+    over those dims so each layer/expert gets its OWN mask and scales — the
+    per-module behavior of the reference's swapped layers.
+    """
+
+    def __init__(self, config: CompressionConfig, num_heads: int = 12,
+                 stacked_keys=("layers",)):
+        self.config = config
+        self.num_heads = num_heads
+        self.stacked_keys = tuple(stacked_keys)
+        self.step = 0  # python-level; crossing an offset recompiles once
+
+    def set_step(self, step: int):
+        self.step = step
+
+    def _active(self, block: FeatureBlock) -> bool:
+        return block.enabled and self.step >= block.schedule_offset
+
+    def _leaf_fns(self, path: str, eff_ndim: int):
+        """Composed transform for one (logical, unstacked) leaf; None if no
+        technique matches."""
+        cfg = self.config
+        fns = []
+        if self._active(cfg.weight_quantization) and eff_ndim >= 2:
+            for g in cfg.weight_quantization.groups():
+                if _match(path, g.modules):
+                    bits, sym = g.bits, g.params.get("quantization_type", "symmetric") == "symmetric"
+                    groups = int(g.params.get("quantize_groups", 1))
+                    fns.append(lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(w, b, s, ng))
+                    break
+        if self._active(cfg.sparse_pruning):
+            for g in cfg.sparse_pruning.groups():
+                if _match(path, g.modules):
+                    fns.append(lambda w, r=float(g.params.get("dense_ratio", 0.5)): ops.sparse_prune_ste(w, r))
+                    break
+        if self._active(cfg.row_pruning) and eff_ndim >= 2:
+            for g in cfg.row_pruning.groups():
+                if _match(path, g.modules):
+                    fns.append(lambda w, r=float(g.params.get("dense_ratio", 0.5)): ops.row_prune_ste(w, r))
+                    break
+        if self._active(cfg.head_pruning) and eff_ndim >= 2:
+            for g in cfg.head_pruning.groups():
+                if _match(path, g.modules):
+                    fns.append(
+                        lambda w, r=float(g.params.get("dense_ratio", 0.5)), h=self.num_heads: ops.head_prune_ste(w, r, h)
+                    )
+                    break
+        if self._active(cfg.channel_pruning) and eff_ndim >= 2:
+            for g in cfg.channel_pruning.groups():
+                if _match(path, g.modules):
+                    fns.append(lambda w, r=float(g.params.get("dense_ratio", 0.5)): ops.channel_prune_ste(w, r))
+                    break
+        if not fns:
+            return None
+
+        def composed(w):
+            for f in fns:
+                w = f(w)
+            return w
+
+        return composed
+
+    def transform_params(self, params):
+        """Apply all active weight-side techniques to matching leaves."""
+
+        def leaf(path, w):
+            if w.ndim < 1:
+                return w
+            p = _path_str(path)
+            top = str(getattr(path[0], "key", getattr(path[0], "idx", path[0]))) if path else ""
+            # every leaf under a stacked subtree carries a leading L dim
+            # (and MoE expert leaves a second E dim): vmap over them so each
+            # layer/expert gets its own mask and scales
+            stack_levels = 0
+            if top in self.stacked_keys and w.ndim >= 2:
+                stack_levels = 1 + (1 if w.ndim >= 4 else 0)
+            fn = self._leaf_fns(p, w.ndim - stack_levels)
+            if fn is None:
+                return w
+            for _ in range(stack_levels):
+                fn = jax.vmap(fn)
+            return fn(w)
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+class CompressedModel:
+    """Engine-protocol wrapper: loss() sees compressed params
+    (reference: layers swapped by init_compression)."""
+
+    def __init__(self, model, compressor: Compressor):
+        self.model = model
+        self.compressor = compressor
+        self.cfg = getattr(model, "cfg", None)
+
+    def init(self, rng):
+        return self.model.init(rng)
+
+    def loss(self, params, batch, rng=None):
+        return self.model.loss(self.compressor.transform_params(params), batch, rng)
+
+    def logical_specs(self, abstract_params):
+        if hasattr(self.model, "logical_specs"):
+            return self.model.logical_specs(abstract_params)
+        return None
+
+    def __getattr__(self, name):
+        return getattr(self.model, name)
+
+
+def init_compression(model, deepspeed_config: Dict[str, Any], num_heads: Optional[int] = None):
+    """Wrap an engine-protocol model with compression
+    (reference compress.py init_compression). Returns (model, compressor)."""
+    config = CompressionConfig.parse(deepspeed_config)
+    if not config.any_enabled():
+        return model, None
+    heads = num_heads or getattr(getattr(model, "cfg", None), "num_heads", 12)
+    compressor = Compressor(config, num_heads=heads)
+    if config.layer_reduction.enabled:
+        log_dist("layer_reduction: use helper.init_student_params_from_teacher on the teacher tree", ranks=[0])
+    if config.activation_quantization.enabled:
+        # activation quant lives inside the forward (reference swaps layers
+        # for QuantAct-wrapped ones); the builtin transformer has a cfg hook,
+        # custom models must call ops.quantize_activation_ste themselves
+        from deepspeed_tpu.models import transformer as tf
+
+        groups = config.activation_quantization.groups()
+        bits = groups[0].bits if groups else 8
+        if isinstance(model, tf.TransformerModel):
+            import dataclasses
+
+            model = tf.TransformerModel(dataclasses.replace(model.cfg, act_quant_bits=bits))
+        else:
+            logger.warning(
+                "activation_quantization enabled but the model is not the builtin "
+                "TransformerModel; wire ops.quantize_activation_ste into its forward "
+                "or activations will NOT be quantized"
+            )
+    return CompressedModel(model, compressor), compressor
+
+
+def redundancy_clean(params, deepspeed_config: Dict[str, Any], num_heads: int = 12):
+    """Bake compression into the weights for deployment
+    (reference compress.py redundancy_clean)."""
+    config = CompressionConfig.parse(deepspeed_config)
+    compressor = Compressor(config, num_heads=num_heads)
+    compressor.step = 10**9  # everything past its offset
+    return jax.tree.map(jax.lax.stop_gradient, compressor.transform_params(params))

@@ -1,0 +1,108 @@
+"""Compression config schema (reference: deepspeed/compression/config.py /
+constants.py — same JSON block names under ``compression_training``)."""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class QuantizationGroup:
+    """One 'different_groups' entry: which params, at what precision."""
+
+    params: Dict[str, Any] = field(default_factory=dict)
+    modules: List[str] = field(default_factory=lambda: ["*"])
+    related_modules: Optional[List[str]] = None
+
+    @property
+    def bits(self) -> int:
+        # reference key: start_bits/target_bits for schedule; here target
+        return int(self.params.get("target_bits", self.params.get("bits", 8)))
+
+
+@dataclass
+class FeatureBlock:
+    enabled: bool = False
+    shared_parameters: Dict[str, Any] = field(default_factory=dict)
+    different_groups: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared_parameters.get("schedule_offset", 0))
+
+    def groups(self) -> List[QuantizationGroup]:
+        out = []
+        for _, g in sorted(self.different_groups.items()):
+            out.append(
+                QuantizationGroup(
+                    params=g.get("params", {}),
+                    modules=g.get("modules", ["*"]),
+                    related_modules=g.get("related_modules"),
+                )
+            )
+        return out
+
+
+@dataclass
+class LayerReductionBlock:
+    enabled: bool = False
+    keep_number_layer: int = 0
+    module_name_prefix: str = "layers"
+    teacher_layer: List[int] = field(default_factory=list)
+    other_module_name: List[str] = field(default_factory=list)
+
+
+@dataclass
+class CompressionConfig:
+    weight_quantization: FeatureBlock = field(default_factory=FeatureBlock)
+    activation_quantization: FeatureBlock = field(default_factory=FeatureBlock)
+    sparse_pruning: FeatureBlock = field(default_factory=FeatureBlock)
+    row_pruning: FeatureBlock = field(default_factory=FeatureBlock)
+    head_pruning: FeatureBlock = field(default_factory=FeatureBlock)
+    channel_pruning: FeatureBlock = field(default_factory=FeatureBlock)
+    layer_reduction: LayerReductionBlock = field(default_factory=LayerReductionBlock)
+
+    @classmethod
+    def parse(cls, config: Dict[str, Any]) -> "CompressionConfig":
+        block = config.get("compression_training", config) or {}
+
+        def fb(name):
+            sub = dict(block.get(name, {}))
+            shared = sub.get("shared_parameters", {})
+            # reference schema puts 'enabled' under shared_parameters; accept
+            # a top-level key too, defaulting to "groups present"
+            enabled = shared.get("enabled", sub.get("enabled", bool(sub.get("different_groups"))))
+            return FeatureBlock(
+                enabled=enabled,
+                shared_parameters=shared,
+                different_groups=sub.get("different_groups", {}),
+            )
+
+        lr = dict(block.get("layer_reduction", {}))
+        return cls(
+            weight_quantization=fb("weight_quantization"),
+            activation_quantization=fb("activation_quantization"),
+            sparse_pruning=fb("sparse_pruning"),
+            row_pruning=fb("row_pruning"),
+            head_pruning=fb("head_pruning"),
+            channel_pruning=fb("channel_pruning"),
+            layer_reduction=LayerReductionBlock(
+                enabled=lr.get("enabled", False),
+                keep_number_layer=int(lr.get("keep_number_layer", 0)),
+                module_name_prefix=lr.get("module_name_prefix", "layers"),
+                teacher_layer=list(lr.get("teacher_layer", [])),
+                other_module_name=list(lr.get("other_module_name", [])),
+            ),
+        )
+
+    def any_enabled(self) -> bool:
+        return any(
+            b.enabled
+            for b in (
+                self.weight_quantization,
+                self.activation_quantization,
+                self.sparse_pruning,
+                self.row_pruning,
+                self.head_pruning,
+                self.channel_pruning,
+            )
+        ) or self.layer_reduction.enabled

@@ -1,0 +1,38 @@
+"""Layer-reduction (distillation student init).
+
+TPU-native counterpart of the reference's ``compression/helper.py``
+(student initialized from selected teacher layers for layer-reduction
+distillation). With stacked-layer param trees (leaves carry a leading L
+dim, models/transformer.py), selecting teacher layers is one gather per
+leaf — no per-module copying.
+"""
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_student_params_from_teacher(teacher_params, teacher_layers: Sequence[int],
+                                     layer_key: str = "layers"):
+    """Build a student param tree keeping only ``teacher_layers`` of the
+    stacked per-layer leaves (reference teacher_layer list semantics)."""
+    idx = jnp.asarray(list(teacher_layers), jnp.int32)
+
+    def pick(tree):
+        return jax.tree.map(lambda leaf: jnp.take(leaf, idx, axis=0), tree)
+
+    out = dict(teacher_params)
+    if layer_key not in out:
+        raise KeyError(f"param tree has no '{layer_key}' subtree to reduce")
+    out[layer_key] = pick(out[layer_key])
+    return out
+
+
+def student_layer_map(num_teacher_layers: int, keep_number_layer: int) -> List[int]:
+    """Default evenly-spaced teacher layer selection (reference behavior when
+    teacher_layer is unspecified)."""
+    if keep_number_layer >= num_teacher_layers:
+        return list(range(num_teacher_layers))
+    step = num_teacher_layers / keep_number_layer
+    return [min(num_teacher_layers - 1, int(i * step)) for i in range(keep_number_layer)]

@@ -1,0 +1,111 @@
+"""Compression transforms: QAT fake-quant and pruning masks.
+
+TPU-native counterpart of the reference's ``compression/basic_layer.py``
+(840 LoC of LinearLayer_Compress subclasses holding quantizer/pruner state).
+Functional redesign: each technique is a pure transform ``w -> w'`` applied
+to matching leaves of the param pytree inside the jitted loss — XLA fuses
+the mask/quant math into the consumer matmul, so there is no runtime cost
+beyond the op itself and no module surgery.
+
+Straight-through estimation (reference's QuantAct/Symmetric/Asymmetric
+autograd fns): ``w + stop_gradient(q(w) - w)`` — exact STE without a
+custom_vjp.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ste(transformed: jnp.ndarray, original: jnp.ndarray) -> jnp.ndarray:
+    """Straight-through: forward value of ``transformed``, gradient of
+    ``original``."""
+    return original + jax.lax.stop_gradient(transformed - original)
+
+
+# ---------------------------------------------------------------------------
+# quantization (reference: basic_layer Symmetric/AsymmetricQuantizer)
+# ---------------------------------------------------------------------------
+
+def quantize_weight_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True,
+                        num_groups: int = 1) -> jnp.ndarray:
+    """Groupwise fake-quant with STE (QAT weight path)."""
+    orig_shape = w.shape
+    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / (2 ** (bits - 1) - 1)
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(flat / scale), -(2 ** (bits - 1)), 2 ** (bits - 1) - 1) * scale
+    else:
+        lo = jnp.min(flat, axis=1, keepdims=True)
+        hi = jnp.max(flat, axis=1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / (2**bits - 1), 1e-8)
+        q = jnp.round((flat - lo) / scale) * scale + lo
+    return ste(q.reshape(orig_shape), w)
+
+
+def quantize_activation_ste(x: jnp.ndarray, bits: int = 8, symmetric: bool = False) -> jnp.ndarray:
+    """Dynamic per-tensor activation fake-quant (reference QuantAct)."""
+    if symmetric:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / (2 ** (bits - 1) - 1)
+        q = jnp.clip(jnp.round(x / scale), -(2 ** (bits - 1)), 2 ** (bits - 1) - 1) * scale
+    else:
+        lo, hi = jnp.min(x), jnp.max(x)
+        scale = jnp.maximum((hi - lo) / (2**bits - 1), 1e-8)
+        q = jnp.round((x - lo) / scale) * scale + lo
+    return ste(q, x)
+
+
+# ---------------------------------------------------------------------------
+# pruning (reference: basic_layer SparsePruningMask / row / head)
+# ---------------------------------------------------------------------------
+
+def sparse_prune_ste(w: jnp.ndarray, dense_ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Unstructured magnitude pruning keeping the top ``dense_ratio`` weights."""
+    if dense_ratio >= 1.0:
+        return w
+    k = max(1, int(round(w.size * dense_ratio)))
+    mag = jnp.abs(w).reshape(-1)
+    threshold = jnp.sort(mag)[-k]
+    mask = (jnp.abs(w) >= threshold).astype(w.dtype)
+    return ste(w * mask, w)
+
+
+def row_prune_ste(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured pruning of output rows by l1 norm (reference row_pruning;
+    rows = last dim here, the output-features dim of (in, out) kernels)."""
+    if dense_ratio >= 1.0 or w.ndim < 2:
+        return w
+    out_dim = w.shape[-1]
+    k = max(1, int(round(out_dim * dense_ratio)))
+    norms = jnp.sum(jnp.abs(w.reshape(-1, out_dim)), axis=0)
+    threshold = jnp.sort(norms)[-k]
+    mask = (norms >= threshold).astype(w.dtype)
+    return ste(w * mask, w)
+
+
+def head_prune_ste(w: jnp.ndarray, dense_ratio: float, num_heads: int) -> jnp.ndarray:
+    """Attention-head pruning: mask whole head blocks of the (D, H*hd)
+    projection by block l1 norm (reference head_pruning on attn outputs)."""
+    if dense_ratio >= 1.0 or w.ndim < 2 or w.shape[-1] % num_heads != 0:
+        return w
+    head_dim = w.shape[-1] // num_heads
+    k = max(1, int(round(num_heads * dense_ratio)))
+    blocks = w.reshape(-1, num_heads, head_dim)
+    norms = jnp.sum(jnp.abs(blocks), axis=(0, 2))
+    threshold = jnp.sort(norms)[-k]
+    mask = jnp.repeat((norms >= threshold).astype(w.dtype), head_dim)
+    return ste(w * mask, w)
+
+
+def channel_prune_ste(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Input-channel pruning (first dim of (in, out) kernels)."""
+    if dense_ratio >= 1.0 or w.ndim < 2:
+        return w
+    in_dim = w.shape[0]
+    k = max(1, int(round(in_dim * dense_ratio)))
+    norms = jnp.sum(jnp.abs(w.reshape(in_dim, -1)), axis=1)
+    threshold = jnp.sort(norms)[-k]
+    mask = (norms >= threshold).astype(w.dtype)
+    return ste(w * mask.reshape((in_dim,) + (1,) * (w.ndim - 1)), w)

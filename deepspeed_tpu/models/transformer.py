@@ -64,6 +64,8 @@ class TransformerConfig:
     moe_use_rts: bool = False  # random token selection needs an rng at loss()
     # --- sequence/context parallelism (parallel/sequence.py) ---
     seq_parallel: str = "none"  # none | ring | ulysses
+    # --- QAT activation fake-quant bits, 0 = off (compression/ wiring) ---
+    act_quant_bits: int = 0
 
     @property
     def head_dim(self):
@@ -316,6 +318,10 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg)
+    if cfg.act_quant_bits > 0:
+        from deepspeed_tpu.compression.ops import quantize_activation_ste
+
+        h = quantize_activation_ste(h, bits=cfg.act_quant_bits)
     q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
     k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
     v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
@@ -337,6 +343,10 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     x = x + attn_out
 
     h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
+    if cfg.act_quant_bits > 0:
+        from deepspeed_tpu.compression.ops import quantize_activation_ste
+
+        h = quantize_activation_ste(h, bits=cfg.act_quant_bits)
     if cfg.moe_num_experts > 0:
         from deepspeed_tpu.moe.sharded_moe import moe_forward
 

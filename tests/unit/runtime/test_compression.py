@@ -1,0 +1,266 @@
+"""Compression + eigenvalue tests (reference: tests/unit/compression/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (
+    CompressionConfig,
+    init_compression,
+    init_student_params_from_teacher,
+    redundancy_clean,
+    student_layer_map,
+)
+from deepspeed_tpu.compression import ops
+
+
+class TestOps:
+    def test_quantize_weight_ste_values_and_grads(self):
+        w = jnp.linspace(-1.0, 1.0, 64).reshape(8, 8)
+        q = ops.quantize_weight_ste(w, bits=4)
+        # forward is quantized (few distinct values), grads are identity
+        assert len(np.unique(np.asarray(q))) <= 16
+        g = jax.grad(lambda x: jnp.sum(ops.quantize_weight_ste(x, bits=4) ** 2))(w)
+        # STE: d/dw sum(q(w)^2) = 2*q(w) (identity through the quantizer)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(2 * q), rtol=1e-5)
+
+    def test_quantize_groupwise(self):
+        w = jnp.concatenate([jnp.ones(32) * 0.01, jnp.ones(32) * 10.0]).reshape(8, 8)
+        q1 = ops.quantize_weight_ste(w, bits=8, num_groups=1)
+        q2 = ops.quantize_weight_ste(w, bits=8, num_groups=2)
+        # per-group scales preserve the small block much better
+        err1 = float(jnp.max(jnp.abs(q1[:4] - w[:4])))
+        err2 = float(jnp.max(jnp.abs(q2[:4] - w[:4])))
+        assert err2 < err1
+
+    def test_activation_quant(self):
+        x = jnp.linspace(0.0, 4.0, 100)
+        q = ops.quantize_activation_ste(x, bits=4)
+        assert len(np.unique(np.asarray(q))) <= 16
+
+    def test_sparse_prune(self):
+        w = jnp.arange(1.0, 101.0).reshape(10, 10)
+        p = ops.sparse_prune_ste(w, dense_ratio=0.3)
+        assert int((np.asarray(p) != 0).sum()) == 30
+        # largest magnitudes survive
+        assert float(p[9, 9]) == 100.0 and float(p[0, 0]) == 0.0
+
+    def test_row_prune(self):
+        w = jnp.stack([jnp.full((4,), float(i)) for i in range(1, 7)], axis=1)  # (4, 6)
+        p = ops.row_prune_ste(w, dense_ratio=0.5)
+        cols = np.asarray(jnp.sum(jnp.abs(p), axis=0))
+        assert int((cols > 0).sum()) == 3  # top-3 columns kept
+
+    def test_head_prune(self):
+        num_heads, head_dim = 4, 2
+        blocks = [jnp.full((8, head_dim), float(i)) for i in (5, 1, 4, 2)]
+        w = jnp.concatenate(blocks, axis=1)  # (8, 8)
+        p = ops.head_prune_ste(w, dense_ratio=0.5, num_heads=num_heads)
+        arr = np.asarray(p)
+        assert arr[:, 0:2].any() and arr[:, 4:6].any()  # heads 0, 2 kept
+        assert not arr[:, 2:4].any() and not arr[:, 6:8].any()
+
+    def test_channel_prune(self):
+        w = jnp.stack([jnp.full((6,), float(i)) for i in (3, 1, 5, 2)], axis=0)  # (4, 6)
+        p = ops.channel_prune_ste(w, dense_ratio=0.5)
+        rows = np.asarray(jnp.sum(jnp.abs(p), axis=1))
+        assert (rows > 0).tolist() == [True, False, True, False]
+
+
+WQ_CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"schedule_offset": 2},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 4, "quantize_groups": 1}, "modules": ["*w*"]}
+            },
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"schedule_offset": 0},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["*w*"]}
+            },
+        },
+    }
+}
+
+
+class _ToyModel:
+    cfg = None
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (8, 8)), "b": jnp.zeros((8,))}
+
+    def loss(self, params, batch, rng=None):
+        return jnp.mean((batch["x"] @ params["w"] + params["b"]) ** 2)
+
+
+class TestCompress:
+    def test_schedule_offset_gates_quantization(self):
+        model, compressor = init_compression(_ToyModel(), WQ_CONFIG, num_heads=2)
+        params = model.init(jax.random.PRNGKey(0))
+        # step 0: pruning active (offset 0), quantization not (offset 2)
+        compressor.set_step(0)
+        t0 = compressor.transform_params(params)
+        assert int((np.asarray(t0["w"]) != 0).sum()) == 32
+        distinct0 = len(np.unique(np.asarray(t0["w"])))
+        compressor.set_step(5)
+        t5 = compressor.transform_params(params)
+        distinct5 = len(np.unique(np.asarray(t5["w"])))
+        assert distinct5 < distinct0  # 4-bit quant now engaged
+
+    def test_wrapped_loss_differs_and_differentiable(self):
+        model, compressor = init_compression(_ToyModel(), WQ_CONFIG, num_heads=2)
+        compressor.set_step(5)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = {"x": jnp.ones((4, 8))}
+        base = _ToyModel().loss(params, batch)
+        comp = model.loss(params, batch)
+        assert not np.isclose(float(base), float(comp))
+        g = jax.grad(lambda p: model.loss(p, batch))(params)
+        assert float(sum(jnp.sum(jnp.abs(l)) for l in jax.tree.leaves(g))) > 0
+
+    def test_disabled_config_returns_model(self):
+        model, compressor = init_compression(_ToyModel(), {})
+        assert compressor is None
+        assert isinstance(model, _ToyModel)
+
+    def test_redundancy_clean(self):
+        params = {"w": jnp.arange(1.0, 65.0).reshape(8, 8), "b": jnp.zeros((8,))}
+        cleaned = redundancy_clean(params, WQ_CONFIG, num_heads=2)
+        assert int((np.asarray(cleaned["w"]) == 0).sum()) >= 32
+
+    def test_engine_integration(self, mesh8):
+        import deepspeed_tpu
+
+        model, compressor = init_compression(_ToyModel(), WQ_CONFIG, num_heads=2)
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "mesh": {"data": 1, "fsdp": -1},
+        }
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+        batch = {"x": np.ones((8, 8), np.float32)}
+        losses = []
+        for _ in range(5):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
+class TestStackedLayers:
+    def test_per_layer_masks_on_stacked_params(self):
+        """Stacked (L, in, out) leaves must be pruned per layer, never across
+        the layer dim (regression: channel pruning zeroed whole layers)."""
+        from deepspeed_tpu.compression.compress import Compressor
+        from deepspeed_tpu.compression.config import CompressionConfig
+
+        cfg = CompressionConfig.parse({
+            "compression_training": {
+                "channel_pruning": {
+                    "different_groups": {"c1": {"params": {"dense_ratio": 0.5}, "modules": ["*w*"]}}
+                }
+            }
+        })
+        comp = Compressor(cfg, num_heads=2)
+        # layer 0 channels ascending, layer 1 descending: per-layer masks differ
+        base = jnp.arange(1.0, 5.0)[:, None] * jnp.ones((4, 6))
+        stacked = jnp.stack([base, base[::-1]])  # (L=2, 4, 6)
+        out = np.asarray(comp.transform_params({"layers": {"w": stacked}})["layers"]["w"])
+        # every layer keeps exactly 2 of 4 input channels — none fully zeroed
+        for l in range(2):
+            rows = (np.abs(out[l]).sum(axis=1) > 0)
+            assert rows.sum() == 2, f"layer {l}: {rows}"
+        # and the masks are layer-specific (top channels differ)
+        assert not np.array_equal(out[0], out[1])
+
+    def test_norm_scales_not_quantized(self):
+        """(L, D) norm-scale leaves are 1-D per layer: weight quant (2-D+
+        only) must leave them alone."""
+        from deepspeed_tpu.compression.compress import Compressor
+        from deepspeed_tpu.compression.config import CompressionConfig
+
+        cfg = CompressionConfig.parse({
+            "compression_training": {
+                "weight_quantization": {
+                    "different_groups": {"q": {"params": {"target_bits": 2}, "modules": ["*"]}}
+                }
+            }
+        })
+        comp = Compressor(cfg)
+        scales = jnp.linspace(0.5, 1.5, 2 * 8).reshape(2, 8)  # (L, D)
+        out = comp.transform_params({"layers": {"ln": {"scale": scales}}})
+        np.testing.assert_allclose(np.asarray(out["layers"]["ln"]["scale"]), np.asarray(scales))
+
+    def test_activation_quant_wired_into_builtin_model(self):
+        """activation_quantization on a TransformerModel must change the loss
+        (regression: it was parsed but never applied)."""
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        act_cfg = {
+            "compression_training": {
+                "activation_quantization": {
+                    "shared_parameters": {"schedule_offset": 0},
+                    "different_groups": {"a": {"params": {"bits": 2}, "modules": ["*"]}},
+                }
+            }
+        }
+        base = TransformerModel(TransformerConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                                                  num_heads=2, max_seq_len=8))
+        wrapped, comp = init_compression(base, act_cfg)
+        assert wrapped.model.cfg.act_quant_bits == 2
+        params = base.init(jax.random.PRNGKey(0))
+        batch = {"input_ids": jnp.zeros((1, 8), jnp.int32) + 3, "labels": jnp.zeros((1, 8), jnp.int32)}
+        l_base = float(base.loss(params, batch, None))
+        l_q = float(wrapped.loss(params, batch, None))
+        assert l_base != l_q
+
+    def test_shared_parameters_enabled_false_respected(self):
+        cfg = CompressionConfig.parse({
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {"enabled": False},
+                    "different_groups": {"q": {"params": {"target_bits": 4}, "modules": ["*"]}},
+                }
+            }
+        })
+        assert not cfg.weight_quantization.enabled
+
+
+class TestLayerReduction:
+    def test_student_init(self):
+        teacher = {
+            "embed": jnp.ones((10, 4)),
+            "layers": {"w": jnp.arange(6.0)[:, None] * jnp.ones((6, 3))},
+        }
+        student = init_student_params_from_teacher(teacher, [0, 2, 5])
+        assert student["layers"]["w"].shape == (3, 3)
+        np.testing.assert_allclose(np.asarray(student["layers"]["w"][:, 0]), [0.0, 2.0, 5.0])
+        np.testing.assert_allclose(student["embed"], teacher["embed"])
+
+    def test_layer_map(self):
+        assert student_layer_map(12, 4) == [0, 3, 6, 9]
+        assert student_layer_map(4, 8) == [0, 1, 2, 3]
+
+
+class TestEigenvalue:
+    def test_quadratic_top_eigenvalue(self):
+        """Hessian of 0.5 x^T A x is A; power iteration must find max |eig|."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        diag = jnp.array([1.0, 3.0, 7.0, 2.0])
+
+        def loss(params):
+            x = params["x"]
+            return 0.5 * jnp.sum(diag * x * x)
+
+        eig, vec = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+            loss, {"x": jnp.ones((4,))}, rng=jax.random.PRNGKey(0)
+        )
+        assert eig == pytest.approx(7.0, rel=1e-2)
+        v = np.abs(np.asarray(vec["x"]))
+        assert np.argmax(v) == 2
