@@ -1,0 +1,128 @@
+"""Async file IO (ZeRO-Infinity swap transport).
+
+TPU-native counterpart of the reference's ``csrc/aio`` python surface
+(``py_ds_aio.cpp``: aio_read/aio_write over a C++ thread pool). Backed by
+csrc/aio/ds_aio.cpp via ctypes; a ThreadPoolExecutor fallback keeps the API
+available when the toolchain is missing.
+"""
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.native import build_and_load
+from deepspeed_tpu.utils.logging import logger
+
+_lib = None
+_checked = False
+
+
+def _native():
+    global _lib, _checked
+    if not _checked:
+        _checked = True
+        _lib = build_and_load("ds_aio", "aio/ds_aio.cpp")
+        if _lib is not None:
+            _lib.ds_aio_new.argtypes = [ctypes.c_int]
+            _lib.ds_aio_new.restype = ctypes.c_void_p
+            _lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64]
+            _lib.ds_aio_pwrite.restype = ctypes.c_int64
+            _lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64]
+            _lib.ds_aio_pread.restype = ctypes.c_int64
+            _lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            _lib.ds_aio_wait.restype = ctypes.c_int64
+            _lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+            _lib.ds_aio_free.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+class AsyncIOHandle:
+    """Submit/wait async reads+writes of numpy buffers to files
+    (reference: AsyncIOBuilder().load().aio_handle())."""
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = _native()
+        self._ids: Dict[int, Optional[Future]] = {}
+        self._next_py_id = 1
+        if self._lib is not None:
+            self._h = self._lib.ds_aio_new(num_threads)
+            self._pool = None
+        else:
+            self._h = None
+            self._pool = ThreadPoolExecutor(max_workers=num_threads)
+
+    # -- submission ------------------------------------------------------
+    def pwrite(self, path: str, arr: np.ndarray) -> int:
+        arr = np.ascontiguousarray(arr)
+        if self._lib is not None:
+            return int(self._lib.ds_aio_pwrite(self._h, path.encode(), arr.ctypes.data, arr.nbytes))
+        data = arr.tobytes()  # snapshot so the caller may reuse the buffer
+
+        def work():
+            with open(path, "wb") as fh:
+                fh.write(data)
+            return len(data)
+
+        return self._track(self._pool.submit(work))
+
+    def pread(self, path: str, out: np.ndarray) -> int:
+        assert out.flags.c_contiguous and out.flags.writeable
+        if self._lib is not None:
+            return int(self._lib.ds_aio_pread(self._h, path.encode(), out.ctypes.data, out.nbytes))
+
+        def work():
+            with open(path, "rb") as fh:
+                buf = fh.read(out.nbytes)
+            flat = np.frombuffer(buf, np.uint8)
+            out.view(np.uint8).reshape(-1)[: flat.size] = flat
+            return flat.size
+
+        return self._track(self._pool.submit(work))
+
+    def _track(self, fut: Future) -> int:
+        pid = self._next_py_id
+        self._next_py_id += 1
+        self._ids[pid] = fut
+        return pid
+
+    # -- completion ------------------------------------------------------
+    def wait(self, op_id: int) -> int:
+        if self._lib is not None:
+            rc = int(self._lib.ds_aio_wait(self._h, op_id))
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc))
+            return rc
+        fut = self._ids.pop(op_id)
+        return fut.result()
+
+    def wait_all(self):
+        if self._lib is not None:
+            self._lib.ds_aio_wait_all(self._h)
+            return
+        for pid in list(self._ids):
+            self.wait(pid)
+
+    def close(self):
+        if self._lib is not None and self._h is not None:
+            self._lib.ds_aio_free(self._h)
+            self._h = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def aio_handle(num_threads: int = 4) -> AsyncIOHandle:
+    return AsyncIOHandle(num_threads)
+
+
+def is_native_available() -> bool:
+    return _native() is not None

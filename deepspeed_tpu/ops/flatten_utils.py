@@ -1,0 +1,17 @@
+"""Flatten/unflatten ops (reference: csrc/utils/flatten_unflatten.cpp, 29
+lines of apex C++ loaded at engine.py:377). On TPU these are jnp reshapes
+XLA folds away — re-exported from runtime/utils for the op registry."""
+
+from deepspeed_tpu.runtime.utils import (
+    flatten_dense_tensors,
+    flatten_tree,
+    unflatten_dense_tensors,
+    unflatten_tree,
+)
+
+__all__ = [
+    "flatten_dense_tensors",
+    "unflatten_dense_tensors",
+    "flatten_tree",
+    "unflatten_tree",
+]

@@ -1,0 +1,17 @@
+"""Random-LTD ops (reference: csrc/random_ltd/ token_sort.cu +
+gather_scatter.cu, pt_binding.cpp:211) — static-shape jnp equivalents live
+in the data-routing layer; re-exported for the op registry."""
+
+from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+    gather_attention_mask,
+    gather_tokens,
+    random_keep_indices,
+    scatter_tokens,
+)
+
+__all__ = [
+    "random_keep_indices",
+    "gather_tokens",
+    "scatter_tokens",
+    "gather_attention_mask",
+]

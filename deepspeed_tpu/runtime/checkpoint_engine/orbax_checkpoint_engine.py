@@ -36,11 +36,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def load(self, path: str, template_tree):
         ocp = self._ocp
         path = os.path.abspath(path)
-        restore_args = jax.tree.map(
-            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding, global_shape=x.shape, dtype=x.dtype),
+        def _restore_arg(x):
+            if isinstance(x, jax.Array):
+                return ocp.ArrayRestoreArgs(sharding=x.sharding, global_shape=x.shape, dtype=x.dtype)
+            return ocp.RestoreArgs()  # host numpy leaves (offloaded state)
+
+        restore_args = jax.tree.map(_restore_arg, template_tree)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if isinstance(x, jax.Array) else x,
             template_tree,
         )
-        abstract = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), template_tree)
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(
             path, args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args)

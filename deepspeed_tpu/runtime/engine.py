@@ -139,6 +139,10 @@ def global_norm(tree):
     return jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in leaves))
 
 
+def _leaf_key(path) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
 class TpuEngine:
     def __init__(
         self,
@@ -189,6 +193,13 @@ class TpuEngine:
         self.fp16_enabled = config.fp16.enabled
         self.loss_scaler = create_loss_scaler(config.fp16, self.fp16_enabled)
 
+        # --- optimizer-state offload tier (reference: ZeRO-Offload/-Infinity,
+        # stage_1_and_2.py cpu_offload + swap_tensor/)
+        self.offload_device = config.zero_config.offload_optimizer.device
+        self._host_master = None  # {dotted_name: np fp32} when offloaded
+        self._host_optimizer = None
+        self._nvme_swapper = None
+
         # --- init params directly into their shardings (zero.Init equivalent:
         # partition at construction, partition_parameters.py:601 — here the
         # initializer is jitted with sharded outputs so full weights never
@@ -196,7 +207,25 @@ class TpuEngine:
         fp32_shardings = self.opt_shardings if self.mixed_precision else self.param_shardings
         init_fn = jax.jit(model.init, out_shardings=fp32_shardings)
         master = init_fn(init_rng)
-        if self.mixed_precision:
+        if self.offload_device in ("cpu", "nvme"):
+            # master weights + moments leave HBM: host fp32 copies, device
+            # keeps only the model-dtype working params
+            leaves_with_path = jax.tree_util.tree_leaves_with_path(master)
+            self._master_treedef = jax.tree.structure(master)
+            self._host_master = {
+                # explicit copy: device_get returns read-only views of
+                # JAX-owned buffers; the C++ optimizer mutates in place
+                _leaf_key(path): np.array(jax.device_get(leaf), np.float32)
+                for path, leaf in leaves_with_path
+            }
+            cast_fn = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
+                out_shardings=self.param_shardings,
+            )
+            self.params = cast_fn(master)
+            del master
+            self.master_params = None
+        elif self.mixed_precision:
             cast_fn = jax.jit(
                 lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
                 out_shardings=self.param_shardings,
@@ -208,13 +237,19 @@ class TpuEngine:
             self.params = master
 
         # --- optimizer
-        if optimizer is None and config.optimizer is not None:
-            optimizer = _build_optimizer(config.optimizer)
-        if optimizer is not None and not hasattr(optimizer, "init"):
-            optimizer = OptaxWrapper(optimizer)
+        if self.offload_device in ("cpu", "nvme"):
+            optimizer = self._configure_offload_optimizer(config)
+        else:
+            if optimizer is None and config.optimizer is not None:
+                optimizer = _build_optimizer(config.optimizer)
+            if optimizer is not None and not hasattr(optimizer, "init"):
+                optimizer = OptaxWrapper(optimizer)
         self.optimizer = optimizer
         self.base_lr = getattr(optimizer, "lr", 0.0) if optimizer is not None else 0.0
-        if optimizer is not None:
+        if self.offload_device in ("cpu", "nvme"):
+            self.opt_state = None
+            self._opt_state_shardings = None
+        elif optimizer is not None:
             base_tree = self.master_params if self.mixed_precision else self.params
             abstract_opt = jax.eval_shape(optimizer.init, self._abstract_params)
             opt_state_sh = _opt_state_shardings(
@@ -306,6 +341,100 @@ class TpuEngine:
         return self.policy.batch_spec()
 
     # ------------------------------------------------------------------
+    # optimizer-state offload (reference: ZeRO-Offload cpu_adam hot loop,
+    # stage_1_and_2.py:1031; ZeRO-Infinity optimizer swapping, swap_tensor/)
+    # ------------------------------------------------------------------
+    def _configure_offload_optimizer(self, config: TpuConfig):
+        opt_cfg = config.optimizer
+        params = dict(opt_cfg.params) if opt_cfg is not None else {}
+        name = opt_cfg.type.lower() if opt_cfg is not None else C.ADAM_OPTIMIZER
+        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
+            raise ValueError(
+                f"offload_optimizer supports Adam/AdamW (reference: DeepSpeedCPUAdam), got {opt_cfg.type}"
+            )
+        kwargs = dict(
+            lr=params.get("lr", 1e-3),
+            betas=tuple(params.get("betas", (0.9, 0.999))),
+            eps=params.get("eps", 1e-8),
+            weight_decay=params.get("weight_decay", 0.0),
+            # parity with the device path: _build_optimizer defaults "Adam"
+            # to adam_w_mode=True (reference ops/adam semantics)
+            adamw_mode=params.get("adam_w_mode", True),
+        )
+        if self.offload_device == "cpu":
+            from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+            self._host_optimizer = DeepSpeedCPUAdam(**kwargs)
+            return self._host_optimizer
+        # nvme tier
+        from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+            PartitionedOptimizerSwapper,
+        )
+
+        nvme_path = config.zero_config.offload_optimizer.nvme_path or "/tmp/dstpu_swap"
+        self._nvme_swapper = PartitionedOptimizerSwapper(
+            swap_folder=os.path.join(nvme_path, "optimizer"),
+            num_threads=config.zero_config.offload_optimizer.buffer_count,
+            **kwargs,
+        )
+        for key, master in self._host_master.items():
+            self._nvme_swapper.register(key, master)
+        # NVMe holds master+moments; the host dict only keeps keys/shapes
+        self._host_master = {k: np.zeros((0,), np.float32) for k in self._host_master}
+        return self._nvme_swapper
+
+    def _host_offload_step(self, lr: float) -> StepMetrics:
+        """Optimizer step on the host tier: grads device->host, C++ Adam on
+        flat fp32 buffers, updated masters -> device params."""
+        cfg = self.config
+        denom = float(self.scale_state.scale) * (
+            self.gradient_accumulation_steps if not cfg.prescale_gradients else 1.0
+        )
+        flat_grads, _ = jax.tree_util.tree_flatten(self.grad_acc)
+        paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(self.grad_acc)]
+        grads = {
+            _leaf_key(p): np.asarray(jax.device_get(g), np.float32) / denom
+            for p, g in zip(paths, flat_grads)
+        }
+        overflow = any(not np.all(np.isfinite(g)) for g in grads.values()) if self.fp16_enabled else False
+        gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())))
+        clip = cfg.gradient_clipping
+        factor = min(1.0, clip / (gnorm + 1e-6)) if clip > 0.0 else 1.0
+
+        if not overflow:
+            if self._nvme_swapper is not None:
+                updated = self._nvme_swapper.step(grads, lr=lr, grad_scale=factor)
+                # push directly; masters stay on NVMe, not in host RAM
+                self._push_masters_to_device(updated)
+            else:
+                for key, master in self._host_master.items():
+                    g = grads[key] * factor if factor != 1.0 else grads[key]
+                    self._host_optimizer.step_buffer(key, master, g, lr=lr)
+                self._push_masters_to_device(self._host_master)
+
+        # loss-scale transition + grad reset (device side)
+        self.scale_state = jax.device_put(
+            self.loss_scaler.update(self.scale_state, jnp.asarray(overflow)), self.replicated
+        )
+        self.grad_acc = self._zero_acc_fn(self.grad_acc)
+        return StepMetrics(
+            grad_norm=jnp.asarray(gnorm), overflow=jnp.asarray(overflow),
+            loss_scale=self.scale_state.scale,
+        )
+
+    def _push_masters_to_device(self, masters: Dict[str, "np.ndarray"]):
+        flat_shardings, _ = jax.tree_util.tree_flatten(self.param_shardings)
+        keys = [
+            _leaf_key(p) for p, _ in jax.tree_util.tree_leaves_with_path(self._abstract_params)
+        ]
+        abstract = jax.tree.leaves(self._abstract_params)
+        leaves = [
+            jax.device_put(masters[k].astype(self.model_dtype).reshape(a.shape), s)
+            for k, s, a in zip(keys, flat_shardings, abstract)
+        ]
+        self.params = jax.tree.unflatten(self._master_treedef, leaves)
+
+    # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
     def _compile_step_fns(self):
@@ -342,8 +471,15 @@ class TpuEngine:
             loss_only_fn, in_shardings=(self.param_shardings, self.batch_sharding, None)
         )
 
-        if optimizer is None:
+        if optimizer is None or self.offload_device in ("cpu", "nvme"):
+            # offload: the optimizer math runs on the host tier
+            # (_host_offload_step), not in a compiled device program
             self._apply_fn = None
+            self._zero_acc_fn = jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=self.grad_shardings,
+                donate_argnums=0,
+            )
             return
 
         def apply_fn(params, master, opt_state, grad_acc, scale_state, lr):
@@ -503,17 +639,20 @@ class TpuEngine:
             return
         assert self.optimizer is not None, "step() requires an optimizer (config or client-provided)"
         self.timers(EngineTimers.STEP).start()
-        lr = jnp.asarray(self.get_lr_value(), jnp.float32)
-        (
-            self.params,
-            self.master_params,
-            self.opt_state,
-            self.grad_acc,
-            self.scale_state,
-            metrics,
-        ) = self._apply_fn(
-            self.params, self.master_params, self.opt_state, self.grad_acc, self.scale_state, lr
-        )
+        if self.offload_device in ("cpu", "nvme"):
+            metrics = self._host_offload_step(self.get_lr_value())
+        else:
+            lr = jnp.asarray(self.get_lr_value(), jnp.float32)
+            (
+                self.params,
+                self.master_params,
+                self.opt_state,
+                self.grad_acc,
+                self.scale_state,
+                metrics,
+            ) = self._apply_fn(
+                self.params, self.master_params, self.opt_state, self.grad_acc, self.scale_state, lr
+            )
         self._last_metrics = metrics
         self.global_steps += 1
         if self.fp16_enabled:
@@ -637,6 +776,32 @@ class TpuEngine:
             tree["master_params"] = self.master_params
         if self.opt_state is not None:
             tree["opt_state"] = self.opt_state
+        if self._nvme_swapper is not None:
+            # nvme tier: pull masters+moments off storage into the checkpoint
+            # (swap files alone don't survive a move to another host, and a
+            # fresh engine's register() would overwrite them before load)
+            keys = list(self._host_master)
+            tree["host_master"] = {k: self._nvme_swapper.get_master(k) for k in keys}
+            tree["host_opt"] = {
+                k: {
+                    "step": np.int64(self._nvme_swapper.step_count),
+                    "m": self._nvme_swapper.get_state(k, "m"),
+                    "v": self._nvme_swapper.get_state(k, "v"),
+                }
+                for k in keys
+            }
+        elif self._host_master is not None:
+            # cpu tier: host master + moments travel in the checkpoint
+            tree["host_master"] = dict(self._host_master)
+            sd = self._host_optimizer.state_dict() if self._host_optimizer is not None else {}
+            if not sd:
+                # pre-step engines need a full-shape template or a fresh
+                # process restores an empty dict and drops the moments
+                sd = {
+                    k: {"step": np.int64(0), "m": np.zeros_like(v), "v": np.zeros_like(v)}
+                    for k, v in self._host_master.items()
+                }
+            tree["host_opt"] = sd
         return tree
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
@@ -677,6 +842,23 @@ class TpuEngine:
             self.master_params = restored["master_params"]
         if load_optimizer_states and "opt_state" in restored:
             self.opt_state = restored["opt_state"]
+        if "host_master" in restored:
+            masters = {k: np.array(v, np.float32) for k, v in restored["host_master"].items()}
+            if self._nvme_swapper is not None:
+                # re-seed the swap files (a fresh engine registered random
+                # init over them) and the step counter
+                for k, m in masters.items():
+                    self._nvme_swapper.swapper.swap_out(f"{k}.master", m)
+                if load_optimizer_states and "host_opt" in restored:
+                    for k, st in restored["host_opt"].items():
+                        self._nvme_swapper.swapper.swap_out(f"{k}.m", np.array(st["m"], np.float32))
+                        self._nvme_swapper.swapper.swap_out(f"{k}.v", np.array(st["v"], np.float32))
+                        self._nvme_swapper.step_count = int(st["step"])
+                self._nvme_swapper.swapper.synchronize()
+            else:
+                self._host_master = masters
+                if load_optimizer_states and "host_opt" in restored and self._host_optimizer is not None:
+                    self._host_optimizer.load_state_dict(restored["host_opt"])
         self.global_steps = meta.get("global_steps", 0)
         self.global_samples = meta.get("global_samples", 0)
         self.micro_steps = meta.get("micro_steps", 0)

@@ -1,0 +1,9 @@
+"""Tensor swapping to NVMe (reference: deepspeed/runtime/swap_tensor/):
+async swapper over the C++ aio pool + NVMe-tiered optimizer state."""
+
+from deepspeed_tpu.runtime.swap_tensor.async_swapper import AsyncTensorSwapper
+from deepspeed_tpu.runtime.swap_tensor.partitioned_optimizer_swapper import (
+    PartitionedOptimizerSwapper,
+)
+
+__all__ = ["AsyncTensorSwapper", "PartitionedOptimizerSwapper"]

@@ -1,0 +1,269 @@
+"""ZeRO-Offload/-Infinity tests: C++ CPU Adam numerics, async IO, swapper,
+engine host-offload path (reference: tests/unit/ops/adam/, tests/unit/ops/aio/,
+tests/unit/runtime/zero offload suites)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam, adam_update, is_native_available
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper, PartitionedOptimizerSwapper
+
+
+class TestCPUAdam:
+    def test_native_build(self):
+        assert is_native_available(), "C++ cpu_adam must build on this toolchain"
+
+    @pytest.mark.parametrize("adamw", [False, True])
+    def test_matches_fused_adam(self, adamw):
+        """Host C++ Adam must track the device FusedAdam trajectory
+        (reference validates DeepSpeedCPUAdam against torch.optim.Adam)."""
+        from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+
+        rng = np.random.default_rng(0)
+        p_host = rng.normal(size=(257,)).astype(np.float32)  # odd size: tail lanes
+        p_dev = {"w": jnp.asarray(p_host.copy())}
+        m = np.zeros_like(p_host)
+        v = np.zeros_like(p_host)
+        ref = FusedAdam(lr=1e-2, adam_w_mode=adamw, weight_decay=0.01)
+        state = ref.init(p_dev)
+        for step in range(1, 8):
+            g = rng.normal(size=(257,)).astype(np.float32)
+            adam_update(p_host, g, m, v, lr=1e-2, weight_decay=0.01, step=step, adamw_mode=adamw)
+            upd, state = ref.update({"w": jnp.asarray(g)}, state, p_dev)
+            p_dev = {"w": p_dev["w"] + upd["w"]}
+        np.testing.assert_allclose(p_host, np.asarray(p_dev["w"]), rtol=2e-5, atol=2e-6)
+
+    def test_stateful_wrapper(self):
+        opt = DeepSpeedCPUAdam(lr=1e-2)
+        p = np.ones(16, np.float32)
+        g = np.full(16, 0.5, np.float32)
+        p1 = opt.step_buffer("w", p, g)
+        assert opt._state["w"]["step"] == 1
+        sd = opt.state_dict()
+        opt2 = DeepSpeedCPUAdam(lr=1e-2)
+        opt2.load_state_dict(sd)
+        assert opt2._state["w"]["step"] == 1
+
+
+class TestAsyncIO:
+    def test_roundtrip_and_async(self, tmp_path):
+        h = AsyncIOHandle(num_threads=2)
+        arrs = [np.random.default_rng(i).normal(size=(1000,)).astype(np.float32) for i in range(4)]
+        ids = [h.pwrite(str(tmp_path / f"f{i}.bin"), a) for i, a in enumerate(arrs)]
+        for i in ids:
+            assert h.wait(i) == 4000
+        outs = [np.zeros(1000, np.float32) for _ in range(4)]
+        rids = [h.pread(str(tmp_path / f"f{i}.bin"), o) for i, o in enumerate(outs)]
+        for i in rids:
+            h.wait(i)
+        for a, o in zip(arrs, outs):
+            np.testing.assert_array_equal(a, o)
+        h.close()
+
+    def test_missing_file_raises(self, tmp_path):
+        h = AsyncIOHandle(1)
+        out = np.zeros(4, np.float32)
+        op = h.pread(str(tmp_path / "nope.bin"), out)
+        with pytest.raises(OSError):
+            h.wait(op)
+        h.close()
+
+    def test_caller_buffer_reuse_safe(self, tmp_path):
+        """Writes snapshot the buffer: mutating after submit must not corrupt."""
+        h = AsyncIOHandle(1)
+        a = np.arange(100000, dtype=np.float32)
+        op = h.pwrite(str(tmp_path / "snap.bin"), a)
+        a[:] = -1  # overwrite immediately
+        h.wait(op)
+        out = np.zeros(100000, np.float32)
+        h.wait(h.pread(str(tmp_path / "snap.bin"), out))
+        np.testing.assert_array_equal(out, np.arange(100000, dtype=np.float32))
+        h.close()
+
+
+class TestSwapper:
+    def test_swap_roundtrip(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path))
+        a = np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32)
+        sw.swap_out("layer0.w", a)
+        back = sw.swap_in("layer0.w")
+        np.testing.assert_array_equal(a, back)
+        sw.remove("layer0.w")
+        assert not os.listdir(tmp_path)
+        sw.close()
+
+    def test_optimizer_swapper_matches_cpu_adam(self, tmp_path):
+        rng = np.random.default_rng(1)
+        master = rng.normal(size=(128,)).astype(np.float32)
+        sw = PartitionedOptimizerSwapper(str(tmp_path), lr=1e-2, adamw_mode=True)
+        sw.register("w", master.copy())
+        ref_p = master.copy()
+        ref_m = np.zeros_like(ref_p)
+        ref_v = np.zeros_like(ref_p)
+        for step in range(1, 5):
+            g = rng.normal(size=(128,)).astype(np.float32)
+            out = sw.step({"w": g})
+            adam_update(ref_p, g, ref_m, ref_v, lr=1e-2, step=step, adamw_mode=True)
+            np.testing.assert_allclose(out["w"], ref_p, rtol=1e-6)
+        sw.close()
+
+
+class TestEngineOffload:
+    def _train(self, cfg_extra, steps=12):
+        import deepspeed_tpu
+        from deepspeed_tpu import comm
+
+        comm.destroy()
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+            "bf16": {"enabled": True},
+            "mesh": {"data": 1, "fsdp": -1},
+        }
+        cfg.update(cfg_extra)
+
+        def loss_fn(params, batch, rng):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        params = {"w": jnp.ones((8, 8), jnp.float32)}
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=cfg)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        batch = {"x": x, "y": np.zeros((8, 8), np.float32)}
+        losses = []
+        for _ in range(steps):
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return engine, losses
+
+    def test_cpu_offload_trains(self):
+        engine, losses = self._train({"zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}})
+        assert engine.offload_device == "cpu"
+        assert engine._host_master is not None
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_cpu_offload_matches_device_path(self):
+        """Offloaded Adam must track the on-device FusedAdam trajectory."""
+        _, dev_losses = self._train({"zero_optimization": {"stage": 2}})
+        _, off_losses = self._train(
+            {"zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}}
+        )
+        np.testing.assert_allclose(dev_losses, off_losses, rtol=0.05)
+
+    def test_nvme_offload_trains(self, tmp_path):
+        engine, losses = self._train({
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)},
+            }
+        })
+        assert engine._nvme_swapper is not None
+        assert losses[-1] < 0.5 * losses[0], losses
+        assert os.path.isdir(tmp_path / "optimizer")
+        engine._nvme_swapper.close()
+
+    def test_cpu_offload_checkpoint_roundtrip(self, tmp_path):
+        engine, _ = self._train({"zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}})
+        engine.save_checkpoint(str(tmp_path / "ck"), tag="t")
+        w_before = engine._host_master["w"].copy()
+        engine._host_master["w"][:] = 0
+        engine.load_checkpoint(str(tmp_path / "ck"), tag="t")
+        np.testing.assert_allclose(engine._host_master["w"], w_before)
+        assert engine._host_optimizer._state["w"]["step"] == 12
+
+
+class TestFreshEngineResume:
+    """Resume into a NEWLY constructed engine (the cross-process scenario):
+    masters AND moments must survive (regression: empty host_opt template /
+    register() clobbering NVMe swap files)."""
+
+    def _cfg(self, extra):
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-2}},
+            "bf16": {"enabled": True},
+            "mesh": {"data": 1, "fsdp": -1},
+        }
+        cfg.update(extra)
+        return cfg
+
+    def _fresh_engine(self, extra):
+        import deepspeed_tpu
+        from deepspeed_tpu import comm
+
+        comm.destroy()
+
+        def loss_fn(params, batch, rng):
+            return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+        params = {"w": jnp.ones((8, 8), jnp.float32)}
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=self._cfg(extra))
+        return engine
+
+    def _batch(self):
+        rng = np.random.default_rng(0)
+        return {"x": rng.normal(size=(8, 8)).astype(np.float32), "y": np.zeros((8, 8), np.float32)}
+
+    def _run(self, engine, steps):
+        out = []
+        for _ in range(steps):
+            loss = engine(self._batch())
+            engine.backward(loss)
+            engine.step()
+            out.append(float(loss))
+        return out
+
+    def test_cpu_tier_fresh_engine_resume(self, tmp_path):
+        extra = {"zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}}
+        a = self._fresh_engine(extra)
+        self._run(a, 5)
+        a.save_checkpoint(str(tmp_path / "ck"), tag="t")
+        continued = self._run(a, 3)
+
+        b = self._fresh_engine(extra)
+        b.load_checkpoint(str(tmp_path / "ck"), tag="t")
+        assert b._host_optimizer._state["w"]["step"] == 5  # moments restored
+        resumed = self._run(b, 3)
+        np.testing.assert_allclose(resumed, continued, rtol=1e-3)
+
+    def test_nvme_tier_fresh_engine_resume(self, tmp_path):
+        extra = {
+            "zero_optimization": {
+                "stage": 2,
+                "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "swap")},
+            }
+        }
+        a = self._fresh_engine(extra)
+        self._run(a, 5)
+        a.save_checkpoint(str(tmp_path / "ck"), tag="t")
+        continued = self._run(a, 3)
+        a._nvme_swapper.close()
+
+        b = self._fresh_engine(extra)  # register() overwrites swap files...
+        b.load_checkpoint(str(tmp_path / "ck"), tag="t")  # ...load re-seeds them
+        assert b._nvme_swapper.step_count == 5
+        resumed = self._run(b, 3)
+        np.testing.assert_allclose(resumed, continued, rtol=1e-3)
+        b._nvme_swapper.close()
+
+
+class TestOpRegistry:
+    def test_all_ops_load(self):
+        """Every registered op must resolve (reference ds_report parity) —
+        except the transformer layer ops scheduled for a later milestone."""
+        from deepspeed_tpu.ops.op_builder import ALL_OPS
+
+        pending = {"transformer", "transformer_inference"}
+        for name, builder in ALL_OPS.items():
+            if name in pending:
+                continue
+            assert builder().builder_available(), f"op {name} failed to load"
