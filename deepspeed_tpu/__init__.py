@@ -61,6 +61,14 @@ def initialize(
         engine = PipelineEngine(
             model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh
         )
+    elif cfg.hybrid_engine.enabled:
+        # RLHF engine: train step + compiled generate on shared weights
+        # (reference: deepspeed/__init__.py:141 hybrid-engine dispatch)
+        from deepspeed_tpu.runtime.hybrid_engine import TpuHybridEngine
+
+        engine = TpuHybridEngine(
+            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh
+        )
     else:
         engine = TpuEngine(
             model,

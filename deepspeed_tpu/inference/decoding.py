@@ -1,0 +1,84 @@
+"""Shared KV-cached decode machinery.
+
+Single home for the compile-and-sample logic used by BOTH the standalone
+``InferenceEngine`` (inference/engine.py) and the RLHF ``TpuHybridEngine``
+(runtime/hybrid_engine.py) — same sharding selection, same prefill/decode
+jits, same sampling loop, so fixes propagate to both surfaces.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
+    """Build (prefill_fn, decode_fn, cache_sharding, batch_sharding) for a
+    TransformerConfig ``cfg`` with params placed per ``param_shardings``."""
+    from deepspeed_tpu.models import transformer as tf
+
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    batch_axes = ("data", "fsdp") if batch_size % dp == 0 else None
+    kv_tensor = "tensor" if cfg.kv_heads % mesh.shape["tensor"] == 0 else None
+    batch_sh = NamedSharding(mesh, PartitionSpec(batch_axes))
+    cache_sh = jax.tree.map(
+        lambda _: NamedSharding(mesh, PartitionSpec(None, batch_axes, None, kv_tensor, None)),
+        tf.init_cache(cfg, 1, 8),
+    )
+
+    def prefill(params, tokens, cache):
+        return tf.forward_with_cache(params, cfg, tokens, cache, 0)
+
+    def decode(params, tok, cache, pos):
+        logits, cache = tf.forward_with_cache(params, cfg, tok, cache, pos)
+        return logits[:, -1], cache
+
+    prefill_fn = jax.jit(
+        prefill,
+        in_shardings=(param_shardings, batch_sh, cache_sh),
+        out_shardings=(batch_sh, cache_sh),
+        donate_argnums=(2,),
+    )
+    decode_fn = jax.jit(
+        decode,
+        in_shardings=(param_shardings, batch_sh, cache_sh, None),
+        out_shardings=(batch_sh, cache_sh),
+        donate_argnums=(2,),
+    )
+    return prefill_fn, decode_fn, cache_sh, batch_sh
+
+
+def select_token(logits, temperature: float, top_k: int, rng) -> jnp.ndarray:
+    """Greedy / temperature / top-k sampling of one token per row."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: int,
+                temperature: float, top_k: int, rng) -> jnp.ndarray:
+    """Prefill + token-by-token decode; returns (B, S + max_new_tokens)."""
+    S = tokens.shape[1]
+    logits, cache = prefill_fn(params, tokens, cache)
+    last = select_token(logits[:, -1], temperature, top_k, rng)
+    out = [last]
+    pos = S
+    for _ in range(max_new_tokens - 1):
+        rng, sub = jax.random.split(rng)
+        step_logits, cache = decode_fn(params, out[-1][:, None], cache, pos)
+        out.append(select_token(step_logits, temperature, top_k, sub))
+        pos += 1
+    return jnp.concatenate([tokens, jnp.stack(out, axis=1)], axis=1)
+
+
+def bounded_cache_len(total: int, max_seq_len: int, max_out_tokens: Optional[int]) -> int:
+    """KV-cache allocation: bounded by max_out_tokens, grown when the request
+    needs more, never past max_seq_len."""
+    if not max_out_tokens:
+        return max_seq_len
+    return max(total, min(max_seq_len, max_out_tokens))

@@ -125,39 +125,11 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def _compile(self, batch_size: int, max_len: int):
-        cfg = self.cfg
-        mesh = self.mesh
-        dp = mesh.shape["data"] * mesh.shape["fsdp"]
-        batch_axes = ("data", "fsdp") if batch_size % dp == 0 else None
-        kv_tensor = "tensor" if cfg.kv_heads % mesh.shape["tensor"] == 0 else None
-        batch_sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
-        cache_sharding = jax.tree.map(
-            lambda _: NamedSharding(mesh, PartitionSpec(None, batch_axes, None, kv_tensor, None)),
-            tf.init_cache(cfg, 1, 8),
-        )
-        self.batch_sharding = batch_sharding
+        from deepspeed_tpu.inference.decoding import compile_decode_fns
 
-        def prefill(params, tokens, cache):
-            logits, cache = tf.forward_with_cache(params, cfg, tokens, cache, 0)
-            return logits, cache
-
-        def decode(params, tok, cache, pos):
-            logits, cache = tf.forward_with_cache(params, cfg, tok, cache, pos)
-            return logits[:, -1], cache
-
-        self._prefill_fn = jax.jit(
-            prefill,
-            in_shardings=(self.param_shardings, self.batch_sharding, cache_sharding),
-            out_shardings=(self.batch_sharding, cache_sharding),
-            donate_argnums=(2,),
+        self._prefill_fn, self._decode_fn, self._cache_sharding, self.batch_sharding = (
+            compile_decode_fns(self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
         )
-        self._decode_fn = jax.jit(
-            decode,
-            in_shardings=(self.param_shardings, self.batch_sharding, cache_sharding, None),
-            out_shardings=(self.batch_sharding, cache_sharding),
-            donate_argnums=(2,),
-        )
-        self._cache_sharding = cache_sharding
         self._compiled_shape = (batch_size, max_len)
 
     def _ensure_compiled(self, batch_size: int, max_len: int):
@@ -203,46 +175,30 @@ class InferenceEngine:
         )
         # KV-cache allocation bounded by max_out_tokens (reference
         # inference/config.py max_out_tokens), grown only if the request needs it
-        max_len = max(total, min(self.cfg.max_seq_len, self.config.max_out_tokens))
+        from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
+
+        max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
         self._ensure_compiled(B, max_len)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), self._cache_sharding)
         t0 = time.time()
-        logits, cache = self._prefill_fn(self.params, tokens, cache)
-        last = self._select(logits[:, -1], temperature, top_k, rng)
-
-        params = self.params
-        temperature_ = temperature
-        top_k_ = top_k
-        cfg = self.cfg
-        decode_fn = self._decode_fn
-
-        out_tokens = [last]
-        pos = S
-        for i in range(max_new_tokens - 1):
-            rng, sub = jax.random.split(rng)
-            logits_step, cache = decode_fn(params, out_tokens[-1][:, None], cache, pos)
-            out_tokens.append(self._select(logits_step, temperature_, top_k_, sub))
-            pos += 1
-        gen = jnp.stack(out_tokens, axis=1)
+        result = decode_loop(
+            self._prefill_fn, self._decode_fn, self.params, tokens, cache,
+            max_new_tokens, temperature, top_k, rng,
+        )
         if self.config.profile_model_time:
-            jax.block_until_ready(gen)
+            jax.block_until_ready(result)
             self._model_times.append(time.time() - t0)
-        result = jnp.concatenate([tokens, gen], axis=1)
         if eos_token_id is not None:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
 
     @staticmethod
     def _select(logits, temperature, top_k, rng):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        from deepspeed_tpu.inference.decoding import select_token
+
+        return select_token(logits, temperature, top_k, rng)
 
     @staticmethod
     def _truncate_eos(tokens, prompt_len, eos_id):

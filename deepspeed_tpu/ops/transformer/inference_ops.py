@@ -1,0 +1,66 @@
+"""Inference-kernel surface (op registry target for 'transformer_inference').
+
+Reference: csrc/transformer/inference op bindings (pt_binding.cpp:1747 —
+qkv_gemm, softmax_context, vector_matmul, mlp_gemm, residual_add, rotary,
+SURVEY §2.4 #6). The decoder loop itself lives in models/transformer.py
+``forward_with_cache`` (compiled whole); these are the op-level equivalents
+for custom model authors.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def qkv_gemm(x, wq, wk, wv, bq=None, bk=None, bv=None):
+    """(B,S,D) x three projections (qkv_gemm binding)."""
+    q = x @ wq
+    k = x @ wk
+    v = x @ wv
+    if bq is not None:
+        q, k, v = q + bq, k + bk, v + bv
+    return q, k, v
+
+
+def vector_matmul(x, w, b=None):
+    out = x @ w
+    return out + b if b is not None else out
+
+
+def residual_add(hidden, residual, bias=None):
+    out = hidden + residual
+    return out + bias if bias is not None else out
+
+
+def apply_rotary_pos_emb(x, positions, theta: float = 10000.0):
+    """x (B, S, H, hd), positions (B, S) (rotary binding)."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,S,hd/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(x.shape).astype(x.dtype)
+
+
+def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None) -> jnp.ndarray:
+    """Single-step cached attention (softmax_context binding): q (B,1,H,hd),
+    caches (B,T,H,hd) valid through ``pos`` inclusive."""
+    B, _, H, hd = q.shape
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)) * scale
+    T = k_cache.shape[1]
+    mask = jnp.arange(T)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(jnp.float32))
+    return ctx.astype(q.dtype)
+
+
+def update_kv_cache(k_cache, v_cache, k_new, v_new, pos) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write step-``pos`` keys/values (the cache side of softmax_context)."""
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new.astype(k_cache.dtype), pos, axis=1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new.astype(v_cache.dtype), pos, axis=1)
+    return k_cache, v_cache

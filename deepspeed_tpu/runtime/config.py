@@ -160,6 +160,17 @@ class EigenvalueConfig:
 
 
 @dataclass
+class HybridEngineConfig:
+    # reference: inference/config.py DeepSpeedHybridEngineConfig
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclass
 class CurriculumConfig:
     enabled: bool = False
     curriculum_type: str = "seqlen"
@@ -224,6 +235,7 @@ class TpuConfig:
         self.comms_logger = from_dict(CommsLoggerConfig, g("comms_logger", {}))
         self.eigenvalue = from_dict(EigenvalueConfig, g("eigenvalue", {}))
         self.curriculum = from_dict(CurriculumConfig, g("curriculum_learning", {}))
+        self.hybrid_engine = from_dict(HybridEngineConfig, g("hybrid_engine", {}))
         self.data_efficiency = from_dict(DataEfficiencyConfig, g("data_efficiency", {}))
         self.compression = g("compression_training", {})
         self.progressive_layer_drop = g("progressive_layer_drop", {"enabled": False})

@@ -1,0 +1,31 @@
+"""Progressive layer drop (stochastic depth schedule).
+
+TPU-native counterpart of the reference's ``ProgressiveLayerDrop``
+(runtime/progressive_layer_drop.py, 40 LoC; theta consumed at
+engine.py:1512): keep-probability theta(t) = theta_min + (1 - theta_min) *
+exp(-gamma * t) ... the reference uses theta * (decay)^t shape; we keep its
+exact formula. Models consume ``get_theta()`` to scale layer keep
+probability per step (static per compile — theta changes between jit calls).
+"""
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int):
+        def _prob(x, g, t):
+            return (1.0 - t) * math.exp(-g * x) + t
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)
+        return self.current_theta

