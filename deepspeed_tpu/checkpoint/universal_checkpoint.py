@@ -158,9 +158,16 @@ def load_universal_into_engine(engine, path: str, load_optimizer_states: bool = 
 
     if load_optimizer_states and engine.opt_state is not None and ckpt.optimizer_components():
         state = engine.opt_state
+
+        def _component(container, name):
+            # NamedTuple field or dict key — both state layouts are supported
+            if isinstance(container, dict):
+                return container.get(name)
+            return getattr(container, name, None)
+
         replaced = {}
         for comp in ckpt.optimizer_components():
-            sub = getattr(state, comp, None)
+            sub = _component(state, comp)
             if sub is None:
                 continue
             tensors = ckpt.load_optimizer_component(comp)
@@ -173,10 +180,20 @@ def load_universal_into_engine(engine, path: str, load_optimizer_states: bool = 
         scalars = ckpt.manifest.get("optimizer_scalars", {})
         kwargs = dict(replaced)
         for name, val in scalars.items():
-            if hasattr(state, name) and name not in kwargs:
-                leaf = getattr(state, name)
+            leaf = _component(state, name)
+            if leaf is not None and name not in kwargs:
                 kwargs[name] = jax.device_put(np.asarray(val, leaf.dtype), leaf.sharding)
-        engine.opt_state = state._replace(**kwargs) if hasattr(state, "_replace") else state
+        if hasattr(state, "_replace"):  # NamedTuple states (FusedAdam etc.)
+            engine.opt_state = state._replace(**kwargs)
+        elif isinstance(state, dict):  # optax-style dict states
+            engine.opt_state = {**state, **kwargs}
+        else:
+            # Silently keeping the old state would restore weights but drop
+            # every optimizer moment — a resume that quietly diverges.
+            raise TypeError(
+                f"cannot restore optimizer state of type {type(state).__name__}: "
+                "expected a NamedTuple (._replace) or dict container"
+            )
 
     meta = ckpt.engine_metadata
     engine.global_steps = int(meta.get("global_steps", engine.global_steps) or 0)

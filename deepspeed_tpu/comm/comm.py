@@ -148,8 +148,18 @@ def _maybe_init_multi_controller():
         )
         log_dist(f"joined coordinator {coord} as process "
                  f"{os.environ['DSTPU_PROCESS_ID']}/{nprocs}", ranks=[0])
-    except Exception as e:  # already initialized or single-process fallback
-        logger.warning(f"jax.distributed.initialize skipped: {e}")
+    except RuntimeError as e:
+        # Only the already-initialized case may be swallowed (jax raises
+        # "distributed.initialize should only be called once."). A genuine
+        # rendezvous failure at nprocs > 1 must be fatal: continuing would
+        # silently degrade into N independent single-host jobs computing
+        # wrong results (each would psum over its local mesh only). The
+        # launcher's fail-fast logic reaps the rest of the job on exit.
+        msg = str(e).lower()
+        if "only be called once" in msg or "already initialized" in msg:
+            logger.warning(f"jax.distributed.initialize skipped: {e}")
+        else:
+            raise
     _MULTI_CONTROLLER_DONE = True
 
 

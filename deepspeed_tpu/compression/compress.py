@@ -63,7 +63,14 @@ class Compressor:
                 if _match(path, g.modules):
                     bits, sym = g.bits, g.params.get("quantization_type", "symmetric") == "symmetric"
                     groups = int(g.params.get("quantize_groups", 1))
-                    fns.append(lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(w, b, s, ng))
+                    # same guard as runtime/quantize.py: a leaf whose element
+                    # count doesn't divide into the group count falls back to
+                    # per-tensor (groups=1) instead of crashing at trace time
+                    fns.append(
+                        lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(
+                            w, b, s, ng if ng > 0 and w.size % ng == 0 else 1
+                        )
+                    )
                     break
         if self._active(cfg.sparse_pruning):
             for g in cfg.sparse_pruning.groups():

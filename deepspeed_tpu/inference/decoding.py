@@ -63,6 +63,8 @@ def select_token(logits, temperature: float, top_k: int, rng) -> jnp.ndarray:
 def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: int,
                 temperature: float, top_k: int, rng) -> jnp.ndarray:
     """Prefill + token-by-token decode; returns (B, S + max_new_tokens)."""
+    if max_new_tokens <= 0:
+        return tokens
     S = tokens.shape[1]
     logits, cache = prefill_fn(params, tokens, cache)
     last = select_token(logits[:, -1], temperature, top_k, rng)

@@ -101,10 +101,13 @@ class TransformerConfig:
         return emb + L * per_layer + final + head
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Training FLOPs/token (fwd+bwd ≈ 6*N + attention term)."""
+        """Training FLOPs/token, Megatron-style accounting (fwd+bwd):
+        6*N over matmul params + the logits projection (the V×D matmul runs
+        every step whether or not embeddings are tied) + causal attention."""
         n = self.num_params() - self.vocab_size * self.hidden_size * (1 if self.tie_embeddings else 2)
+        lm_head_flops = 6 * self.vocab_size * self.hidden_size
         attn_flops = 12 * self.num_layers * self.hidden_size * seq_len  # 2*2*3 per token pair
-        return 6.0 * n + attn_flops
+        return 6.0 * n + lm_head_flops + attn_flops
 
 
 # preset shapes for parity configs (BASELINE.md tracked configs)
@@ -584,9 +587,9 @@ def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
     else:
         labels = tokens[:, 1:]
         logits_for_loss = logits[:, :-1]
-    logits32 = logits_for_loss.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits32, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+
+    nll = softmax_cross_entropy(logits_for_loss, labels)
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask[:, : nll.shape[1]].astype(jnp.float32)

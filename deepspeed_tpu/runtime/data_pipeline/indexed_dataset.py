@@ -23,9 +23,22 @@ import numpy as np
 _MAGIC = b"MMIDIDX\x00\x00"
 _VERSION = 1
 
-# dtype codes (Megatron indexed_dataset dtypes table)
-_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16}
-_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+# dtype codes — byte-compatible with the Megatron/reference table
+# (reference runtime/data_pipeline/data_sampling/indexed_dataset.py: 6=float64,
+# 7=double, 9=uint32, 10=uint64). Code 11 is our extension for float32 —
+# outside the reference range so files stay mutually readable.
+# NOTE: before 2026-07 this repo briefly wrote float32 as code 6; such files
+# (float payloads only — integer token corpora are unaffected) must be rebuilt.
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+    6: np.float64, 7: np.double, 8: np.uint16, 9: np.uint32, 10: np.uint64,
+    11: np.float32,
+}
+# reverse map: np.double is np.float64, so build in ascending-code order and
+# keep the first (canonical) code for each dtype
+_DTYPE_CODES = {}
+for _code in sorted(_DTYPES):
+    _DTYPE_CODES.setdefault(np.dtype(_DTYPES[_code]), _code)
 
 
 def data_file_path(prefix: str) -> str:

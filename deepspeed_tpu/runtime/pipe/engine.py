@@ -126,8 +126,9 @@ class PipelinedTransformer:
         else:
             labels = tokens[..., 1:]
             logits_for_loss = logits[..., :-1, :]
-        logp = jax.nn.log_softmax(logits_for_loss.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+
+        nll = softmax_cross_entropy(logits_for_loss, labels)
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[..., : nll.shape[-1]].astype(jnp.float32)
