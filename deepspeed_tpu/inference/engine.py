@@ -66,12 +66,18 @@ class InferenceEngine:
                 )
         self.cfg = cfg
 
-        # mesh: inference default is pure tensor-parallel over available chips
+        # mesh: inference default is tensor-parallel (+ expert-parallel for
+        # MoE models, reference moe_inference ep groups) over available chips
         if mesh is None:
             if comm.is_initialized():
                 mesh = comm.get_mesh()
             else:
-                shape = self.config.mesh or {"data": -1, "tensor": self.config.tensor_parallel.tp_size}
+                shape = self.config.mesh
+                if shape is None:
+                    shape = {"data": -1, "tensor": self.config.tensor_parallel.tp_size}
+                    ep = self.config.moe.ep_size
+                    if (self.config.moe.enabled or cfg.moe_num_experts > 0) and ep > 1:
+                        shape["expert"] = ep
                 mesh = comm.init_distributed(mesh_shape=shape, verbose=False)
         self.mesh = mesh
 

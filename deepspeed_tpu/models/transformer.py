@@ -66,6 +66,15 @@ class TransformerConfig:
     seq_parallel: str = "none"  # none | ring | ulysses
     # --- QAT activation fake-quant bits, 0 = off (compression/ wiring) ---
     act_quant_bits: int = 0
+    # --- data efficiency (engine-driven schedules) ---
+    # random-LTD: layers run on a random token subset of this length
+    # (engine re-jits per scheduled value; 0 = off). Applies to all scanned
+    # layers; per-layer subsets need scan_layers=False.
+    random_ltd: bool = False
+    # progressive layer drop: stochastic depth with keep prob
+    # p_l = 1 - (l/L) * (1 - theta); theta is a dynamic scalar from the
+    # engine's PLD schedule (runtime/progressive_layer_drop.py)
+    pld_enabled: bool = False
 
     @property
     def head_dim(self):
@@ -138,75 +147,95 @@ def get_config(preset: str, **overrides) -> TransformerConfig:
 # init
 # ---------------------------------------------------------------------------
 
-def init(rng, cfg: TransformerConfig):
-    """Build the parameter pytree (all leaves fp32; engine casts as needed)."""
-    D, V, L, F, S = cfg.hidden_size, cfg.vocab_size, cfg.num_layers, cfg.ffn_size, cfg.max_seq_len
-    hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
-    keys = iter(jax.random.split(rng, 32))
-
-    def dense(key, shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))).astype(jnp.float32)
-
-    def stack(maker):
-        return jnp.stack([maker(k) for k in jax.random.split(next(keys), L)])
-
-    E = cfg.moe_num_experts
-
-    def estack(maker):
-        """Stack over layers AND experts: (L, E, ...)."""
-        return jnp.stack(
-            [jnp.stack([maker(k) for k in jax.random.split(lk, E)]) for lk in jax.random.split(next(keys), L)]
-        )
-
-    if E > 0:
-        mlp = {
-            "gate": stack(lambda k: jax.random.normal(k, (D, E), jnp.float32) * 0.02),
-            "wi": estack(lambda k: dense(k, (D, F), D)),
-            "wo": estack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
-        }
-        if cfg.activation == "silu_glu":
-            mlp["wg"] = estack(lambda k: dense(k, (D, F), D))
-    else:
-        mlp = {
-            "wi": stack(lambda k: dense(k, (D, F), D)),
-            "wo": stack(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
-        }
-        if cfg.activation == "silu_glu":
-            mlp["wg"] = stack(lambda k: dense(k, (D, F), D))
-
+def init_outer(rng, cfg: TransformerConfig):
+    """Non-layer params: embeddings, final norm, lm head (all fp32)."""
+    D, V, S = cfg.hidden_size, cfg.vocab_size, cfg.max_seq_len
+    k_tok, k_pos, k_head = jax.random.split(rng, 3)
     params = {
-        "embed": {"tok": jax.random.normal(next(keys), (V, D), jnp.float32) * 0.02},
-        "layers": {
-            "attn": {
-                "wq": stack(lambda k: dense(k, (D, nh * hd), D)),
-                "wk": stack(lambda k: dense(k, (D, nkv * hd), D)),
-                "wv": stack(lambda k: dense(k, (D, nkv * hd), D)),
-                "wo": stack(lambda k: dense(k, (nh * hd, D), nh * hd) / math.sqrt(2 * L)),
-            },
-            "mlp": mlp,
-            "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
-            "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
-        },
+        "embed": {"tok": jax.random.normal(k_tok, (V, D), jnp.float32) * 0.02},
         "final_norm": {"scale": jnp.ones((D,), jnp.float32)},
     }
     if cfg.pos_embedding == "learned":
-        params["embed"]["pos"] = jax.random.normal(next(keys), (S, D), jnp.float32) * 0.02
+        params["embed"]["pos"] = jax.random.normal(k_pos, (S, D), jnp.float32) * 0.02
     if not cfg.tie_embeddings:
-        params["lm_head"] = {"w": dense(next(keys), (D, V), D)}
+        params["lm_head"] = {
+            "w": jax.random.normal(k_head, (D, V), jnp.float32) / math.sqrt(D)
+        }
     if cfg.use_bias:
-        params["layers"]["attn"]["bq"] = jnp.zeros((L, nh * hd), jnp.float32)
-        params["layers"]["attn"]["bk"] = jnp.zeros((L, nkv * hd), jnp.float32)
-        params["layers"]["attn"]["bv"] = jnp.zeros((L, nkv * hd), jnp.float32)
-        params["layers"]["attn"]["bo"] = jnp.zeros((L, D), jnp.float32)
-        if E > 0:
-            params["layers"]["mlp"]["bi"] = jnp.zeros((L, E, F), jnp.float32)
-            params["layers"]["mlp"]["bo"] = jnp.zeros((L, E, D), jnp.float32)
-        else:
-            params["layers"]["mlp"]["bi"] = jnp.zeros((L, F), jnp.float32)
-            params["layers"]["mlp"]["bo"] = jnp.zeros((L, D), jnp.float32)
-        params["layers"]["ln1"]["bias"] = jnp.zeros((L, D), jnp.float32)
-        params["layers"]["ln2"]["bias"] = jnp.zeros((L, D), jnp.float32)
         params["final_norm"]["bias"] = jnp.zeros((D,), jnp.float32)
+    return params
+
+
+def _init_one_layer(key, cfg: TransformerConfig):
+    """Unstacked params for a single decoder layer."""
+    D, F, L = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+    hd, nh, nkv, E = cfg.head_dim, cfg.num_heads, cfg.kv_heads, cfg.moe_num_experts
+    ks = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) * (1.0 / math.sqrt(fan_in))
+
+    def experts(maker):
+        return jnp.stack([maker(k) for k in jax.random.split(next(ks), E)])
+
+    if E > 0:
+        mlp = {
+            "gate": jax.random.normal(next(ks), (D, E), jnp.float32) * 0.02,
+            "wi": experts(lambda k: dense(k, (D, F), D)),
+            "wo": experts(lambda k: dense(k, (F, D), F) / math.sqrt(2 * L)),
+        }
+        if cfg.activation == "silu_glu":
+            mlp["wg"] = experts(lambda k: dense(k, (D, F), D))
+    else:
+        mlp = {
+            "wi": dense(next(ks), (D, F), D),
+            "wo": dense(next(ks), (F, D), F) / math.sqrt(2 * L),
+        }
+        if cfg.activation == "silu_glu":
+            mlp["wg"] = dense(next(ks), (D, F), D)
+
+    layer = {
+        "attn": {
+            "wq": dense(next(ks), (D, nh * hd), D),
+            "wk": dense(next(ks), (D, nkv * hd), D),
+            "wv": dense(next(ks), (D, nkv * hd), D),
+            "wo": dense(next(ks), (nh * hd, D), nh * hd) / math.sqrt(2 * L),
+        },
+        "mlp": mlp,
+        "ln1": {"scale": jnp.ones((D,), jnp.float32)},
+        "ln2": {"scale": jnp.ones((D,), jnp.float32)},
+    }
+    if cfg.use_bias:
+        layer["attn"]["bq"] = jnp.zeros((nh * hd,), jnp.float32)
+        layer["attn"]["bk"] = jnp.zeros((nkv * hd,), jnp.float32)
+        layer["attn"]["bv"] = jnp.zeros((nkv * hd,), jnp.float32)
+        layer["attn"]["bo"] = jnp.zeros((D,), jnp.float32)
+        if E > 0:
+            layer["mlp"]["bi"] = jnp.zeros((E, F), jnp.float32)
+            layer["mlp"]["bo"] = jnp.zeros((E, D), jnp.float32)
+        else:
+            layer["mlp"]["bi"] = jnp.zeros((F,), jnp.float32)
+            layer["mlp"]["bo"] = jnp.zeros((D,), jnp.float32)
+        layer["ln1"]["bias"] = jnp.zeros((D,), jnp.float32)
+        layer["ln2"]["bias"] = jnp.zeros((D,), jnp.float32)
+    return layer
+
+
+def init_layer_slice(rng, cfg: TransformerConfig, lo: int, hi: int):
+    """Stacked params for layers [lo, hi) — per-layer keys are ``fold_in``
+    of the absolute layer index, so any slicing yields identical leaves.
+    This is the ZeRO-Infinity streaming-init hook (reference analogue:
+    zero.Init partitioned construction, partition_parameters.py:601):
+    the param-offload tier materialises one sub-group at a time."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(jnp.arange(lo, hi))
+    return jax.vmap(lambda k: _init_one_layer(k, cfg))(keys)
+
+
+def init(rng, cfg: TransformerConfig):
+    """Build the parameter pytree (all leaves fp32; engine casts as needed)."""
+    r_outer, r_layers = jax.random.split(rng)
+    params = init_outer(r_outer, cfg)
+    params["layers"] = init_layer_slice(r_layers, cfg, 0, cfg.num_layers)
     return params
 
 
@@ -401,8 +430,17 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
 from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import resolve_policy as _resolve_remat_policy  # noqa: E402
 
 
-def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
-    """tokens (B, S) int32 -> (logits (B, S, V), moe_aux_loss scalar)."""
+def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
+            ltd_keep_len=None, pld_theta=None):
+    """tokens (B, S) int32 -> (logits (B, S, V), moe_aux_loss scalar).
+
+    ``ltd_keep_len`` (static int) — random-LTD: each participating layer runs
+    on that many randomly kept tokens, outputs scattered back (reference
+    data_routing/basic_layer.py:113; engine advances the schedule and re-jits
+    per value). ``pld_theta`` (dynamic scalar) — progressive layer drop:
+    stochastic depth with keep prob 1 - (l/L)(1-theta) (reference
+    progressive_layer_drop.py, consumed at engine.py:1512).
+    """
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
@@ -410,32 +448,68 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["pos"][:S].astype(dtype)
 
-    layer_fn = partial(_layer_body, cfg=cfg, positions=positions)
+    ltd_on = (
+        cfg.random_ltd and ltd_keep_len is not None and 0 < int(ltd_keep_len) < S
+        and dropout_rng is not None
+    )
+    pld_on = cfg.pld_enabled and pld_theta is not None and dropout_rng is not None
+
+    def layer_with_routing(x_in, layer_p, rng, layer_frac):
+        """One layer + data-efficiency wrappers (LTD token subset, PLD skip)."""
+        r_drop = r_ltd = r_pld = None
+        if rng is not None:
+            r_drop, r_ltd, r_pld = jax.random.split(rng, 3)
+        if ltd_on:
+            from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+                gather_tokens,
+                random_keep_indices,
+                scatter_tokens,
+            )
+
+            idx = random_keep_indices(r_ltd, B, S, int(ltd_keep_len))
+            x_k = gather_tokens(x_in, idx)
+            pos_k = jnp.take_along_axis(positions, idx, axis=1)
+            new_k, aux = _layer_body(x_k, layer_p, cfg=cfg, positions=pos_k, dropout_rng=r_drop)
+            new_x = scatter_tokens(x_in, new_k, idx)
+        else:
+            new_x, aux = _layer_body(x_in, layer_p, cfg=cfg, positions=positions, dropout_rng=r_drop)
+        if pld_on:
+            p_keep = 1.0 - layer_frac * (1.0 - jnp.float32(pld_theta))
+            keep = jax.random.bernoulli(r_pld, p_keep)
+            new_x = jnp.where(keep, new_x, x_in)
+            aux = jnp.where(keep, aux, jnp.zeros_like(aux))
+        return new_x, aux
+
+    layer_fn = layer_with_routing
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy), static_argnums=())
 
     layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
-    needs_rng = (cfg.dropout > 0.0 or cfg.moe_use_rts) and dropout_rng is not None
+    needs_rng = (
+        cfg.dropout > 0.0 or cfg.moe_use_rts or ltd_on or pld_on
+    ) and dropout_rng is not None
+    L = cfg.num_layers
+    layer_fracs = jnp.arange(1, L + 1, dtype=jnp.float32) / L
     if cfg.scan_layers:
         if needs_rng:
-            layer_rngs = jax.random.split(dropout_rng, cfg.num_layers)
+            layer_rngs = jax.random.split(dropout_rng, L)
         else:
-            layer_rngs = jnp.zeros((cfg.num_layers, 2), jnp.uint32)
+            layer_rngs = jnp.zeros((L, 2), jnp.uint32)
 
         def scan_step(carry, inp):
-            layer_p, rng = inp
+            layer_p, rng, frac = inp
             rng = rng if needs_rng else None
-            new_x, aux = layer_fn(carry, layer_p, dropout_rng=rng)
+            new_x, aux = layer_fn(carry, layer_p, rng, frac)
             return new_x, aux
 
-        x, auxs = jax.lax.scan(scan_step, x, (layers, layer_rngs))
+        x, auxs = jax.lax.scan(scan_step, x, (layers, layer_rngs, layer_fracs))
         aux_total = jnp.sum(auxs)
     else:
         aux_total = jnp.float32(0.0)
-        for i in range(cfg.num_layers):
+        for i in range(L):
             layer_p = jax.tree.map(lambda p: p[i], layers)
             rng = jax.random.fold_in(dropout_rng, i) if needs_rng else None
-            x, aux = layer_fn(x, layer_p, dropout_rng=rng)
+            x, aux = layer_fn(x, layer_p, rng, layer_fracs[i])
             aux_total = aux_total + aux
 
     x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
@@ -449,6 +523,85 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None):
 def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
     """tokens (B, S) int32 -> logits (B, S, V)."""
     return forward(params, cfg, tokens, dropout_rng=dropout_rng)[0]
+
+
+# ---------------------------------------------------------------------------
+# streaming (sub-group) execution pieces — ZeRO-Infinity parameter offload
+# (runtime/zero/param_offload.py). The decoder is cut at layer-group
+# boundaries so host-resident weights stream through HBM one group at a
+# time; the activation at each boundary is the only checkpoint kept.
+# Reference analogue: stage3.py sub_group_size streaming +
+# partitioned_param_swapper.py.
+# ---------------------------------------------------------------------------
+
+def embed_fwd(params, cfg: TransformerConfig, tokens):
+    """tokens (..., S) -> embedded activations (..., S, D) in model dtype
+    (leading dims beyond batch — e.g. a microbatch dim — broadcast through)."""
+    dtype = cfg.jnp_dtype
+    S = tokens.shape[-1]
+    x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
+    if cfg.pos_embedding == "learned":
+        x = x + params["embed"]["pos"][:S].astype(dtype)
+    return x
+
+
+def layer_slice_fwd(layers_slice, cfg: TransformerConfig, x):
+    """Run a contiguous group of decoder layers (stacked leaves, leading dim
+    = group size). Returns (x', moe_aux_sum). No dropout in the streaming
+    path (offload training runs at scales where dropout is off)."""
+    B, S, D = x.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    layer_fn = partial(_layer_body, cfg=cfg, positions=positions, dropout_rng=None)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy))
+    dtype = cfg.jnp_dtype
+    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, layers_slice)
+
+    def scan_step(carry, layer_p):
+        new_x, aux = layer_fn(carry, layer_p)
+        return new_x, aux
+
+    x, auxs = jax.lax.scan(scan_step, x, layers)
+    return x, jnp.sum(auxs)
+
+
+def _ce_from_logits(logits, batch, tokens, denom=None):
+    """Shift + masked token cross-entropy shared by loss_fn / head_loss_fwd.
+
+    ``denom`` overrides the masked normalizer — callers that sum partial CE
+    terms across microbatches (the 1F1B pipeline head) pass the GLOBAL mask
+    token count so per-microbatch sums add up to the whole-batch mean.
+    """
+    from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
+
+    if "labels" in batch:
+        labels = batch["labels"]
+        logits_for_loss = logits
+    else:
+        labels = tokens[..., 1:]
+        logits_for_loss = logits[..., :-1, :]
+    nll = softmax_cross_entropy(logits_for_loss, labels)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[..., : nll.shape[-1]].astype(jnp.float32)
+        if denom is None:
+            denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(nll * mask) / denom
+    if denom is not None:
+        return jnp.sum(nll) / denom
+    return jnp.mean(nll)
+
+
+def head_loss_fwd(params, cfg: TransformerConfig, x, batch, denom=None):
+    """Final norm + logits + cross-entropy (MoE aux is added by the caller
+    from the per-group aux sums)."""
+    dtype = cfg.jnp_dtype
+    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("...sd,vd->...sv", x, params["embed"]["tok"].astype(dtype))
+    else:
+        logits = jnp.einsum("...sd,dv->...sv", x, params["lm_head"]["w"].astype(dtype))
+    return _ce_from_logits(logits, batch, batch["input_ids"], denom=denom)
 
 
 # ---------------------------------------------------------------------------
@@ -576,26 +729,15 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
     return logits, {"k": new_k, "v": new_v}
 
 
-def loss_fn(params, cfg: TransformerConfig, batch, rng=None):
+def loss_fn(params, cfg: TransformerConfig, batch, rng=None, ltd_keep_len=None, pld_theta=None):
     """Next-token cross entropy. batch: {'input_ids': (B,S) int32} and
     optional 'labels' (shifted internally if absent) and 'loss_mask'."""
     tokens = batch["input_ids"]
-    logits, moe_aux = forward(params, cfg, tokens, dropout_rng=rng)
-    if "labels" in batch:
-        labels = batch["labels"]
-        logits_for_loss = logits
-    else:
-        labels = tokens[:, 1:]
-        logits_for_loss = logits[:, :-1]
-    from deepspeed_tpu.ops.cross_entropy import softmax_cross_entropy
-
-    nll = softmax_cross_entropy(logits_for_loss, labels)
-    mask = batch.get("loss_mask")
-    if mask is not None:
-        mask = mask[:, : nll.shape[1]].astype(jnp.float32)
-        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    else:
-        ce = jnp.mean(nll)
+    logits, moe_aux = forward(
+        params, cfg, tokens, dropout_rng=rng,
+        ltd_keep_len=ltd_keep_len, pld_theta=pld_theta,
+    )
+    ce = _ce_from_logits(logits, batch, tokens)
     if cfg.moe_num_experts > 0:
         ce = ce + cfg.moe_aux_loss_coef * moe_aux
     return ce
@@ -614,8 +756,11 @@ class TransformerModel:
     def init(self, rng):
         return init(rng, self.cfg)
 
-    def loss(self, params, batch, rng=None):
-        return loss_fn(params, self.cfg, batch, rng=rng)
+    def loss(self, params, batch, rng=None, ltd_keep_len=None, pld_theta=None):
+        return loss_fn(
+            params, self.cfg, batch, rng=rng,
+            ltd_keep_len=ltd_keep_len, pld_theta=pld_theta,
+        )
 
     def apply(self, params, tokens, rng=None):
         return apply(params, self.cfg, tokens, dropout_rng=rng)

@@ -124,6 +124,10 @@ class PipelineConfig:
     stages: int = 1
     partition_method: str = "parameters"
     activation_checkpoint_interval: int = 0
+    # "gpipe": autodiff through the forward scan (O(M) live activations per
+    # stage, no recompute). "1f1b": fused fwd+bwd scan with O(P) live
+    # activations and per-stage recompute (reference schedule.py TrainSchedule)
+    schedule: str = "gpipe"
 
 
 @dataclass

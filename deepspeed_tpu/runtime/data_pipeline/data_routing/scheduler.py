@@ -13,11 +13,19 @@ from typing import Any, Dict, List
 class RandomLTDScheduler:
     def __init__(self, config: Dict[str, Any]):
         cfg = dict(config)
-        self.total_steps = int(cfg.get("total_layer_token_steps", 10000))
-        self.start_tokens = int(cfg.get("random_ltd_layer_token_start", 128))
-        self.max_tokens = int(cfg.get("seq_length", 1024))
+        # reference layout: {"random_ltd_schedule": {"min_value", "max_value",
+        # "schedule_config": {"require_steps", "seq_per_step"}}}
+        sched = cfg.get("random_ltd_schedule", {})
+        scfg = sched.get("schedule_config", {}) if isinstance(sched, dict) else {}
+        self.total_steps = int(
+            scfg.get("require_steps", cfg.get("total_layer_token_steps", 10000))
+        )
+        self.start_tokens = int(
+            sched.get("min_value", cfg.get("random_ltd_layer_token_start", 128))
+        )
+        self.max_tokens = int(sched.get("max_value", cfg.get("seq_length", 1024)))
         self.layer_ids: List[int] = list(cfg.get("random_ltd_layer_id", []))
-        self.step_size = int(cfg.get("token_step_size", 16))
+        self.step_size = int(scfg.get("seq_per_step", cfg.get("token_step_size", 16)))
         self.current_steps = 0
 
     def get_current_seq(self) -> int:

@@ -73,6 +73,19 @@ class MMapIndexedDataset:
         offset += count * 8
         self._doc_idx = np.frombuffer(idx_buf, dtype=np.int64, count=doc_count, offset=offset)
         self._data = np.memmap(data_file_path(path_prefix), dtype=self._dtype, mode="r")
+        if count:
+            # integrity check: the index must cover the .bin exactly. This is
+            # loud where a silent dtype mismatch would corrupt — e.g. a float32
+            # file written before the dtype-table fix decodes as float64 with
+            # half the expected elements.
+            expected = int(self._pointers[-1]) // self._dtype.itemsize + int(self._sizes[-1])
+            if expected != len(self._data):
+                raise ValueError(
+                    f"{path_prefix}.bin holds {len(self._data)} {self._dtype} elements "
+                    f"but the index expects {expected}; the file is truncated or was "
+                    "written with an incompatible dtype table (float32 payloads from "
+                    "before 2026-07 used code 6 and must be rebuilt)"
+                )
 
     def __len__(self):
         return len(self._sizes)

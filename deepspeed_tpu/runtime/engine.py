@@ -200,41 +200,65 @@ class TpuEngine:
         self._host_optimizer = None
         self._nvme_swapper = None
 
+        # --- ZeRO-Infinity parameter offload: host/NVMe weights streamed
+        # through HBM per layer-group (runtime/zero/param_offload.py)
+        self.coordinator = None
+        self.param_offload = config.zero_config.offload_param_enabled()
+        if self.param_offload and self.offload_device == "none":
+            # streamed params require the host optimizer tier (the device
+            # never holds the full tree for a compiled apply step)
+            log_dist("offload_param enabled: promoting offload_optimizer to cpu tier", ranks=[0])
+            config.zero_config.offload_optimizer.device = "cpu"
+            self.offload_device = "cpu"
+
         # --- init params directly into their shardings (zero.Init equivalent:
         # partition at construction, partition_parameters.py:601 — here the
         # initializer is jitted with sharded outputs so full weights never
         # materialise on one device)
         fp32_shardings = self.opt_shardings if self.mixed_precision else self.param_shardings
-        init_fn = jax.jit(model.init, out_shardings=fp32_shardings)
-        master = init_fn(init_rng)
-        if self.offload_device in ("cpu", "nvme"):
-            # master weights + moments leave HBM: host fp32 copies, device
-            # keeps only the model-dtype working params
-            leaves_with_path = jax.tree_util.tree_leaves_with_path(master)
-            self._master_treedef = jax.tree.structure(master)
-            self._host_master = {
-                # explicit copy: device_get returns read-only views of
-                # JAX-owned buffers; the C++ optimizer mutates in place
-                _leaf_key(path): np.array(jax.device_get(leaf), np.float32)
-                for path, leaf in leaves_with_path
-            }
-            cast_fn = jax.jit(
-                lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
-                out_shardings=self.param_shardings,
+        if self.param_offload:
+            # params never materialize in HBM: host-side group-by-group init,
+            # masters live in the host optimizer tier
+            from deepspeed_tpu.runtime.zero.param_offload import ParamOffloadCoordinator
+
+            self.coordinator = ParamOffloadCoordinator(
+                model, mesh, self.policy, self.model_dtype,
+                config.zero_config, self.batch_sharding, init_rng,
             )
-            self.params = cast_fn(master)
-            del master
+            self._host_master = self.coordinator.masters
+            self._master_treedef = jax.tree.structure(abstract_params)
+            self.params = self.coordinator.working
             self.master_params = None
-        elif self.mixed_precision:
-            cast_fn = jax.jit(
-                lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
-                out_shardings=self.param_shardings,
-            )
-            self.master_params = master
-            self.params = cast_fn(master)
         else:
-            self.master_params = None
-            self.params = master
+            master = jax.jit(model.init, out_shardings=fp32_shardings)(init_rng)
+            if self.offload_device in ("cpu", "nvme"):
+                # master weights + moments leave HBM: host fp32 copies, device
+                # keeps only the model-dtype working params
+                leaves_with_path = jax.tree_util.tree_leaves_with_path(master)
+                self._master_treedef = jax.tree.structure(master)
+                self._host_master = {
+                    # explicit copy: device_get returns read-only views of
+                    # JAX-owned buffers; the C++ optimizer mutates in place
+                    _leaf_key(path): np.array(jax.device_get(leaf), np.float32)
+                    for path, leaf in leaves_with_path
+                }
+                cast_fn = jax.jit(
+                    lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
+                    out_shardings=self.param_shardings,
+                )
+                self.params = cast_fn(master)
+                del master
+                self.master_params = None
+            elif self.mixed_precision:
+                cast_fn = jax.jit(
+                    lambda p: jax.tree.map(lambda x: x.astype(self.model_dtype), p),
+                    out_shardings=self.param_shardings,
+                )
+                self.master_params = master
+                self.params = cast_fn(master)
+            else:
+                self.master_params = None
+                self.params = master
 
         # --- optimizer
         if self.offload_device in ("cpu", "nvme"):
@@ -261,12 +285,16 @@ class TpuEngine:
             self.opt_state = None
             self._opt_state_shardings = None
 
-        # --- grad accumulation buffer (fp32, stage-sharded)
-        acc_init = jax.jit(
-            lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), self._abstract_params),
-            out_shardings=self.grad_shardings,
-        )
-        self.grad_acc = acc_init()
+        # --- grad accumulation buffer (fp32, stage-sharded); the param-offload
+        # path accumulates host-side in the coordinator instead
+        if self.param_offload:
+            self.grad_acc = None
+        else:
+            acc_init = jax.jit(
+                lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), self._abstract_params),
+                out_shardings=self.grad_shardings,
+            )
+            self.grad_acc = acc_init()
 
         self.scale_state: LossScaleState = jax.device_put(self.loss_scaler.init(), self.replicated)
 
@@ -295,6 +323,53 @@ class TpuEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config)
+
+        # --- data-efficiency runtime schedules: progressive layer drop +
+        # random-LTD (reference engine.py:1512 PLD theta pass-through;
+        # data_pipeline/data_routing random-LTD scheduler). Both are consumed
+        # by the model forward: PLD theta as a dynamic scalar, the LTD
+        # kept-token count as a static shape (bounded re-jits on the
+        # token_step_size grid — same granularity as curriculum seqlen).
+        self.pld = None
+        pld_cfg = config.progressive_layer_drop or {}
+        if isinstance(pld_cfg, dict) and pld_cfg.get("enabled"):
+            from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+            self.pld = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001)
+            )
+        self.random_ltd_scheduler = None
+        routing = (config.data_efficiency.data_routing or {}) if config.data_efficiency else {}
+        ltd_cfg = routing.get("random_ltd", {}) if isinstance(routing, dict) else {}
+        if routing.get("enabled", True) is False:
+            ltd_cfg = {}
+        if ltd_cfg.get("enabled"):
+            from deepspeed_tpu.runtime.data_pipeline.data_routing.scheduler import RandomLTDScheduler
+
+            merged = dict(ltd_cfg)
+            merged.setdefault("seq_length", getattr(getattr(model, "cfg", None), "max_seq_len", 1024))
+            self.random_ltd_scheduler = RandomLTDScheduler(merged)
+        if self.param_offload and (self.pld is not None or self.random_ltd_scheduler is not None):
+            # the streamed offload path (coordinator.micro_step) has no
+            # PLD/LTD plumbing; running anyway would silently ignore the
+            # configured schedules
+            raise ValueError(
+                "progressive_layer_drop / random-LTD are not supported together "
+                "with zero_optimization.offload_param (the streamed parameter-"
+                "offload forward does not apply data-efficiency schedules)"
+            )
+        # flip the model-side flags so forward() applies the schedules
+        model_cfg = getattr(model, "cfg", None)
+        if model_cfg is not None and hasattr(model_cfg, "pld_enabled"):
+            import dataclasses as _dc
+
+            updates = {}
+            if self.pld is not None and not model_cfg.pld_enabled:
+                updates["pld_enabled"] = True
+            if self.random_ltd_scheduler is not None and not model_cfg.random_ltd:
+                updates["random_ltd"] = True
+            if updates:
+                model.cfg = _dc.replace(model_cfg, **updates)
 
         # --- curriculum learning (reference: engine.py:1673-1676 seqlen
         # truncation per step; schedule in data_pipeline/curriculum_scheduler)
@@ -390,12 +465,15 @@ class TpuEngine:
         denom = float(self.scale_state.scale) * (
             self.gradient_accumulation_steps if not cfg.prescale_gradients else 1.0
         )
-        flat_grads, _ = jax.tree_util.tree_flatten(self.grad_acc)
-        paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(self.grad_acc)]
-        grads = {
-            _leaf_key(p): np.asarray(jax.device_get(g), np.float32) / denom
-            for p, g in zip(paths, flat_grads)
-        }
+        if self.coordinator is not None:
+            grads = self.coordinator.consume_grads(denom)
+        else:
+            flat_grads, _ = jax.tree_util.tree_flatten(self.grad_acc)
+            paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(self.grad_acc)]
+            grads = {
+                _leaf_key(p): np.asarray(jax.device_get(g), np.float32) / denom
+                for p, g in zip(paths, flat_grads)
+            }
         overflow = any(not np.all(np.isfinite(g)) for g in grads.values()) if self.fp16_enabled else False
         gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())))
         clip = cfg.gradient_clipping
@@ -404,19 +482,28 @@ class TpuEngine:
         if not overflow:
             if self._nvme_swapper is not None:
                 updated = self._nvme_swapper.step(grads, lr=lr, grad_scale=factor)
-                # push directly; masters stay on NVMe, not in host RAM
-                self._push_masters_to_device(updated)
+                if self.coordinator is not None:
+                    self.coordinator.refresh_working(updated)
+                    self.params = self.coordinator.working
+                else:
+                    # push directly; masters stay on NVMe, not in host RAM
+                    self._push_masters_to_device(updated)
             else:
                 for key, master in self._host_master.items():
                     g = grads[key] * factor if factor != 1.0 else grads[key]
                     self._host_optimizer.step_buffer(key, master, g, lr=lr)
-                self._push_masters_to_device(self._host_master)
+                if self.coordinator is not None:
+                    self.coordinator.refresh_working(self._host_master)
+                    self.params = self.coordinator.working
+                else:
+                    self._push_masters_to_device(self._host_master)
 
         # loss-scale transition + grad reset (device side)
         self.scale_state = jax.device_put(
             self.loss_scaler.update(self.scale_state, jnp.asarray(overflow)), self.replicated
         )
-        self.grad_acc = self._zero_acc_fn(self.grad_acc)
+        if self.grad_acc is not None:
+            self.grad_acc = self._zero_acc_fn(self.grad_acc)
         return StepMetrics(
             grad_norm=jnp.asarray(gnorm), overflow=jnp.asarray(overflow),
             loss_scale=self.scale_state.scale,
@@ -438,6 +525,13 @@ class TpuEngine:
     # compiled programs
     # ------------------------------------------------------------------
     def _compile_step_fns(self):
+        if self.param_offload:
+            # the coordinator owns the compiled programs (streamed per-group)
+            self._micro_fn = None
+            self._eval_fn = None
+            self._apply_fn = None
+            self._zero_acc_fn = None
+            return
         model = self.model
         cfg = self.config
         gas = self.gradient_accumulation_steps
@@ -449,20 +543,58 @@ class TpuEngine:
         optimizer = self.optimizer
         predivide = cfg.gradient_predivide_factor if cfg.prescale_gradients else 1.0
 
-        def micro_fn(params, grad_acc, batch, rng, scale):
-            def scaled_loss(p):
-                return model.loss(p, batch, rng).astype(jnp.float32) * scale
-
-            loss, grads = jax.value_and_grad(scaled_loss)(params)
-            new_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32) / predivide, grad_acc, grads)
-            return loss / scale, new_acc
-
-        self._micro_fn = jax.jit(
-            micro_fn,
-            donate_argnums=(1,),
-            in_shardings=(self.param_shardings, self.grad_shardings, self.batch_sharding, None, None),
-            out_shardings=(self.replicated, self.grad_shardings),
+        # models may provide their own fused loss+grad program (the 1F1B
+        # pipeline computes grads inside its schedule instead of autodiff
+        # over the whole pipeline — pipe/engine.py value_and_grad)
+        custom_vag = (
+            getattr(model, "value_and_grad", None)
+            if getattr(cfg.pipeline, "schedule", "gpipe") == "1f1b"
+            else None
         )
+        import inspect
+
+        loss_sig = None
+        try:
+            loss_sig = set(inspect.signature(model.loss).parameters)
+        except (TypeError, ValueError):
+            loss_sig = set()
+        accepts_ltd = "ltd_keep_len" in loss_sig
+        accepts_pld = "pld_theta" in loss_sig
+        use_pld = self.pld is not None and accepts_pld
+
+        def build_micro(ltd_keep_len=None):
+            """Jitted micro-step; ``ltd_keep_len`` is static (it sets shapes),
+            PLD theta rides as a dynamic operand (no re-jit as it decays)."""
+
+            def micro_fn(params, grad_acc, batch, rng, scale, pld_theta):
+                if custom_vag is not None:
+                    loss, grads = custom_vag(params, batch, rng, scale)
+                else:
+                    kwargs = {}
+                    if accepts_ltd and ltd_keep_len is not None:
+                        kwargs["ltd_keep_len"] = ltd_keep_len
+                    if use_pld:
+                        kwargs["pld_theta"] = pld_theta
+
+                    def scaled_loss(p):
+                        return model.loss(p, batch, rng, **kwargs).astype(jnp.float32) * scale
+
+                    loss, grads = jax.value_and_grad(scaled_loss)(params)
+                new_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32) / predivide, grad_acc, grads)
+                return loss / scale, new_acc
+
+            return jax.jit(
+                micro_fn,
+                donate_argnums=(1,),
+                in_shardings=(
+                    self.param_shardings, self.grad_shardings, self.batch_sharding, None, None, None,
+                ),
+                out_shardings=(self.replicated, self.grad_shardings),
+            )
+
+        self._micro_builder = build_micro
+        self._micro_jits = {None: build_micro(None)}
+        self._micro_fn = self._micro_jits[None]
 
         def loss_only_fn(params, batch, rng):
             return model.loss(params, batch, rng).astype(jnp.float32)
@@ -600,6 +732,11 @@ class TpuEngine:
         self.tput_timer.start()
         if self.curriculum_scheduler is not None:
             batch = self._curriculum_truncate(batch)
+        if self.coordinator is not None:
+            loss = self.coordinator.micro_step(batch, float(self.scale_state.scale))
+            self._pending_loss = loss
+            self.timers(EngineTimers.FORWARD).stop()
+            return loss
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else self._next_rng()
         if (
@@ -608,8 +745,20 @@ class TpuEngine:
             and self.global_steps + 1 >= self.config.flops_profiler.profile_step
         ):
             self._profile_flops(batch, rng)
-        loss, self.grad_acc = self._micro_fn(
-            self.params, self.grad_acc, batch, rng, self.scale_state.scale
+        keep_len = None
+        if self.random_ltd_scheduler is not None:
+            keep_len = self.random_ltd_scheduler.update_seq(self.global_steps)
+            seq_len = next(
+                (v.shape[-1] for v in jax.tree.leaves(batch) if getattr(v, "ndim", 0) >= 2), None
+            )
+            if seq_len is not None and keep_len >= seq_len:
+                keep_len = None
+        micro = self._micro_jits.get(keep_len)
+        if micro is None:
+            micro = self._micro_jits[keep_len] = self._micro_builder(keep_len)
+        theta = jnp.float32(self.pld.get_theta() if self.pld is not None else 1.0)
+        loss, self.grad_acc = micro(
+            self.params, self.grad_acc, batch, rng, self.scale_state.scale, theta
         )
         self._pending_loss = loss
         self.timers(EngineTimers.FORWARD).stop()
@@ -618,6 +767,8 @@ class TpuEngine:
     __call__ = forward
 
     def eval_batch(self, batch, rng=None):
+        if self.coordinator is not None:
+            return self.coordinator.eval_loss(batch)
         batch = self._shard_batch(batch)
         return self._eval_fn(self.params, batch, rng if rng is not None else self._next_rng())
 
@@ -655,6 +806,8 @@ class TpuEngine:
             )
         self._last_metrics = metrics
         self.global_steps += 1
+        if self.pld is not None:
+            self.pld.update_state(self.global_steps)
         if self.fp16_enabled:
             # dynamic scaling requires reading the overflow flag (host sync,
             # same as the reference's has_overflow allreduce + item())
@@ -681,7 +834,7 @@ class TpuEngine:
         prof = FlopsProfiler(self.model, engine=self)
         try:
             compiled = self._micro_fn.lower(
-                self.params, self.grad_acc, batch, rng, self.scale_state.scale
+                self.params, self.grad_acc, batch, rng, self.scale_state.scale, jnp.float32(1.0)
             ).compile()
             cost = compiled.cost_analysis()
             if isinstance(cost, (list, tuple)):
@@ -694,7 +847,7 @@ class TpuEngine:
                 lambda t: jax.tree.map(jnp.zeros_like, t), out_shardings=self.grad_shardings
             )(self.grad_acc)
             t0 = time.time()
-            out_loss, _ = compiled(self.params, zeros, batch, rng, self.scale_state.scale)
+            out_loss, _ = compiled(self.params, zeros, batch, rng, self.scale_state.scale, jnp.float32(1.0))
             float(out_loss)
             prof.duration = time.time() - t0
             prof.params = count_params(self.params)
@@ -769,9 +922,10 @@ class TpuEngine:
     def _state_tree(self):
         tree = {
             "params": self.params,
-            "grad_acc": self.grad_acc,
             "scale_state": self.scale_state,
         }
+        if self.grad_acc is not None:
+            tree["grad_acc"] = self.grad_acc
         if self.master_params is not None:
             tree["master_params"] = self.master_params
         if self.opt_state is not None:
@@ -836,8 +990,12 @@ class TpuEngine:
         template = self._state_tree()
         restored, meta = self.checkpoint_engine.load(path, template)
         self.params = restored["params"]
-        self.grad_acc = restored["grad_acc"]
+        if "grad_acc" in restored:
+            self.grad_acc = restored["grad_acc"]
         self.scale_state = restored["scale_state"]
+        if self.coordinator is not None:
+            self.coordinator.set_working(restored["params"])
+            self.params = self.coordinator.working
         if "master_params" in restored:
             self.master_params = restored["master_params"]
         if load_optimizer_states and "opt_state" in restored:
@@ -857,6 +1015,8 @@ class TpuEngine:
                 self._nvme_swapper.swapper.synchronize()
             else:
                 self._host_master = masters
+                if self.coordinator is not None:
+                    self.coordinator.masters = masters  # keep the aliases in sync
                 if load_optimizer_states and "host_opt" in restored and self._host_optimizer is not None:
                     self._host_optimizer.load_state_dict(restored["host_opt"])
         self.global_steps = meta.get("global_steps", 0)

@@ -98,7 +98,7 @@ class PipelinedTransformer:
 
         layer_fn = partial(tf._layer_body, cfg=cfg, positions=positions, dropout_rng=None)
         if cfg.remat:
-            layer_fn = jax.checkpoint(layer_fn, policy=tf._REMAT_POLICIES[cfg.remat_policy])
+            layer_fn = jax.checkpoint(layer_fn, policy=tf._resolve_remat_policy(cfg.remat_policy))
 
         layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
 
@@ -138,6 +138,98 @@ class PipelinedTransformer:
         if cfg.moe_num_experts > 0:
             ce = ce + cfg.moe_aux_loss_coef * moe_aux / self.num_microbatches
         return ce
+
+
+    # ------------------------------------------------------------------
+    # 1F1B path: direct gradient computation (no autodiff through the
+    # pipeline scan), selected via config pipeline.schedule == "1f1b"
+    # ------------------------------------------------------------------
+    def value_and_grad(self, params, batch, rng, scale):
+        """(scaled loss, grads) with the memory-bounded fused 1F1B schedule
+        (pipelining.pipeline_1f1b_grads). Matches loss()'s math exactly:
+        mean CE over microbatches + moe aux; grads scaled by ``scale``."""
+        from deepspeed_tpu.runtime.pipe.pipelining import pipeline_1f1b_grads
+
+        cfg = self.cfg
+        tokens = batch["input_ids"]
+        assert tokens.ndim == 3, f"pipeline batch must be (microbatches, mb, seq), got {tokens.shape}"
+        M, mb, S = tokens.shape
+        dtype = cfg.jnp_dtype
+
+        # --- embed under vjp (its grads come back from the pipeline's dx)
+        x_mb, embed_vjp = jax.vjp(
+            lambda emb: tf.embed_fwd({"embed": emb}, cfg, tokens), params["embed"]
+        )
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (mb, S))
+        layer_fn = partial(tf._layer_body, cfg=cfg, positions=positions, dropout_rng=None)
+        if cfg.remat:
+            layer_fn = jax.checkpoint(layer_fn, policy=tf._resolve_remat_policy(cfg.remat_policy))
+        layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+
+        def stage_fn(stage_layers, h):
+            def body(carry, lp):
+                h2, aux = layer_fn(carry, lp)
+                return h2, aux
+
+            h, auxs = jax.lax.scan(body, h, stage_layers)
+            return h, jnp.sum(auxs)
+
+        # --- loss head: final norm + projection + per-microbatch CE, reusing
+        # the streaming head (models/transformer.head_loss_fwd). With a
+        # loss_mask, per-microbatch sums are normalized by the GLOBAL mask
+        # token count so the summed 1F1B loss equals loss()'s whole-batch
+        # masked mean (per-microbatch means would over-weight sparse ones).
+        head_params = {"final_norm": params["final_norm"]}
+        if cfg.tie_embeddings:
+            head_params["proj"] = params["embed"]["tok"]
+        else:
+            head_params["proj"] = params["lm_head"]["w"]
+
+        labels_mb_tree = {"input_ids": tokens}
+        if "labels" in batch:
+            labels_mb_tree["labels"] = batch["labels"]
+        mask = batch.get("loss_mask")
+        global_denom = None
+        if mask is not None:
+            labels_mb_tree["loss_mask"] = mask
+            nll_width = tokens.shape[-1] if "labels" in batch else tokens.shape[-1] - 1
+            global_denom = jnp.maximum(
+                jnp.sum(mask[..., :nll_width].astype(jnp.float32)), 1.0
+            )
+
+        def head_loss_fn(hp, y, labels_mb):
+            pseudo = {"final_norm": hp["final_norm"]}
+            if cfg.tie_embeddings:
+                pseudo["embed"] = {"tok": hp["proj"]}
+            else:
+                pseudo["lm_head"] = {"w": hp["proj"]}
+            if global_denom is not None:
+                ce = tf.head_loss_fwd(pseudo, cfg, y, labels_mb, denom=global_denom)
+                return ce.astype(jnp.float32) * scale
+            ce = tf.head_loss_fwd(pseudo, cfg, y, labels_mb)
+            return ce.astype(jnp.float32) * (scale / M)
+
+        aux_cot = jnp.float32(scale * cfg.moe_aux_loss_coef / M if cfg.moe_num_experts > 0 else 0.0)
+        loss_sum, aux_sum, dlayers, dhead, dx_mb = pipeline_1f1b_grads(
+            layers, x_mb, labels_mb_tree, stage_fn, head_loss_fn, head_params,
+            aux_cot, state_sharding=self._state_sharding(),
+        )
+
+        (dembed,) = embed_vjp(dx_mb.astype(dtype))
+        grads = {
+            "embed": jax.tree.map(lambda g: g.astype(jnp.float32), dembed),
+            "layers": dlayers,
+            "final_norm": dhead["final_norm"],
+        }
+        if cfg.tie_embeddings:
+            grads["embed"] = dict(grads["embed"])
+            grads["embed"]["tok"] = grads["embed"]["tok"] + dhead["proj"]
+        else:
+            grads["lm_head"] = {"w": dhead["proj"]}
+
+        loss = loss_sum + (scale * cfg.moe_aux_loss_coef / M) * aux_sum if cfg.moe_num_experts > 0 else loss_sum
+        return loss, grads
 
 
 class PipelineModuleModel:

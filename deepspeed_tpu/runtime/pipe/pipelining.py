@@ -97,6 +97,151 @@ def pipeline_apply_stacked(
     return outs
 
 
+def pipeline_1f1b_grads(
+    stage_params: Any,
+    x_microbatches: jnp.ndarray,
+    labels_microbatches: Any,
+    stage_fn: Callable[[Any, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    head_loss_fn: Callable[[Any, jnp.ndarray, Any], jnp.ndarray],
+    head_params: Any,
+    aux_cot: jnp.ndarray,
+    state_sharding=None,
+):
+    """Memory-bounded 1F1B pipeline: fused forward+backward in ONE scan.
+
+    The compiled analogue of the reference's 1F1B TrainSchedule
+    (runtime/pipe/schedule.py:189 — warmup fwds, steady-state alternating
+    fwd/bwd, drain) re-derived for SPMD lockstep: every tick runs one
+    vmapped forward AND one vmapped backward across all P stages:
+
+      F(s, m) at tick t = s + m
+      B(s, m) at tick t = 2P - 1 - s + m      (T = M + 2P - 1 ticks)
+
+    so stage ``P-1`` backpropagates microbatch m one tick after computing it
+    — exactly the reference's one-forward-one-backward steady state. Instead
+    of autodiff through the forward scan (which keeps O(M) residuals per
+    stage — GPipe's memory law), each stage stashes only its *boundary
+    input* in a 2P-deep ring and recomputes the stage body inside
+    ``jax.vjp`` at B-time: live activations are O(P) per stage regardless
+    of M (stash lifetime = 2(P-s) - 1 ticks). Gradients accumulate inside
+    the scan carry, the last stage seeds cotangents through
+    ``head_loss_fn`` (loss head evaluated at F(P-1) ticks), and boundary
+    cotangents ride the same collective-permute lanes backwards
+    (roll(-1) vs the forward roll(+1) — reference p2p SendGrad/RecvGrad).
+
+    Args:
+      stage_params: pytree, leaves stage-stacked (P, ...), pipe-sharded.
+      x_microbatches: (M, *act) pipeline inputs (already embedded).
+      labels_microbatches: pytree of (M, ...) per-microbatch loss inputs.
+      stage_fn: (stage_param_slice, x) -> (y, aux_scalar).
+      head_loss_fn: (head_params, y_last_stage, labels_mb) -> scalar loss
+        for ONE microbatch (caller folds in loss scaling / 1/M).
+      head_params: pytree the loss head differentiates against.
+      aux_cot: cotangent for each per-stage aux output (e.g. scaled MoE
+        aux-loss coefficient; 0.0 when unused).
+      state_sharding: optional sharding for the (P, *act) boundary buffers.
+
+    Returns:
+      (loss_sum, aux_sum, d_stage_params, d_head_params, dx_microbatches)
+      — loss_sum/aux_sum are summed over microbatches; gradients are fp32.
+    """
+    M = x_microbatches.shape[0]
+    P = jax.tree.leaves(stage_params)[0].shape[0]
+    S2 = 2 * P  # stash ring depth (max in-flight per stage = 2(P-s)-1)
+    act_shape = x_microbatches.shape[1:]
+    act_dtype = x_microbatches.dtype
+    T = M + 2 * P - 1
+
+    vstage = jax.vmap(stage_fn)
+
+    def stage_vjp(p, x, dy, da):
+        _, vjp = jax.vjp(stage_fn, p, x)
+        return vjp((dy, da))
+
+    vstage_bwd = jax.vmap(stage_vjp)
+
+    head_vag = jax.value_and_grad(head_loss_fn, argnums=(0, 1))
+
+    stage_ids = jnp.arange(P)
+    zero_act = jnp.zeros((P,) + act_shape, act_dtype)
+    zero_act = _constrain(zero_act, state_sharding)
+    stash0 = jnp.zeros((S2, P) + act_shape, act_dtype)
+    dparams0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+    dhead0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), head_params)
+
+    def clip_idx(i, n):
+        return jnp.clip(i, 0, n - 1)
+
+    def tick(carry, t):
+        x_state, dx_state, stash, dparams, dhead, loss_sum, aux_sum = carry
+
+        # ---- forward half-tick: F(s, m = t - s)
+        inp = jax.lax.dynamic_index_in_dim(
+            x_microbatches, clip_idx(t, M), axis=0, keepdims=False
+        )
+        x_state = jax.lax.dynamic_update_index_in_dim(x_state, inp, 0, axis=0)
+        x_state = _constrain(x_state, state_sharding)
+        # stash this tick's stage INPUTS at ring slot (t mod 2P)
+        stash = jax.lax.dynamic_update_slice(
+            stash, x_state[None].astype(act_dtype), (t % S2,) + (0,) * (x_state.ndim)
+        )
+        y, _aux = vstage(stage_params, x_state)
+        mb_f = t - stage_ids
+        valid_f = (mb_f >= 0) & (mb_f < M)
+        aux_sum = aux_sum + jnp.sum(_aux.astype(jnp.float32) * valid_f.astype(jnp.float32))
+
+        # ---- loss head on the last stage's fresh output (seed for B(P-1))
+        y_last = jax.lax.index_in_dim(y, P - 1, axis=0, keepdims=False)
+        m_last = t - (P - 1)
+        labels_mb = jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, clip_idx(m_last, M), axis=0, keepdims=False),
+            labels_microbatches,
+        )
+        valid_last = ((m_last >= 0) & (m_last < M)).astype(jnp.float32)
+        loss_mb, (dhead_mb, dy_seed) = head_vag(head_params, y_last, labels_mb)
+        loss_sum = loss_sum + loss_mb.astype(jnp.float32) * valid_last
+        dhead = jax.tree.map(
+            lambda acc, g: acc + g.astype(jnp.float32) * valid_last, dhead, dhead_mb
+        )
+
+        # ---- backward half-tick: B(s, m = t - (2P - 1 - s))
+        mb_b = t - (2 * P - 1 - stage_ids)
+        valid_b = (mb_b >= 0) & (mb_b < M)
+        # the input of F(s, m_b) was stashed at tick m_b + s = t - (2P-1-2s)
+        read_slot = (t - (2 * P - 1 - 2 * stage_ids)) % S2
+        x_in = jax.vmap(lambda slot, st: st[slot], in_axes=(0, 1))(read_slot, stash)
+        dy = dx_state.astype(act_dtype)
+        da = jnp.broadcast_to(aux_cot, (P,)) * valid_b.astype(jnp.float32)
+        dp, dx = vstage_bwd(stage_params, x_in, dy, da)
+        bmask_f32 = valid_b.astype(jnp.float32)
+
+        def mask_like(g):
+            return g.astype(jnp.float32) * bmask_f32.reshape((P,) + (1,) * (g.ndim - 1))
+
+        dparams = jax.tree.map(lambda acc, g: acc + mask_like(g), dparams, dp)
+        dx = dx.astype(jnp.float32) * bmask_f32.reshape((P,) + (1,) * (dx.ndim - 1))
+        out_dx = jax.lax.index_in_dim(dx, 0, axis=0, keepdims=False)
+
+        # ---- shift lanes: activations forward (+1), cotangents back (-1),
+        # and inject the fresh loss seed at the last stage's slot
+        x_state = jnp.roll(y, 1, axis=0)
+        dx_next = jnp.roll(dx, -1, axis=0)
+        dx_next = jax.lax.dynamic_update_index_in_dim(
+            dx_next, dy_seed.astype(jnp.float32) * valid_last, P - 1, axis=0
+        )
+        dx_next = _constrain(dx_next, state_sharding)
+        return (x_state, dx_next, stash, dparams, dhead, loss_sum, aux_sum), out_dx
+
+    dx0 = jnp.zeros((P,) + act_shape, jnp.float32)
+    dx0 = _constrain(dx0, state_sharding)
+    carry0 = (zero_act, dx0, stash0, dparams0, dhead0, jnp.float32(0.0), jnp.float32(0.0))
+    (x_f, dx_f, _, dparams, dhead, loss_sum, aux_sum), dxs = jax.lax.scan(
+        tick, carry0, jnp.arange(T)
+    )
+    dx_microbatches = dxs[2 * P - 1:]
+    return loss_sum, aux_sum, dparams, dhead, dx_microbatches
+
+
 def pipeline_apply_sequential(
     stage_fns: Sequence[Callable],
     stage_params: Sequence[Any],

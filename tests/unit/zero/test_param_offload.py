@@ -1,0 +1,151 @@
+"""ZeRO-Infinity parameter offload (runtime/zero/param_offload.py).
+
+Reference behaviours covered (SURVEY §2: stage3 offload_param +
+partitioned_param_swapper): params stream through the device one layer-group
+at a time, grads/masters live host-side, NVMe tier round-trips, training
+matches the non-streamed engine, checkpoints resume.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import TransformerModel
+
+
+def _model():
+    return TransformerModel.from_preset(
+        "gpt2-125m",
+        dtype="bfloat16",
+        num_layers=4,
+        hidden_size=64,
+        num_heads=4,
+        vocab_size=128,
+        max_seq_len=32,
+    )
+
+
+def _config(offload_param_device="cpu", sub_group_elems=None, nvme_path=None):
+    import jax
+
+    from deepspeed_tpu.models import transformer as tf
+
+    model = _model()
+    abstract = jax.eval_shape(
+        lambda r: tf.init_layer_slice(r, model.cfg, 0, 1), jax.random.PRNGKey(0)
+    )
+    per_layer = sum(int(np.prod(l.shape[1:])) for l in jax.tree.leaves(abstract))
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.0}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "sub_group_size": sub_group_elems if sub_group_elems is not None else 2 * per_layer,
+            "offload_param": {"device": offload_param_device, "nvme_path": nvme_path},
+            "offload_optimizer": {
+                "device": "cpu" if offload_param_device == "cpu" else "nvme",
+                "nvme_path": nvme_path,
+            },
+        },
+        "mesh": {"data": 2, "fsdp": 4},
+    }
+
+
+def _batch(bs=8, seq=32, vocab=128, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"input_ids": rs.randint(0, vocab, (bs, seq)).astype(np.int32)}
+
+
+def _train(engine, steps=4, seed=0):
+    losses = []
+    for i in range(steps):
+        batch = _batch(seed=seed + i)
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+class TestParamOffloadCpu:
+    def test_groups_and_memory_bound(self):
+        engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=_config())
+        coord = engine.coordinator
+        assert coord is not None
+        # 4 layers, sub_group_size = 2 layers worth of elems -> 2 groups
+        assert coord.n_groups == 2
+        _train(engine, steps=2)
+        import jax
+
+        total_layer_bytes = sum(np.asarray(l).nbytes for l in jax.tree.leaves(engine.params["layers"]))
+        # HBM never saw more than one group's weights at a time
+        assert coord.stats["max_live_group_bytes"] <= total_layer_bytes // coord.n_groups + 1
+        assert coord.stats["h2d_bytes"] > 0
+
+    def test_matches_non_streamed_engine(self):
+        """Streaming fwd/bwd + host Adam must match the offload-optimizer
+        engine (same C++ Adam, whole-model compiled fwd/bwd)."""
+        cfg_stream = _config()
+        engine_s, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg_stream)
+
+        cfg_plain = _config()
+        cfg_plain["zero_optimization"]["offload_param"] = {"device": "none"}
+        engine_p, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg_plain)
+
+        losses_s = _train(engine_s, steps=3)
+        losses_p = _train(engine_p, steps=3)
+        np.testing.assert_allclose(losses_s, losses_p, rtol=2e-2)
+        # masters agree after 3 identical steps
+        for key in ("layers.attn.wq", "embed.tok", "final_norm.scale"):
+            np.testing.assert_allclose(
+                engine_s._host_master[key], engine_p._host_master[key], rtol=3e-2, atol=3e-3
+            )
+
+    def test_loss_drops_and_eval(self):
+        engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=_config())
+        batch = _batch(seed=42)
+        losses = []
+        for _ in range(8):
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.3, losses
+        ev = engine.eval_batch(batch)
+        assert abs(float(ev) - losses[-1]) < 0.5
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        engine, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=_config())
+        _train(engine, steps=2)
+        engine.save_checkpoint(str(tmp_path), tag="t")
+        ref_master = {k: v.copy() for k, v in engine._host_master.items()}
+
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=_config())
+        engine2.load_checkpoint(str(tmp_path), tag="t")
+        for k, v in ref_master.items():
+            np.testing.assert_array_equal(engine2._host_master[k], v)
+        # training continues from the restored state
+        l_cont = _train(engine2, steps=1, seed=10)
+        assert np.isfinite(l_cont[0])
+
+
+class TestParamOffloadNvme:
+    def test_nvme_tier_trains(self, tmp_path):
+        nvme = str(tmp_path / "swap")
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_config("nvme", nvme_path=nvme)
+        )
+        import os
+
+        assert os.path.isdir(os.path.join(nvme, "params"))
+        assert any(f.endswith(".swp") for f in os.listdir(os.path.join(nvme, "params")))
+        batch = _batch(seed=7)
+        losses = []
+        for _ in range(4):
+            loss = engine.forward(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
