@@ -64,6 +64,25 @@ def quantize_signscale(x: jnp.ndarray, error: jnp.ndarray) -> Tuple[jnp.ndarray,
     return signs, scale, new_error
 
 
+def chunked_quantize_ef(flat_padded: jnp.ndarray, worker_error: jnp.ndarray, world: int):
+    """Single-program equivalent of what ``compressed_allreduce`` computes
+    when every member holds the SAME tensor (the pjit case: gradients are
+    GSPMD-reduced before the optimizer, so all workers' compensated momenta
+    are identical): per-destination-chunk sign/scale quantization with error
+    feedback. Returns (quantized [padded], new_worker_error [padded]).
+
+    Identity argument: with identical inputs, phase 1 sums W copies of
+    scale*sign = W*scale*sign per chunk; phase 2's server quantize of that is
+    exact (|W*scale*sign| is constant per chunk), so result/W == scale*sign —
+    this function. Tests assert bitwise equality against the shard_map path.
+    """
+    chunks = (flat_padded + worker_error).reshape(world, -1)
+    scales = jnp.mean(jnp.abs(chunks), axis=1)
+    signs = jnp.where(chunks >= 0, 1, -1).astype(jnp.int8)
+    q = (scales[:, None] * signs.astype(jnp.float32)).reshape(flat_padded.shape)
+    return q, flat_padded + worker_error - q
+
+
 def compressed_allreduce(
     x: jnp.ndarray,
     state: CompressionState,

@@ -470,6 +470,12 @@ class TpuEngine:
         else:
             flat_grads, _ = jax.tree_util.tree_flatten(self.grad_acc)
             paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(self.grad_acc)]
+            # start every D2H copy before blocking on any (reference overlaps
+            # the grad copy with backward, stage_1_and_2.py:1031; here the
+            # copies at least overlap each other and any in-flight compute)
+            for g in flat_grads:
+                if hasattr(g, "copy_to_host_async"):
+                    g.copy_to_host_async()
             grads = {
                 _leaf_key(p): np.asarray(jax.device_get(g), np.float32) / denom
                 for p, g in zip(paths, flat_grads)
@@ -778,6 +784,16 @@ class TpuEngine:
         self.timers(EngineTimers.BACKWARD).start()
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu * comm.dp_world_size()
+        if (
+            self.offload_device in ("cpu", "nvme")
+            and self.coordinator is None
+            and self.is_gradient_accumulation_boundary()
+        ):
+            # kick off grad D2H right behind the (async-dispatched) last
+            # micro-step so transfers overlap the tail of backward compute
+            for g in jax.tree.leaves(self.grad_acc):
+                if hasattr(g, "copy_to_host_async"):
+                    g.copy_to_host_async()
         self.timers(EngineTimers.BACKWARD).stop()
         return loss if loss is not None else self._pending_loss
 

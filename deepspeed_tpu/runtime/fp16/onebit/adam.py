@@ -22,7 +22,9 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.tree import LeafTuple, unpack_leaves
 
 
@@ -31,6 +33,57 @@ class OnebitAdamState(NamedTuple):
     exp_avg: Any  # momentum pytree
     exp_avg_sq: Any  # variance pytree (frozen after freeze_step)
     error: Any  # error-feedback pytree (compression residual)
+    # compressed-backend wire buffers: per leaf {"w": [padded], "s": [padded/W]}
+    comm_state: Any = ()
+
+
+def _pad_len(n: int, world: int) -> int:
+    return int(-(-n // world) * world)
+
+
+def _data_world() -> int:
+    try:
+        from deepspeed_tpu import comm
+
+        return int(comm.get_mesh().shape.get("data", 1))
+    except Exception:
+        return 1
+
+
+def _shard_map_no_repcheck(fn, mesh, in_specs, out_specs):
+    try:
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except TypeError:  # older shard_map API
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def _compressed_sync_leaf(m, cs, mesh, world):
+    """Momentum allreduce over the ``data`` axis through the REAL compressed
+    wire path (runtime/comm/compressed.compressed_allreduce inside shard_map):
+    int8 signs + per-chunk f32 scales ride the all_to_all/all_gather, ~4x
+    less traffic than an fp32 allreduce (26x with sub-byte packing in the
+    reference; int8 is the natural TPU wire type). Returns (synced momentum
+    average, new buffers). All inputs are data-replicated (grads were
+    GSPMD-reduced), so outputs are too — rep-checking is disabled for the
+    error buffers, whose replication is by-construction."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.comm.compressed import CompressionState, compressed_allreduce
+
+    shape = m.shape
+    flat = m.reshape(-1).astype(jnp.float32)
+    pad = cs["w"].shape[0] - flat.shape[0]
+    flat = jnp.pad(flat, (0, pad))
+
+    def inner(flat, we, se):
+        out, st = compressed_allreduce(flat, CompressionState(we, se), "data")
+        return out / world, st.worker_error, st.server_error
+
+    out, we, se = _shard_map_no_repcheck(
+        inner, mesh, in_specs=(P(), P(), P()), out_specs=(P(), P(), P())
+    )(flat, cs["w"], cs["s"])
+    n = int(np.prod(shape or (1,)))
+    return out[:n].reshape(shape), {"w": we, "s": se}
 
 
 def _quantize_ef(m, err):
