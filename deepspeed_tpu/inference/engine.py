@@ -38,6 +38,22 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 class InferenceEngine:
     def __init__(self, model, config=None, params=None, mesh=None, seed: int = 0):
         self.config = InferenceConfig.parse(config)
+        # auto-dispatch (reference: _apply_injection_policy at
+        # inference/engine.py:384 + sharded loading at :338): a checkpoint
+        # path converts shard-by-shard; an HF torch module converts in place
+        if isinstance(model, str):
+            from deepspeed_tpu.module_inject.load_checkpoint import convert_hf_checkpoint
+
+            model, np_params = convert_hf_checkpoint(model)
+            if params is None:
+                params = np_params
+        elif model is not None and hasattr(model, "state_dict") and hasattr(model, "config") \
+                and not isinstance(model, (tf.TransformerModel, tf.TransformerConfig)):
+            from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+            model, np_params = convert_hf_model(model)
+            if params is None:
+                params = np_params
         builtin = isinstance(model, (tf.TransformerModel, tf.TransformerConfig))
         if isinstance(model, tf.TransformerConfig):
             model = tf.TransformerModel(model)

@@ -41,9 +41,9 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None  # GQA; None => MHA
     ffn_hidden_size: Optional[int] = None  # None => 4*hidden (gpt) / derived (llama)
     max_seq_len: int = 1024
-    pos_embedding: str = "learned"  # learned | rope | none
+    pos_embedding: str = "learned"  # learned | rope | alibi | none
     norm_type: str = "layernorm"  # layernorm | rmsnorm
-    activation: str = "gelu"  # gelu | silu_glu (SwiGLU)
+    activation: str = "gelu"  # gelu | relu | silu_glu (SwiGLU)
     tie_embeddings: bool = True
     dtype: str = "float32"  # compute/storage dtype for params & activations
     rope_theta: float = 10000.0
@@ -54,6 +54,17 @@ class TransformerConfig:
     attn_impl: str = "xla"  # xla | pallas (flash attention kernel)
     use_bias: bool = True  # linear/ln biases (gpt2 yes, llama no)
     scan_layers: bool = True
+    # --- architecture variants for the HF injection-policy families
+    # (module_inject/policies.py; reference replace_policy.py:20-26) ---
+    rope_dim: Optional[int] = None  # partial rotary over first rope_dim dims (GPT-J/NeoX)
+    rope_interleaved: bool = False  # GPT-J even/odd pairing (vs llama/neox half-split)
+    parallel_residual: bool = False  # x + attn(h) + mlp(h') in one residual (GPT-J/NeoX)
+    shared_ln: bool = False  # parallel residual feeds mlp from ln1 too (GPT-J)
+    norm_position: str = "pre"  # pre | post (post: BERT / OPT-350m ordering)
+    causal: bool = True  # False = bidirectional encoder attention (BERT)
+    type_vocab_size: int = 0  # token-type-embedding vocab (BERT; 0 = off)
+    embed_norm: bool = False  # LayerNorm over summed embeddings (BERT, BLOOM)
+    lm_head_bias: bool = False  # untied lm head carries a bias (GPT-J)
     # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -105,7 +116,10 @@ class TransformerConfig:
         if self.use_bias:
             per_layer += (D + 2 * kvd + D) + (F + D) + 2 * D  # attn/mlp/ln biases
         emb = V * D + (self.max_seq_len * D if self.pos_embedding == "learned" else 0)
-        head = 0 if self.tie_embeddings else V * D
+        emb += self.type_vocab_size * D
+        if self.embed_norm:
+            emb += D + (D if self.use_bias else 0)
+        head = 0 if self.tie_embeddings else V * D + (V if self.lm_head_bias else 0)
         final = D + (D if self.use_bias else 0)
         return emb + L * per_layer + final + head
 
@@ -157,10 +171,20 @@ def init_outer(rng, cfg: TransformerConfig):
     }
     if cfg.pos_embedding == "learned":
         params["embed"]["pos"] = jax.random.normal(k_pos, (S, D), jnp.float32) * 0.02
+    if cfg.type_vocab_size > 0:
+        params["embed"]["type"] = (
+            jax.random.normal(jax.random.fold_in(k_pos, 1), (cfg.type_vocab_size, D), jnp.float32) * 0.02
+        )
+    if cfg.embed_norm:
+        params["embed_norm"] = {"scale": jnp.ones((D,), jnp.float32)}
+        if cfg.use_bias:
+            params["embed_norm"]["bias"] = jnp.zeros((D,), jnp.float32)
     if not cfg.tie_embeddings:
         params["lm_head"] = {
             "w": jax.random.normal(k_head, (D, V), jnp.float32) / math.sqrt(D)
         }
+        if cfg.lm_head_bias:
+            params["lm_head"]["b"] = jnp.zeros((V,), jnp.float32)
     if cfg.use_bias:
         params["final_norm"]["bias"] = jnp.zeros((D,), jnp.float32)
     return params
@@ -267,12 +291,15 @@ def logical_specs(params, cfg: TransformerConfig):
             return pre + table[last]
         if "ln1" in names or "ln2" in names:
             return pre + ("norm",)
-        if "final_norm" in names:
+        if "final_norm" in names or "embed_norm" in names:
             return ("norm",)
         if "embed" in names:
-            return ("vocab", "embed") if last == "tok" else ("seq", "embed")
+            if last == "tok":
+                return ("vocab", "embed")
+            # pos table shards over seq; the tiny type table stays unsharded
+            return ("seq", "embed") if last == "pos" else (None, "embed")
         if "lm_head" in names:
-            return ("embed", "vocab")
+            return ("embed", "vocab") if last == "w" else ("vocab",)
         return tuple(None for _ in leaf.shape)
 
     return jax.tree_util.tree_map_with_path(annotate, params)
@@ -296,20 +323,50 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
-def _rope(x, positions, theta: float):
-    """Rotary embedding over head_dim (reference analogue:
-    csrc/transformer/inference apply_rotary_pos_emb.cu)."""
+def _rope(x, positions, theta: float, rot_dim: Optional[int] = None, interleaved: bool = False):
+    """Rotary embedding (reference analogue:
+    csrc/transformer/inference apply_rotary_pos_emb.cu).
+
+    ``rot_dim`` rotates only the first rot_dim dims of each head (GPT-J /
+    GPT-NeoX partial rotary); ``interleaved`` pairs even/odd dims (GPT-J)
+    instead of first/second half (llama / NeoX)."""
     B, S, H, hd = x.shape
-    half = hd // 2
+    rd = hd if rot_dim is None else rot_dim
+    rot, rest = x[..., :rd], x[..., rd:]
+    half = rd // 2
     freqs = jnp.exp(-math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # B,S,half
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = x[..., :half], x[..., half:]
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
-    )
+    if interleaved:
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(rot.shape)
+    else:
+        x1, x2 = rot[..., :half], rot[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if rd < hd:
+        out = jnp.concatenate([out, rest.astype(out.dtype)], axis=-1)
     return out.astype(x.dtype)
+
+
+def _alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (press et al.; reference: BLOOM container's
+    alibi path in module_inject/containers/bloom.py lineage)."""
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        slopes = pow2_slopes(n_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(n_heads))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2][: n_heads - closest]
+        slopes = slopes + extra
+    return jnp.asarray(slopes, jnp.float32)
 
 
 def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
@@ -326,59 +383,35 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
 
         mesh = comm.get_mesh()
         if mesh.shape.get("sequence", 1) > 1:
-            return sequence_parallel_attention(q, k, v, impl=cfg.seq_parallel, causal=True, mesh=mesh)
-    if cfg.attn_impl == "pallas":
+            if cfg.pos_embedding == "alibi":
+                raise NotImplementedError("ALiBi bias is not supported under sequence parallelism")
+            return sequence_parallel_attention(q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh)
+    if cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=cfg.causal)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
     scale = 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    logits = jnp.where(causal[None, None, :, :], logits, jnp.float32(-1e30))
+    if cfg.pos_embedding == "alibi":
+        pos = jnp.arange(S, dtype=jnp.float32)
+        rel = pos[None, :] - pos[:, None]  # (q, k): negative into the past
+        logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
+    if cfg.causal:
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(causal[None, None, :, :], logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng):
-    """One decoder layer; shapes: x (B,S,D), layer_params leaves unstacked."""
-    attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
-    ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
-    B, S, D = x.shape
-    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+def _dense_act(cfg: TransformerConfig):
+    return jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
 
-    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg)
-    if cfg.act_quant_bits > 0:
-        from deepspeed_tpu.compression.ops import quantize_activation_ste
 
-        h = quantize_activation_ste(h, bits=cfg.act_quant_bits)
-    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
-    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
-    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
-    if cfg.use_bias:
-        q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
-    q = q.reshape(B, S, nh, hd)
-    k = k.reshape(B, S, nkv, hd)
-    v = v.reshape(B, S, nkv, hd)
-    if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-    attn_out = _attention(q, k, v, cfg, positions).reshape(B, S, nh * hd)
-    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
-    if cfg.use_bias:
-        attn_out = attn_out + attn_p["bo"]
-    if cfg.dropout > 0.0 and dropout_rng is not None:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - cfg.dropout, attn_out.shape)
-        attn_out = jnp.where(keep, attn_out / (1.0 - cfg.dropout), 0.0).astype(attn_out.dtype)
-    x = x + attn_out
-
-    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
-    if cfg.act_quant_bits > 0:
-        from deepspeed_tpu.compression.ops import quantize_activation_ste
-
-        h = quantize_activation_ste(h, bits=cfg.act_quant_bits)
+def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False):
+    """Shared MLP/MoE block: h (B,S,D) -> (out (B,S,D), moe aux loss)."""
     if cfg.moe_num_experts > 0:
         from deepspeed_tpu.moe.sharded_moe import moe_forward
 
@@ -389,7 +422,7 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
                 a = t @ ep["wi"]
                 if cfg.use_bias:
                     a = a + ep["bi"]
-                a = jax.nn.gelu(a)
+                a = _dense_act(cfg)(a)
             out = a @ ep["wo"]
             if cfg.use_bias:
                 out = out + ep["bo"]
@@ -402,27 +435,92 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
             expert_fn,
             expert_params,
             k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor,
+            capacity_factor=cfg.moe_capacity_factor * (2 if decode else 1),
             min_capacity=cfg.moe_min_capacity,
-            rng=dropout_rng if cfg.moe_use_rts else None,
-            use_rts=cfg.moe_use_rts,
+            rng=dropout_rng if (cfg.moe_use_rts and not decode) else None,
+            use_rts=cfg.moe_use_rts and not decode,
             drop_tokens=cfg.moe_drop_tokens,
         )
+        return mlp_out, aux
+    aux = jnp.float32(0.0)
+    if cfg.activation == "silu_glu":
+        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
+        act = jax.nn.silu(gate) * up
     else:
-        aux = jnp.float32(0.0)
-        if cfg.activation == "silu_glu":
-            up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-            gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
-            act = jax.nn.silu(gate) * up
-        else:
-            act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-            if cfg.use_bias:
-                act = act + mlp_p["bi"]
-            act = jax.nn.gelu(act)
-        mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
+        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
         if cfg.use_bias:
-            mlp_out = mlp_out + mlp_p["bo"]
-    return x + mlp_out, aux
+            act = act + mlp_p["bi"]
+        act = _dense_act(cfg)(act)
+    mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
+    if cfg.use_bias:
+        mlp_out = mlp_out + mlp_p["bo"]
+    return mlp_out, aux
+
+
+def _qkv(h, attn_p, cfg: TransformerConfig, positions):
+    """Project h -> (q, k, v) heads with positional transform applied."""
+    B, S, _ = h.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
+    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
+    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
+    if cfg.use_bias:
+        q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
+    q = q.reshape(B, S, nh, hd)
+    k = k.reshape(B, S, nkv, hd)
+    v = v.reshape(B, S, nkv, hd)
+    if cfg.pos_embedding == "rope":
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_dim, cfg.rope_interleaved)
+    return q, k, v
+
+
+def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng):
+    """One decoder layer; shapes: x (B,S,D), layer_params leaves unstacked.
+
+    Residual topologies: pre-LN (GPT-2/llama), post-LN (BERT / OPT-350m
+    ``do_layer_norm_before=False``), and parallel residual (GPT-J / NeoX:
+    x + attn(ln1 x) + mlp(ln1|ln2 x))."""
+    attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
+    ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
+    B, S, D = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def maybe_quant(h):
+        if cfg.act_quant_bits > 0:
+            from deepspeed_tpu.compression.ops import quantize_activation_ste
+
+            return quantize_activation_ste(h, bits=cfg.act_quant_bits)
+        return h
+
+    pre_ln = cfg.norm_position == "pre"
+    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
+    h = maybe_quant(h)
+    q, k, v = _qkv(h, attn_p, cfg, positions)
+    attn_out = _attention(q, k, v, cfg, positions).reshape(B, S, nh * hd)
+    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
+    if cfg.use_bias:
+        attn_out = attn_out + attn_p["bo"]
+    if cfg.dropout > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - cfg.dropout, attn_out.shape)
+        attn_out = jnp.where(keep, attn_out / (1.0 - cfg.dropout), 0.0).astype(attn_out.dtype)
+
+    if cfg.parallel_residual:
+        h2 = h if cfg.shared_ln else maybe_quant(_norm(x, ln2["scale"], ln2.get("bias"), cfg))
+        mlp_out, aux = _mlp_block(h2, mlp_p, cfg, dropout_rng)
+        return x + attn_out + mlp_out, aux
+
+    if pre_ln:
+        x = x + attn_out
+        h = maybe_quant(_norm(x, ln2["scale"], ln2.get("bias"), cfg))
+        mlp_out, aux = _mlp_block(h, mlp_p, cfg, dropout_rng)
+        return x + mlp_out, aux
+
+    # post-LN: norm is applied over residual sums (BERT ordering)
+    x = _norm(x + attn_out, ln1["scale"], ln1.get("bias"), cfg)
+    mlp_out, aux = _mlp_block(maybe_quant(x), mlp_p, cfg, dropout_rng)
+    return _norm(x + mlp_out, ln2["scale"], ln2.get("bias"), cfg), aux
 
 
 # policy registry lives in runtime/activation_checkpointing (shared with the
@@ -431,7 +529,7 @@ from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import resolve
 
 
 def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
-            ltd_keep_len=None, pld_theta=None):
+            ltd_keep_len=None, pld_theta=None, token_types=None, return_hidden=False):
     """tokens (B, S) int32 -> (logits (B, S, V), moe_aux_loss scalar).
 
     ``ltd_keep_len`` (static int) — random-LTD: each participating layer runs
@@ -447,6 +545,12 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["pos"][:S].astype(dtype)
+    if cfg.type_vocab_size > 0:
+        tt = token_types if token_types is not None else jnp.zeros_like(tokens)
+        x = x + jnp.take(params["embed"]["type"], tt, axis=0).astype(dtype)
+    if cfg.embed_norm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg)
 
     ltd_on = (
         cfg.random_ltd and ltd_keep_len is not None and 0 < int(ltd_keep_len) < S
@@ -512,17 +616,28 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
             x, aux = layer_fn(x, layer_p, rng, layer_fracs[i])
             aux_total = aux_total + aux
 
-    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.norm_position == "pre":  # post-LN stacks end normalized already
+        x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if return_hidden:
+        return x, aux_total
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+        if "b" in params.get("lm_head", {}):
+            logits = logits + params["lm_head"]["b"].astype(dtype)
     return logits, aux_total
 
 
-def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None):
+def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None, token_types=None):
     """tokens (B, S) int32 -> logits (B, S, V)."""
-    return forward(params, cfg, tokens, dropout_rng=dropout_rng)[0]
+    return forward(params, cfg, tokens, dropout_rng=dropout_rng, token_types=token_types)[0]
+
+
+def encode(params, cfg: TransformerConfig, tokens, token_types=None):
+    """tokens (B, S) int32 -> final hidden states (B, S, D) (encoder use:
+    the BERT-family injection policies; reference policy ABC policy.py)."""
+    return forward(params, cfg, tokens, token_types=token_types, return_hidden=True)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -542,6 +657,11 @@ def embed_fwd(params, cfg: TransformerConfig, tokens):
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
     if cfg.pos_embedding == "learned":
         x = x + params["embed"]["pos"][:S].astype(dtype)
+    if cfg.type_vocab_size > 0:
+        x = x + params["embed"]["type"][0].astype(dtype)
+    if cfg.embed_norm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg)
     return x
 
 
@@ -596,7 +716,8 @@ def head_loss_fwd(params, cfg: TransformerConfig, x, batch, denom=None):
     """Final norm + logits + cross-entropy (MoE aux is added by the caller
     from the per-group aux sums)."""
     dtype = cfg.jnp_dtype
-    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.norm_position == "pre":
+        x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
     if cfg.tie_embeddings:
         logits = jnp.einsum("...sd,vd->...sv", x, params["embed"]["tok"].astype(dtype))
     else:
@@ -631,18 +752,9 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     T = k_cache.shape[1]
 
-    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg)
-    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
-    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
-    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
-    if cfg.use_bias:
-        q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
-    q = q.reshape(B, S, nh, hd)
-    k = k.reshape(B, S, nkv, hd)
-    v = v.reshape(B, S, nkv, hd)
-    if cfg.pos_embedding == "rope":
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+    pre_ln = cfg.norm_position == "pre"
+    h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
+    q, k, v = _qkv(h, attn_p, cfg, positions)
 
     k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
@@ -655,6 +767,9 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale  # (B,nh,S,T)
     kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
     qpos = positions[0][:, None]  # (S, 1): absolute positions of new tokens
+    if cfg.pos_embedding == "alibi":
+        rel = kpos.astype(jnp.float32) - qpos.astype(jnp.float32)  # (S, T)
+        logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
     mask = kpos <= qpos  # attend to everything written up to and incl. self
     logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
@@ -662,43 +777,21 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
-    x = x + attn_out
 
-    h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
-    if cfg.moe_num_experts > 0:
-        from deepspeed_tpu.moe.sharded_moe import moe_forward
+    if cfg.parallel_residual:
+        h2 = h if cfg.shared_ln else _norm(x, ln2["scale"], ln2.get("bias"), cfg)
+        mlp_out, _ = _mlp_block(h2, mlp_p, cfg, decode=True)
+        return x + attn_out + mlp_out, k_cache, v_cache
 
-        def expert_fn(ep, t):
-            if cfg.activation == "silu_glu":
-                a = jax.nn.silu(t @ ep["wg"]) * (t @ ep["wi"])
-            else:
-                a = t @ ep["wi"]
-                if cfg.use_bias:
-                    a = a + ep["bi"]
-                a = jax.nn.gelu(a)
-            out = a @ ep["wo"]
-            if cfg.use_bias:
-                out = out + ep["bo"]
-            return out
+    if pre_ln:
+        x = x + attn_out
+        h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
+        mlp_out, _ = _mlp_block(h, mlp_p, cfg, decode=True)
+        return x + mlp_out, k_cache, v_cache
 
-        expert_params = {kk2: v2 for kk2, v2 in mlp_p.items() if kk2 != "gate"}
-        mlp_out, _, _ = moe_forward(
-            h, mlp_p["gate"], expert_fn, expert_params, k=cfg.moe_top_k,
-            capacity_factor=cfg.moe_capacity_factor * 2, min_capacity=cfg.moe_min_capacity,
-            drop_tokens=cfg.moe_drop_tokens,
-        )
-    elif cfg.activation == "silu_glu":
-        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
-        mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, mlp_p["wo"])
-    else:
-        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-        if cfg.use_bias:
-            act = act + mlp_p["bi"]
-        mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.gelu(act), mlp_p["wo"])
-        if cfg.use_bias:
-            mlp_out = mlp_out + mlp_p["bo"]
-    return x + mlp_out, k_cache, v_cache
+    x = _norm(x + attn_out, ln1["scale"], ln1.get("bias"), cfg)
+    mlp_out, _ = _mlp_block(x, mlp_p, cfg, decode=True)
+    return _norm(x + mlp_out, ln2["scale"], ln2.get("bias"), cfg), k_cache, v_cache
 
 
 def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
@@ -711,6 +804,12 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
     if cfg.pos_embedding == "learned":
         pos_table = params["embed"]["pos"].astype(dtype)
         x = x + jnp.take(pos_table, jnp.minimum(positions[0], pos_table.shape[0] - 1), axis=0)
+    if cfg.type_vocab_size > 0:
+        # decode has no token-type stream; type 0 matches forward()'s default
+        x = x + params["embed"]["type"][0].astype(dtype)
+    if cfg.embed_norm:
+        en = params["embed_norm"]
+        x = _norm(x, en["scale"], en.get("bias"), cfg)
 
     layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
 
@@ -721,11 +820,14 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
         return h, (k_c, v_c)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))
-    x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
+    if cfg.norm_position == "pre":
+        x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+        if "b" in params.get("lm_head", {}):
+            logits = logits + params["lm_head"]["b"].astype(dtype)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -171,6 +171,8 @@ class OPTPolicy(HFPolicy):
     ARCHITECTURES = ("OPTForCausalLM", "opt")
 
     def config(self, hf_config) -> TransformerConfig:
+        if getattr(hf_config, "word_embed_proj_dim", hf_config.hidden_size) != hf_config.hidden_size:
+            raise NotImplementedError("OPT word_embed_proj_dim != hidden_size (project_in/out) unsupported")
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -180,20 +182,392 @@ class OPTPolicy(HFPolicy):
             max_seq_len=hf_config.max_position_embeddings,
             pos_embedding="learned",
             norm_type="layernorm",
-            activation="gelu",  # OPT uses relu; gelu kept for shared kernel — see note
+            # facebook/opt-* use relu; galactica ships OPT arch with gelu
+            activation=getattr(hf_config, "activation_function", "relu"),
+            # OPT-350m ships do_layer_norm_before=False (post-LN)
+            norm_position="pre" if getattr(hf_config, "do_layer_norm_before", True) else "post",
             tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
             use_bias=True,
         )
 
     def params(self, state, cfg) -> Dict:
-        raise NotImplementedError(
-            "OPT weight relayout requires relu activation + offset position "
-            "embeddings; config translation is provided, weights land with "
-            "the activation-registry extension."
+        L = cfg.num_layers
+        pre = "model.decoder." if any(k.startswith("model.decoder.") for k in state) else "decoder."
+
+        def g(name):
+            return _np(state[pre + name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        params = {
+            "embed": {
+                "tok": g("embed_tokens.weight"),
+                # OPT's learned positions are queried at position+2
+                # (modeling_opt OPTLearnedPositionalEmbedding offset); baking
+                # the offset into the table keeps the model's 0-based lookup
+                "pos": g("embed_positions.weight")[2:],
+            },
+            "layers": {
+                "attn": {
+                    "wq": stackT("layers.{}.self_attn.q_proj.weight"),
+                    "wk": stackT("layers.{}.self_attn.k_proj.weight"),
+                    "wv": stackT("layers.{}.self_attn.v_proj.weight"),
+                    "wo": stackT("layers.{}.self_attn.out_proj.weight"),
+                    "bq": stackB("layers.{}.self_attn.q_proj.bias"),
+                    "bk": stackB("layers.{}.self_attn.k_proj.bias"),
+                    "bv": stackB("layers.{}.self_attn.v_proj.bias"),
+                    "bo": stackB("layers.{}.self_attn.out_proj.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("layers.{}.fc1.weight"),
+                    "wo": stackT("layers.{}.fc2.weight"),
+                    "bi": stackB("layers.{}.fc1.bias"),
+                    "bo": stackB("layers.{}.fc2.bias"),
+                },
+                "ln1": {
+                    "scale": stackB("layers.{}.self_attn_layer_norm.weight"),
+                    "bias": stackB("layers.{}.self_attn_layer_norm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("layers.{}.final_layer_norm.weight"),
+                    "bias": stackB("layers.{}.final_layer_norm.bias"),
+                },
+            },
+        }
+        if cfg.norm_position == "pre":
+            params["final_norm"] = {"scale": g("final_layer_norm.weight"), "bias": g("final_layer_norm.bias")}
+        else:
+            D = cfg.hidden_size
+            params["final_norm"] = {"scale": np.ones(D, np.float32), "bias": np.zeros(D, np.float32)}
+        return params
+
+
+class BloomPolicy(HFPolicy):
+    """reference: BLOOMLayerPolicy (module_inject/containers/bloom.py) —
+    ALiBi positions, embedding LayerNorm, per-head-interleaved fused qkv."""
+
+    ARCHITECTURES = ("BloomForCausalLM", "BloomModel", "bloom")
+
+    def config(self, hf_config) -> TransformerConfig:
+        D = hf_config.hidden_size
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=D,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            max_seq_len=getattr(hf_config, "seq_length", 2048),
+            pos_embedding="alibi",
+            norm_type="layernorm",
+            activation="gelu",
+            tie_embeddings=True,
+            use_bias=True,
+            embed_norm=True,
+            norm_eps=hf_config.layer_norm_epsilon,
         )
 
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        nh, hd = cfg.num_heads, cfg.head_dim
+        pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
 
-POLICIES = [GPT2Policy, LlamaPolicy, OPTPolicy]
+        def g(name):
+            return _np(state[pre + name])
+
+        def qkv_w(i, which):
+            # fused (3D, D) laid out per head: [h, (q|k|v), hd, D]
+            w = g(f"h.{i}.self_attention.query_key_value.weight").reshape(nh, 3, hd, D)
+            return w[:, which].reshape(nh * hd, D).T  # -> (D, nh*hd)
+
+        def qkv_b(i, which):
+            b = g(f"h.{i}.self_attention.query_key_value.bias").reshape(nh, 3, hd)
+            return b[:, which].reshape(nh * hd)
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        params = {
+            "embed": {"tok": g("word_embeddings.weight")},
+            "embed_norm": {
+                "scale": g("word_embeddings_layernorm.weight"),
+                "bias": g("word_embeddings_layernorm.bias"),
+            },
+            "layers": {
+                "attn": {
+                    "wq": np.stack([qkv_w(i, 0) for i in range(L)]),
+                    "wk": np.stack([qkv_w(i, 1) for i in range(L)]),
+                    "wv": np.stack([qkv_w(i, 2) for i in range(L)]),
+                    "wo": stackT("h.{}.self_attention.dense.weight"),
+                    "bq": np.stack([qkv_b(i, 0) for i in range(L)]),
+                    "bk": np.stack([qkv_b(i, 1) for i in range(L)]),
+                    "bv": np.stack([qkv_b(i, 2) for i in range(L)]),
+                    "bo": stackB("h.{}.self_attention.dense.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("h.{}.mlp.dense_h_to_4h.weight"),
+                    "wo": stackT("h.{}.mlp.dense_4h_to_h.weight"),
+                    "bi": stackB("h.{}.mlp.dense_h_to_4h.bias"),
+                    "bo": stackB("h.{}.mlp.dense_4h_to_h.bias"),
+                },
+                "ln1": {
+                    "scale": stackB("h.{}.input_layernorm.weight"),
+                    "bias": stackB("h.{}.input_layernorm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("h.{}.post_attention_layernorm.weight"),
+                    "bias": stackB("h.{}.post_attention_layernorm.bias"),
+                },
+            },
+            "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        }
+        return params
+
+
+class GPTNeoXPolicy(HFPolicy):
+    """reference: GPTNEOXLayerPolicy (module_inject/containers/gptneox.py) —
+    parallel residual, partial rotary (rotary_pct), fused qkv per head."""
+
+    ARCHITECTURES = ("GPTNeoXForCausalLM", "gpt_neox")
+
+    def config(self, hf_config) -> TransformerConfig:
+        hd = hf_config.hidden_size // hf_config.num_attention_heads
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            ffn_hidden_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_embedding="rope",
+            rope_dim=int(hd * getattr(hf_config, "rotary_pct", 1.0)),
+            rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+            norm_type="layernorm",
+            activation="gelu",
+            parallel_residual=getattr(hf_config, "use_parallel_residual", True),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+            use_bias=True,
+            norm_eps=hf_config.layer_norm_eps,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        nh, hd = cfg.num_heads, cfg.head_dim
+        pre = "gpt_neox." if any(k.startswith("gpt_neox.") for k in state) else ""
+
+        def g(name):
+            return _np(state[pre + name] if pre + name in state else state[name])
+
+        def qkv_w(i, which):
+            # fused (3D, D) laid out per head: [h, (q|k|v), hd, D]
+            w = g(f"layers.{i}.attention.query_key_value.weight").reshape(nh, 3, hd, D)
+            return w[:, which].reshape(nh * hd, D).T
+
+        def qkv_b(i, which):
+            b = g(f"layers.{i}.attention.query_key_value.bias").reshape(nh, 3, hd)
+            return b[:, which].reshape(nh * hd)
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        params = {
+            "embed": {"tok": g("embed_in.weight")},
+            "layers": {
+                "attn": {
+                    "wq": np.stack([qkv_w(i, 0) for i in range(L)]),
+                    "wk": np.stack([qkv_w(i, 1) for i in range(L)]),
+                    "wv": np.stack([qkv_w(i, 2) for i in range(L)]),
+                    "wo": stackT("layers.{}.attention.dense.weight"),
+                    "bq": np.stack([qkv_b(i, 0) for i in range(L)]),
+                    "bk": np.stack([qkv_b(i, 1) for i in range(L)]),
+                    "bv": np.stack([qkv_b(i, 2) for i in range(L)]),
+                    "bo": stackB("layers.{}.attention.dense.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("layers.{}.mlp.dense_h_to_4h.weight"),
+                    "wo": stackT("layers.{}.mlp.dense_4h_to_h.weight"),
+                    "bi": stackB("layers.{}.mlp.dense_h_to_4h.bias"),
+                    "bo": stackB("layers.{}.mlp.dense_4h_to_h.bias"),
+                },
+                "ln1": {
+                    "scale": stackB("layers.{}.input_layernorm.weight"),
+                    "bias": stackB("layers.{}.input_layernorm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("layers.{}.post_attention_layernorm.weight"),
+                    "bias": stackB("layers.{}.post_attention_layernorm.bias"),
+                },
+            },
+            "final_norm": {"scale": g("final_layer_norm.weight"), "bias": g("final_layer_norm.bias")},
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = {"w": _np(state["embed_out.weight"]).T}
+        return params
+
+
+class GPTJPolicy(HFPolicy):
+    """reference: HFGPTJLayerPolicy (module_inject/containers/gptj.py) —
+    parallel residual with a single shared LN, interleaved partial rotary,
+    bias-free attention projections, biased lm_head."""
+
+    ARCHITECTURES = ("GPTJForCausalLM", "gptj")
+
+    def config(self, hf_config) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            max_seq_len=hf_config.n_positions,
+            pos_embedding="rope",
+            rope_dim=getattr(hf_config, "rotary_dim", None),
+            rope_interleaved=True,
+            norm_type="layernorm",
+            activation="gelu",
+            parallel_residual=True,
+            shared_ln=True,
+            tie_embeddings=False,
+            lm_head_bias=True,
+            use_bias=True,  # mlp/ln have biases; attn biases are zero-filled
+            norm_eps=hf_config.layer_norm_epsilon,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+
+        def g(name):
+            return _np(state[pre + name] if pre + name in state else state[name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        zeros_b = np.zeros((L, D), np.float32)
+        params = {
+            "embed": {"tok": g("wte.weight")},
+            "layers": {
+                "attn": {
+                    "wq": stackT("h.{}.attn.q_proj.weight"),
+                    "wk": stackT("h.{}.attn.k_proj.weight"),
+                    "wv": stackT("h.{}.attn.v_proj.weight"),
+                    "wo": stackT("h.{}.attn.out_proj.weight"),
+                    "bq": zeros_b,
+                    "bk": zeros_b,
+                    "bv": zeros_b,
+                    "bo": zeros_b,
+                },
+                "mlp": {
+                    "wi": stackT("h.{}.mlp.fc_in.weight"),
+                    "wo": stackT("h.{}.mlp.fc_out.weight"),
+                    "bi": stackB("h.{}.mlp.fc_in.bias"),
+                    "bo": stackB("h.{}.mlp.fc_out.bias"),
+                },
+                "ln1": {"scale": stackB("h.{}.ln_1.weight"), "bias": stackB("h.{}.ln_1.bias")},
+                # shared_ln: ln2 unused; identity keeps the param tree uniform
+                "ln2": {"scale": np.ones((L, D), np.float32), "bias": zeros_b},
+            },
+            "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+            "lm_head": {"w": _np(state["lm_head.weight"]).T, "b": _np(state["lm_head.bias"])},
+        }
+        return params
+
+
+class BertPolicy(HFPolicy):
+    """reference: HFBertLayerPolicy (module_inject/containers/bert.py) —
+    post-LN encoder with token-type embeddings + embedding LayerNorm.
+    Produces the encoder stack; use models.transformer.encode() for
+    last-hidden-state outputs (the reference injects encoder layers only)."""
+
+    ARCHITECTURES = ("BertModel", "BertForMaskedLM", "BertForSequenceClassification", "bert")
+
+    def config(self, hf_config) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            ffn_hidden_size=hf_config.intermediate_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_embedding="learned",
+            norm_type="layernorm",
+            activation="gelu",
+            norm_position="post",
+            causal=False,
+            type_vocab_size=getattr(hf_config, "type_vocab_size", 2),
+            embed_norm=True,
+            tie_embeddings=True,
+            use_bias=True,
+            norm_eps=hf_config.layer_norm_eps,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        pre = "bert." if any(k.startswith("bert.") for k in state) else ""
+
+        def g(name):
+            return _np(state[pre + name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        params = {
+            "embed": {
+                "tok": g("embeddings.word_embeddings.weight"),
+                "pos": g("embeddings.position_embeddings.weight"),
+                "type": g("embeddings.token_type_embeddings.weight"),
+            },
+            "embed_norm": {
+                "scale": g("embeddings.LayerNorm.weight"),
+                "bias": g("embeddings.LayerNorm.bias"),
+            },
+            "layers": {
+                "attn": {
+                    "wq": stackT("encoder.layer.{}.attention.self.query.weight"),
+                    "wk": stackT("encoder.layer.{}.attention.self.key.weight"),
+                    "wv": stackT("encoder.layer.{}.attention.self.value.weight"),
+                    "wo": stackT("encoder.layer.{}.attention.output.dense.weight"),
+                    "bq": stackB("encoder.layer.{}.attention.self.query.bias"),
+                    "bk": stackB("encoder.layer.{}.attention.self.key.bias"),
+                    "bv": stackB("encoder.layer.{}.attention.self.value.bias"),
+                    "bo": stackB("encoder.layer.{}.attention.output.dense.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("encoder.layer.{}.intermediate.dense.weight"),
+                    "wo": stackT("encoder.layer.{}.output.dense.weight"),
+                    "bi": stackB("encoder.layer.{}.intermediate.dense.bias"),
+                    "bo": stackB("encoder.layer.{}.output.dense.bias"),
+                },
+                # post-LN: ln1 = attention.output.LayerNorm, ln2 = output.LayerNorm
+                "ln1": {
+                    "scale": stackB("encoder.layer.{}.attention.output.LayerNorm.weight"),
+                    "bias": stackB("encoder.layer.{}.attention.output.LayerNorm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("encoder.layer.{}.output.LayerNorm.weight"),
+                    "bias": stackB("encoder.layer.{}.output.LayerNorm.bias"),
+                },
+            },
+            # unused at post-LN (forward skips final norm); identity for shape
+            "final_norm": {"scale": np.ones(D, np.float32), "bias": np.zeros(D, np.float32)},
+        }
+        return params
+
+
+POLICIES = [GPT2Policy, LlamaPolicy, OPTPolicy, BloomPolicy, GPTNeoXPolicy, GPTJPolicy, BertPolicy]
 
 
 def policy_for(hf_config) -> HFPolicy:
