@@ -52,7 +52,9 @@ def initialize(
 
         model = _FnModel(loss_fn, params)
 
-    cfg = TpuConfig(config)
+    # an explicit mesh fixes the device count (it may cover a subset of local
+    # devices, e.g. an elastic shrink — elasticity/elastic_agent.py)
+    cfg = TpuConfig(config, mesh_device_count=mesh.devices.size if mesh is not None else None)
 
     pipe_axis = cfg.mesh_axis_sizes().get("pipe", 1)
     if cfg.pipeline.stages > 1 or pipe_axis > 1 or _is_pipeline_model(model):

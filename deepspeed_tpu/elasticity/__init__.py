@@ -1,3 +1,4 @@
+from deepspeed_tpu.elasticity.elastic_agent import elastic_resume, rescale_config
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig,
     ElasticityConfigError,
@@ -14,6 +15,8 @@ __all__ = [
     "ElasticityError",
     "ElasticityIncompatibleWorldSize",
     "compute_elastic_config",
+    "elastic_resume",
     "get_best_candidate_batch_size",
     "get_valid_gpus",
+    "rescale_config",
 ]

@@ -1,0 +1,88 @@
+"""Elastic restart flow: membership change -> reshard -> resume.
+
+TPU-native counterpart of the reference's elasticity v2
+(``elasticity/elastic_agent.py:28`` DSElasticAgent, ``_invoke_run:118`` —
+torchelastic restarts every worker on membership change and the job reloads
+its checkpoint). On TPU there is no per-GPU worker pool to restart: a rescale
+event means the pod slice changed, so the flow is
+
+  1. recompute the batch triad for the new chip count with the v1 elastic
+     batch math (``compute_elastic_config`` — same batch size stays valid,
+     GAS absorbs the change),
+  2. convert the latest engine checkpoint to the universal layout
+     (``checkpoint/ds_to_universal``) — mesh-shape-free fp32 tensors,
+  3. rebuild mesh + engine at the new world size and restore master weights
+     and optimizer state exactly (``load_universal_into_engine``).
+
+``elastic_resume`` is that flow as one call; the ``dstpu`` launcher invokes
+it when started with ``--elastic`` after a rescale.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+def rescale_config(ds_config: Dict[str, Any], new_world_size: int) -> Dict[str, Any]:
+    """Return a copy of ``ds_config`` with the batch triad recomputed for
+    ``new_world_size`` chips via the elastic candidates (reference
+    elasticity.py:233). Raises ElasticityIncompatibleWorldSize when the
+    chip count cannot divide any valid configuration."""
+    final_batch, _valid, micro = compute_elastic_config(ds_config, world_size=new_world_size)
+    cfg = dict(ds_config)
+    cfg["train_batch_size"] = final_batch
+    cfg["train_micro_batch_size_per_gpu"] = micro
+    cfg["gradient_accumulation_steps"] = final_batch // (micro * new_world_size)
+    logger.info(
+        f"elastic rescale to {new_world_size} chips: batch={final_batch} "
+        f"micro={micro} gas={cfg['gradient_accumulation_steps']}"
+    )
+    return cfg
+
+
+def elastic_resume(
+    ds_config: Dict[str, Any],
+    checkpoint_dir: str,
+    new_world_size: int,
+    mesh_shape: Optional[Dict[str, int]] = None,
+    tag: Optional[str] = None,
+    model=None,
+    loss_fn=None,
+    params=None,
+    devices=None,
+    load_optimizer_states: bool = True,
+):
+    """One-call membership-change restart (reference elastic_agent.py:118).
+
+    Saves nothing itself: call after the *previous* incarnation has written a
+    checkpoint. Returns the resumed engine on the new mesh. ``mesh_shape``
+    defaults to all chips on the fsdp axis. ``devices`` restricts the mesh to
+    a subset of local devices (a shrunk slice where the process still sees
+    the old chips; also how tests rescale on one host)."""
+    import deepspeed_tpu
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.checkpoint import ds_to_universal, load_universal_into_engine
+
+    cfg = rescale_config(ds_config, new_world_size)
+    cfg["mesh"] = mesh_shape or {"data": 1, "fsdp": new_world_size}
+
+    uni_dir = os.path.join(checkpoint_dir, "universal")
+    manifest_path = os.path.join(uni_dir, "universal_manifest.json")
+    if not os.path.exists(manifest_path):
+        ds_to_universal(checkpoint_dir, uni_dir, tag=tag)
+
+    comm.destroy()
+    if devices is None:
+        import jax
+
+        devices = jax.devices()[:new_world_size] if len(jax.devices()) > new_world_size else None
+    mesh = comm.init_distributed(mesh_shape=cfg["mesh"], devices=devices, verbose=False)
+    engine, *_ = deepspeed_tpu.initialize(model=model, loss_fn=loss_fn, params=params, config=cfg, mesh=mesh)
+    load_universal_into_engine(engine, uni_dir, load_optimizer_states=load_optimizer_states)
+    logger.info(
+        f"elastic resume complete: world={new_world_size} "
+        f"global_steps={engine.global_steps} mesh={dict(engine.mesh.shape)}"
+    )
+    return engine

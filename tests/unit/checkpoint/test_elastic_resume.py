@@ -1,0 +1,96 @@
+"""Elastic rescale + resume flow (reference: elasticity/elastic_agent.py:118
+membership-change restart; the ZeRO 'elastic checkpoint' reload at a
+different DP world size, engine.py:732)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.elasticity import (
+    ElasticityIncompatibleWorldSize,
+    elastic_resume,
+    rescale_config,
+)
+
+
+def _loss_fn(params, batch, rng):
+    return jnp.mean((batch["x"] @ params["block"]["w"] + params["block"]["b"]) ** 2)
+
+
+def _params():
+    return {"block": {"w": jnp.full((8, 8), 0.25, jnp.float32), "b": jnp.zeros((8,), jnp.float32)}}
+
+
+def _config(world, micro):
+    return {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "mesh": {"data": 1, "fsdp": world},
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 16,
+            "micro_batch_sizes": [1, 2, 4],
+            "min_gpus": 1,
+            "max_gpus": 8,
+            "version": 0.2,
+        },
+    }
+
+
+def _train(engine, steps):
+    rng = np.random.default_rng(0)
+    for _ in range(steps):
+        batch = {"x": rng.normal(size=(engine.train_micro_batch_size_per_gpu * comm.dp_world_size(), 8)).astype(np.float32)}
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+
+
+class TestElasticResume:
+    def test_rescale_config_math(self):
+        cfg = rescale_config(_config(8, 2), new_world_size=4)
+        assert cfg["train_batch_size"] % (cfg["train_micro_batch_size_per_gpu"] * 4) == 0
+        assert cfg["gradient_accumulation_steps"] >= 1
+
+    def test_rescale_incompatible_world(self):
+        import pytest
+
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            rescale_config(_config(8, 2), new_world_size=7)
+
+    def test_save_at_8_resume_at_4(self, tmp_path):
+        """The VERDICT r1 #10 done-criterion: save at 8 devices, rescale to
+        4, resume with identical master weights (+ moments), keep training."""
+        comm.destroy()
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=_loss_fn, params=_params(), config=_config(8, 2))
+        _train(engine, 3)
+        ckpt = str(tmp_path / "ckpt")
+        engine.save_checkpoint(ckpt, tag="pre-rescale")
+        src_w = np.asarray(engine.master_params["block"]["w"], np.float32)
+        src_m = np.asarray(engine.opt_state.exp_avg["block"]["w"], np.float32)
+        src_steps = engine.global_steps
+
+        resumed = elastic_resume(
+            _config(8, 2),
+            ckpt,
+            new_world_size=4,
+            mesh_shape={"data": 1, "fsdp": 4},
+            tag="pre-rescale",
+            loss_fn=_loss_fn,
+            params=_params(),
+        )
+        assert resumed.mesh.shape["fsdp"] == 4
+        np.testing.assert_array_equal(
+            np.asarray(resumed.master_params["block"]["w"], np.float32), src_w
+        )
+        np.testing.assert_allclose(
+            np.asarray(resumed.opt_state.exp_avg["block"]["w"], np.float32), src_m, rtol=1e-6
+        )
+        assert resumed.global_steps == src_steps
+
+        _train(resumed, 1)
+        assert resumed.global_steps == src_steps + 1
