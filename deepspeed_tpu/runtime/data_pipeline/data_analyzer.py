@@ -1,18 +1,32 @@
-"""Dataset difficulty analysis.
+"""Dataset difficulty analysis (map-reduce index build).
 
 TPU-native counterpart of the reference's ``DataAnalyzer``
 (runtime/data_pipeline/data_sampling/data_analyzer.py, 417 LoC): map a metric
-function over every sample (sharded across workers), then reduce into a
-difficulty index consumable by ``DeepSpeedDataSampler``. The reference runs
-this as a distributed map-reduce writing Megatron index files; here the map
-runs over host processes (multiprocessing) and the reduce is a sort — the
-output (metric values + sorted order) is saved as .npy next to the dataset.
+function over every sample, sharded across workers, each worker writing
+Megatron-format partial index files; then reduce by merging the partials into
+the two index files the curriculum sampler consumes:
+
+  ``{metric}_sample_to_metric``  — indexed dataset, item i = [metric(sample_i)]
+  ``{metric}_metric_to_sample``  — indexed dataset, one item per distinct
+      metric value (ascending), holding the sample ids at that value
+
+plus ``{metric}_values.npy`` / ``{metric}_order.npy`` fast-path arrays. The
+reference runs map workers as distributed ranks writing
+``..._worker{n}_thread{t}`` files and merges on rank 0
+(``merge_map_results``); here workers are a thread pool (metric fns are
+numpy/mmap-bound and release the GIL) and the merge is in-process, with the
+same on-disk outputs.
 """
 
 import os
-from typing import Callable, Optional, Sequence
+from typing import Callable, List, Optional
 
 import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    make_builder,
+)
 
 METRIC_SEQLEN = "seqlen"
 
@@ -25,6 +39,14 @@ def seqlen_metric(sample) -> float:
                 return float(len(sample[key]))
         sample = next(iter(sample.values()))
     return float(len(sample))
+
+
+def _s2m_prefix(save_path: str, metric_name: str) -> str:
+    return os.path.join(save_path, f"{metric_name}_sample_to_metric")
+
+
+def _m2s_prefix(save_path: str, metric_name: str) -> str:
+    return os.path.join(save_path, f"{metric_name}_metric_to_sample")
 
 
 class DataAnalyzer:
@@ -42,33 +64,121 @@ class DataAnalyzer:
         self.num_workers = max(1, num_workers)
         self.save_path = save_path
 
+    # -- map phase -------------------------------------------------------
     def _map_range(self, lo: int, hi: int) -> np.ndarray:
         return np.asarray([self.metric_fn(self.dataset[i]) for i in range(lo, hi)], np.float64)
 
-    def run_map_reduce(self) -> np.ndarray:
-        """Compute the metric for every sample; returns the values array and
-        writes {metric_name}_values.npy / {metric_name}_order.npy if save_path."""
-        n = len(self.dataset)
-        if self.num_workers <= 1:
-            values = self._map_range(0, n)
-        else:
-            # thread pool: metric fns are numpy/IO bound (mmap reads release
-            # the GIL); worker processes would re-mmap the dataset per fork
-            from concurrent.futures import ThreadPoolExecutor
+    def _map_worker_to_file(self, worker: int, lo: int, hi: int) -> str:
+        """One map worker: metric values for [lo, hi) written as a partial
+        sample_to_metric indexed dataset (reference: run_map worker files)."""
+        values = self._map_range(lo, hi)
+        prefix = _s2m_prefix(self.save_path, self.metric_name) + f"_worker{worker}"
+        builder = make_builder(prefix, dtype=np.float64)
+        builder.add_items_batched(values, np.ones(values.shape[0], np.int64))
+        builder.finalize()
+        return prefix
 
-            bounds = np.linspace(0, n, self.num_workers + 1, dtype=int)
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                chunks = list(pool.map(lambda se: self._map_range(se[0], se[1]), zip(bounds[:-1], bounds[1:])))
-            values = np.concatenate(chunks) if chunks else np.zeros((0,), np.float64)
-        if self.save_path:
-            os.makedirs(self.save_path, exist_ok=True)
-            np.save(os.path.join(self.save_path, f"{self.metric_name}_values.npy"), values)
-            np.save(
-                os.path.join(self.save_path, f"{self.metric_name}_order.npy"),
-                np.argsort(values, kind="stable"),
-            )
+    # -- reduce phase ----------------------------------------------------
+    def _merge(self, worker_prefixes: List[str], n: int) -> np.ndarray:
+        """Merge partials into the final index files (reference:
+        merge_map_results / merge_index_files)."""
+        # partial .bin payloads are raw float64 single-element items: byte-level
+        # concat (the reference merge_index_files works at this level too)
+        values = np.concatenate(
+            [np.fromfile(p + ".bin", np.float64) for p in worker_prefixes]
+        ) if worker_prefixes else np.zeros((0,), np.float64)
+        assert values.shape[0] == n
+
+        # sample_to_metric: one item per sample
+        s2m = make_builder(_s2m_prefix(self.save_path, self.metric_name), dtype=np.float64)
+        s2m.add_items_batched(values, np.ones(n, np.int64))
+        s2m.finalize()
+
+        # metric_to_sample: one item per distinct metric value (ascending) =
+        # the sample ids at that value — the difficulty-bucket index the
+        # reference's curriculum sampler queries
+        m2s = make_builder(_m2s_prefix(self.save_path, self.metric_name), dtype=np.int64)
+        order = np.argsort(values, kind="stable")
+        sorted_vals = values[order]
+        boundaries = np.flatnonzero(np.diff(sorted_vals)) + 1
+        sizes = np.diff(np.concatenate([[0], boundaries, [n]])) if n else np.zeros((0,), np.int64)
+        distinct = sorted_vals[np.concatenate([[0], boundaries]).astype(np.int64)] if n else np.zeros((0,))
+        m2s.add_items_batched(order.astype(np.int64), sizes)
+        m2s.finalize()
+        np.save(
+            os.path.join(self.save_path, f"{self.metric_name}_metric_values.npy"),
+            np.asarray(distinct, np.float64),
+        )
+
+        # fast-path arrays
+        np.save(os.path.join(self.save_path, f"{self.metric_name}_values.npy"), values)
+        np.save(os.path.join(self.save_path, f"{self.metric_name}_order.npy"), order)
+
+        # worker partials are merge inputs only (the reference removes them too)
+        for p in worker_prefixes:
+            for suffix in (".bin", ".idx"):
+                try:
+                    os.remove(p + suffix)
+                except OSError:
+                    pass
         return values
 
+    def run_map_reduce(self) -> np.ndarray:
+        """Map the metric over every sample, reduce into the on-disk index;
+        returns the per-sample metric values."""
+        n = len(self.dataset)
+        if not self.save_path:
+            # in-memory only: values array, no index files (still threaded)
+            return self._map_values(n)
+        os.makedirs(self.save_path, exist_ok=True)
+        if n == 0:
+            return self._merge([], 0)
+        bounds = np.linspace(0, n, self.num_workers + 1, dtype=int)
+        if self.num_workers <= 1:
+            prefixes = [self._map_worker_to_file(0, 0, n)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(self.num_workers) as pool:
+                prefixes = list(
+                    pool.map(
+                        lambda wse: self._map_worker_to_file(*wse),
+                        [(w, int(bounds[w]), int(bounds[w + 1])) for w in range(self.num_workers)],
+                    )
+                )
+        return self._merge(prefixes, n)
+
+    def _map_values(self, n: int) -> np.ndarray:
+        """Threaded in-memory map (metric fns are numpy/mmap-bound and
+        release the GIL)."""
+        if self.num_workers <= 1 or n == 0:
+            return self._map_range(0, n)
+        from concurrent.futures import ThreadPoolExecutor
+
+        bounds = np.linspace(0, n, self.num_workers + 1, dtype=int)
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            chunks = list(pool.map(lambda se: self._map_range(*se), zip(bounds[:-1], bounds[1:])))
+        return np.concatenate(chunks)
+
+    # -- consumers -------------------------------------------------------
     @staticmethod
     def load_values(save_path: str, metric_name: str = METRIC_SEQLEN) -> np.ndarray:
-        return np.load(os.path.join(save_path, f"{metric_name}_values.npy"))
+        npy = os.path.join(save_path, f"{metric_name}_values.npy")
+        if os.path.exists(npy):
+            return np.load(npy)
+        # fallback: the index file alone (single-element f64 items => raw read)
+        return np.fromfile(_s2m_prefix(save_path, metric_name) + ".bin", np.float64)
+
+    @staticmethod
+    def samples_with_metric_range(
+        save_path: str, lo: float, hi: float, metric_name: str = METRIC_SEQLEN
+    ) -> np.ndarray:
+        """Sample ids whose metric lies in [lo, hi) — the difficulty-bucket
+        query the curriculum sampler issues (reference
+        get_new_cluster/sample_from_clusters lineage)."""
+        vals = np.load(os.path.join(save_path, f"{metric_name}_metric_values.npy"))
+        if vals.size == 0:
+            return np.zeros((0,), np.int64)
+        m2s = MMapIndexedDataset(_m2s_prefix(save_path, metric_name))
+        keep = [m2s[i] for i in np.flatnonzero((vals >= lo) & (vals < hi))]
+        return np.concatenate(keep).astype(np.int64) if keep else np.zeros((0,), np.int64)

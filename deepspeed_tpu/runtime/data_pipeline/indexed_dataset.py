@@ -133,6 +133,21 @@ class MMapIndexedDatasetBuilder:
         self._sizes.append(arr.size)
         self._offset += arr.nbytes
 
+    def add_items_batched(self, flat: np.ndarray, sizes) -> None:
+        """Bulk append: ``flat`` holds the concatenated payloads of items
+        whose lengths are ``sizes`` — one write + vectorized index math
+        instead of a Python loop of ``add_item`` (the map-reduce merge path,
+        reference merge_index_files concatenates at the byte level too)."""
+        flat = np.ascontiguousarray(flat, dtype=self._dtype)
+        sizes = np.asarray(sizes, np.int64)
+        assert flat.size == int(sizes.sum())
+        self._bin.write(flat.tobytes(order="C"))
+        nbytes = sizes * self._dtype.itemsize
+        pointers = self._offset + np.concatenate([[0], np.cumsum(nbytes[:-1])])
+        self._pointers.extend(pointers.tolist())
+        self._sizes.extend(sizes.tolist())
+        self._offset += int(nbytes.sum())
+
     def end_document(self):
         self._doc_idx.append(len(self._sizes))
 

@@ -1,0 +1,85 @@
+"""Memory-tiled linear layers.
+
+TPU-native counterpart of the reference's ``TiledLinear``
+(runtime/zero/tiling.py:32): split a huge linear into row/column tiles so
+peak memory holds one tile, not the whole layer. Under GSPMD the *weight*
+is already sharded by the ZeRO-3/TP policy, so the reference's motivation
+(only one partition's tile gathered at a time) maps to remat granularity
+here: each tile's matmul is wrapped in ``jax.checkpoint`` so neither the
+full gathered weight nor the full activation block is live at once — the
+XLA scheduler streams tiles through HBM. The out-tile loop is a
+``lax.scan`` (single compiled tile body, like the layer scan).
+
+``tiled_linear`` is the functional op; ``TiledLinear`` carries
+init/apply with the reference's (in_splits, out_splits) surface.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tiled_linear(x, w, b=None, in_splits: int = 1, out_splits: int = 1):
+    """y = x @ w (+ b), computed in (in_splits × out_splits) tiles.
+
+    x: (..., D_in); w: (D_in, D_out); b: (D_out,) or None.
+    Requires D_in % in_splits == 0 and D_out % out_splits == 0.
+    """
+    D_in, D_out = w.shape
+    if D_in % in_splits or D_out % out_splits:
+        raise ValueError(
+            f"weight ({D_in},{D_out}) not divisible by splits ({in_splits},{out_splits})"
+        )
+    ti, to = D_in // in_splits, D_out // out_splits
+
+    if in_splits == 1 and out_splits == 1:
+        y = x @ w
+        return y + b if b is not None else y
+
+    # stack tiles: (out_splits, in_splits, ti, to)
+    w_t = w.reshape(in_splits, ti, out_splits, to).transpose(2, 0, 1, 3)
+    x_t = x.reshape(x.shape[:-1] + (in_splits, ti))
+
+    @jax.checkpoint
+    def out_tile(w_o):  # (in_splits, ti, to) -> (..., to)
+        return jnp.einsum("...kt,kto->...o", x_t, w_o)
+
+    y_t = jax.lax.map(out_tile, w_t)  # (out_splits, ..., to)
+    y = jnp.moveaxis(y_t, 0, -2).reshape(x.shape[:-1] + (D_out,))
+    return y + b if b is not None else y
+
+
+class TiledLinear:
+    """Reference-shaped module: ``TiledLinear(in_features, out_features,
+    in_splits=, out_splits=, bias=)`` with init(rng) -> params and
+    apply(params, x)."""
+
+    def __init__(self, in_features: int, out_features: int, in_splits: int = 1,
+                 out_splits: int = 1, bias: bool = True):
+        if in_features % in_splits or out_features % out_splits:
+            raise ValueError("features must divide the split counts")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.bias = bias
+
+    def init(self, rng):
+        kw, _ = jax.random.split(rng)
+        params = {
+            "w": jax.random.normal(kw, (self.in_features, self.out_features), jnp.float32)
+            / math.sqrt(self.in_features)
+        }
+        if self.bias:
+            params["b"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def apply(self, params, x):
+        return tiled_linear(
+            x, params["w"], params.get("b"), in_splits=self.in_splits, out_splits=self.out_splits
+        )
+
+    def __call__(self, params, x):
+        return self.apply(params, x)

@@ -120,6 +120,39 @@ class TestDataAnalyzer:
         cur.update_difficulty(100)  # difficulty 128: everything eligible
         assert len(sampler.eligible_indices()) == len(data)
 
+    def test_index_files_and_bucket_query(self, tmp_path):
+        """The map-reduce build writes the reference's two index datasets
+        (sample_to_metric + metric_to_sample, data_analyzer.py merge flow)
+        and the bucket query answers difficulty ranges from them."""
+        lengths = [4, 16, 64, 8, 32, 128, 4, 16]
+        data = [{"input_ids": np.zeros(l, np.int32)} for l in lengths]
+        analyzer = DataAnalyzer(data, metric_fn=seqlen_metric, save_path=str(tmp_path), num_workers=3)
+        values = analyzer.run_map_reduce()
+
+        assert MMapIndexedDataset.exists(str(tmp_path / "seqlen_sample_to_metric"))
+        assert MMapIndexedDataset.exists(str(tmp_path / "seqlen_metric_to_sample"))
+        # worker partials must be cleaned up after the merge
+        assert not any("worker" in p.name for p in tmp_path.iterdir())
+
+        # sample_to_metric round-trips the values
+        np.testing.assert_array_equal(DataAnalyzer.load_values(str(tmp_path)), lengths)
+
+        # metric_to_sample groups ids by distinct value, ascending
+        m2s = MMapIndexedDataset(str(tmp_path / "seqlen_metric_to_sample"))
+        assert len(m2s) == len(set(lengths))
+        np.testing.assert_array_equal(np.sort(m2s[0]), [0, 6])  # both len-4 samples
+
+        # bucket query: lengths in [8, 32)
+        ids = DataAnalyzer.samples_with_metric_range(str(tmp_path), 8, 32)
+        assert set(ids) == {3, 1, 7}
+
+    def test_empty_dataset(self, tmp_path):
+        analyzer = DataAnalyzer([], save_path=str(tmp_path), num_workers=2)
+        values = analyzer.run_map_reduce()
+        assert values.shape == (0,)
+        assert DataAnalyzer.load_values(str(tmp_path)).shape == (0,)
+        assert DataAnalyzer.samples_with_metric_range(str(tmp_path), 0, 100).shape == (0,)
+
     def test_sampler_iteration(self):
         sampler = DeepSpeedDataSampler(total_samples=100, batch_size=8, seed=1)
         it = iter(sampler)
