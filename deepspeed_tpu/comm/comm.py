@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -310,12 +311,17 @@ def ppermute(tensor, perm, group: GroupLike = None):
 
 
 def broadcast(tensor, src: int = 0, group: GroupLike = None):
-    """Select src's shard on every member (psum of a masked value)."""
+    """Select src's value on every member (psum of a where-masked value —
+    ``where`` not multiply, so non-src members holding NaN/inf garbage
+    can't poison the sum; bools ride as i32)."""
     _log_op("broadcast", tensor, group)
     axes = _axes(group)
     idx = axis_index(group)
-    mask = (idx == src).astype(tensor.dtype)
-    return jax.lax.psum(tensor * mask, axes)
+    was_bool = tensor.dtype == jnp.bool_
+    x = tensor.astype(jnp.int32) if was_bool else tensor
+    x = jnp.where(idx == src, x, jnp.zeros_like(x))
+    out = jax.lax.psum(x, axes)
+    return out.astype(jnp.bool_) if was_bool else out
 
 
 def axis_index(group: GroupLike = None):
