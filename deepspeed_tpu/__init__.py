@@ -52,6 +52,21 @@ def initialize(
 
         model = _FnModel(loss_fn, params)
 
+    # elastic restart (dstpu --elastic, launcher/runner.py): resume from the
+    # latest checkpoint at the current chip count before building a fresh
+    # engine. elastic_resume re-enters initialize() with the guard env set.
+    import os as _os
+
+    if _os.environ.get("DSTPU_ELASTIC") == "1" and _os.environ.get("_DSTPU_ELASTIC_ACTIVE") != "1":
+        import json as _json
+
+        from deepspeed_tpu.elasticity import maybe_elastic_resume
+
+        raw_cfg = config if isinstance(config, dict) else _json.load(open(config))
+        engine = maybe_elastic_resume(raw_cfg, model=model)
+        if engine is not None:
+            return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
     # an explicit mesh fixes the device count (it may cover a subset of local
     # devices, e.g. an elastic shrink — elasticity/elastic_agent.py)
     cfg = TpuConfig(config, mesh_device_count=mesh.devices.size if mesh is not None else None)

@@ -1,4 +1,4 @@
-from deepspeed_tpu.elasticity.elastic_agent import elastic_resume, rescale_config
+from deepspeed_tpu.elasticity.elastic_agent import elastic_resume, maybe_elastic_resume, rescale_config
 from deepspeed_tpu.elasticity.elasticity import (
     ElasticityConfig,
     ElasticityConfigError,
@@ -16,6 +16,7 @@ __all__ = [
     "ElasticityIncompatibleWorldSize",
     "compute_elastic_config",
     "elastic_resume",
+    "maybe_elastic_resume",
     "get_best_candidate_batch_size",
     "get_valid_gpus",
     "rescale_config",

@@ -21,8 +21,38 @@ it when started with ``--elastic`` after a rescale.
 import os
 from typing import Any, Dict, Optional
 
-from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.elasticity.elasticity import ElasticityError, compute_elastic_config
 from deepspeed_tpu.utils.logging import logger
+
+
+def maybe_elastic_resume(ds_config: Dict[str, Any], **kwargs):
+    """The per-process half of ``dstpu --elastic`` (launcher/runner.py):
+    when the launcher exported DSTPU_ELASTIC and a checkpoint directory is
+    known, resume on however many chips this incarnation sees. Returns the
+    resumed engine, or None when not launched elastically / nothing to
+    resume from."""
+    if os.environ.get("DSTPU_ELASTIC") != "1":
+        return None
+    # try every known checkpoint location, not just the first configured one
+    # (a rescaled host may be missing the launcher-named mount while the
+    # config's dir is present locally)
+    candidates = [
+        os.environ.get("DSTPU_ELASTIC_CKPT", ""),
+        ds_config.get("checkpoint", {}).get("dir", ""),
+    ]
+    ckpt = next((c for c in candidates if c and os.path.isdir(c)), "")
+    if not ckpt:
+        logger.warning(
+            f"DSTPU_ELASTIC set but no checkpoint dir exists (tried {[c for c in candidates if c]}) — cold start"
+        )
+        return None
+    import jax
+
+    try:
+        return elastic_resume(ds_config, ckpt, new_world_size=jax.device_count(), **kwargs)
+    except ElasticityError as e:
+        logger.warning(f"elastic resume unavailable ({e}) — cold start")
+        return None
 
 
 def rescale_config(ds_config: Dict[str, Any], new_world_size: int) -> Dict[str, Any]:
@@ -79,7 +109,13 @@ def elastic_resume(
 
         devices = jax.devices()[:new_world_size] if len(jax.devices()) > new_world_size else None
     mesh = comm.init_distributed(mesh_shape=cfg["mesh"], devices=devices, verbose=False)
-    engine, *_ = deepspeed_tpu.initialize(model=model, loss_fn=loss_fn, params=params, config=cfg, mesh=mesh)
+    os.environ["_DSTPU_ELASTIC_ACTIVE"] = "1"  # guard: initialize() must not re-enter us
+    try:
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, loss_fn=loss_fn, params=params, config=cfg, mesh=mesh
+        )
+    finally:
+        os.environ.pop("_DSTPU_ELASTIC_ACTIVE", None)
     load_universal_into_engine(engine, uni_dir, load_optimizer_states=load_optimizer_states)
     logger.info(
         f"elastic resume complete: world={new_world_size} "

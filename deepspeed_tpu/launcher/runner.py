@@ -30,7 +30,10 @@ from typing import Dict, List, Optional, Tuple
 from deepspeed_tpu.utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"
-EXPORT_ENVS = ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME")
+EXPORT_ENVS = (
+    "PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME",
+    "DSTPU_ELASTIC", "DSTPU_ELASTIC_CKPT",
+)
 
 
 def parse_args(args=None):
@@ -56,6 +59,13 @@ def parse_args(args=None):
                         help="TPU pod slice name for --launcher tpu-pod")
     parser.add_argument("--zone", type=str, default="", help="GCP zone for tpu-pod")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic restart: export DSTPU_ELASTIC_* env so the "
+                             "user script resumes via elasticity.elastic_resume "
+                             "when the chip count changed (reference ds_elastic / "
+                             "elastic_agent.py membership-change restart)")
+    parser.add_argument("--elastic_checkpoint_dir", type=str, default="",
+                        help="checkpoint dir elastic restarts resume from")
     parser.add_argument("--no_python", action="store_true")
     parser.add_argument("--module", action="store_true", help="run script as python -m")
     parser.add_argument("user_script", type=str)
@@ -209,6 +219,14 @@ def build_multinode_cmds(args, active: Dict[str, List[int]], master_addr: str) -
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.elastic:
+        # the per-process half lives in elasticity/elastic_agent.py:
+        # the user script (or deepspeed_tpu.initialize via config
+        # 'elasticity') reads these and calls elastic_resume when the
+        # current world size differs from the checkpointed one
+        os.environ["DSTPU_ELASTIC"] = "1"
+        if args.elastic_checkpoint_dir:
+            os.environ["DSTPU_ELASTIC_CKPT"] = args.elastic_checkpoint_dir
     resource_pool = fetch_hostfile(args.hostfile)
     if not resource_pool:
         resource_pool = {"localhost": max(1, args.num_gpus) if args.num_gpus > 0 else 1}

@@ -148,6 +148,21 @@ PRESETS = {
         ffn_hidden_size=28672, max_seq_len=4096, pos_embedding="rope", norm_type="rmsnorm",
         activation="silu_glu", tie_embeddings=False, use_bias=False,
     ),
+    # BASELINE.json tracked inference config (BLOOM-7B kernel injection)
+    "bloom-7b": dict(
+        vocab_size=250880, hidden_size=4096, num_layers=30, num_heads=32,
+        max_seq_len=2048, pos_embedding="alibi", embed_norm=True, tie_embeddings=True,
+    ),
+    "gptj-6b": dict(
+        vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16,
+        max_seq_len=2048, pos_embedding="rope", rope_dim=64, rope_interleaved=True,
+        parallel_residual=True, shared_ln=True, tie_embeddings=False, lm_head_bias=True,
+    ),
+    "gpt-neox-20b": dict(
+        vocab_size=50432, hidden_size=6144, num_layers=44, num_heads=64,
+        ffn_hidden_size=24576, max_seq_len=2048, pos_embedding="rope", rope_dim=24,
+        parallel_residual=True, tie_embeddings=False,
+    ),
 }
 
 

@@ -62,6 +62,26 @@ class TestElasticResume:
         with pytest.raises(ElasticityIncompatibleWorldSize):
             rescale_config(_config(8, 2), new_world_size=7)
 
+    def test_initialize_auto_resumes_under_dstpu_elastic(self, tmp_path, monkeypatch):
+        """dstpu --elastic contract: a plain deepspeed_tpu.initialize() call
+        must resume from the exported checkpoint without script changes
+        (launcher/runner.py --elastic -> maybe_elastic_resume)."""
+        comm.destroy()
+        engine, *_ = deepspeed_tpu.initialize(loss_fn=_loss_fn, params=_params(), config=_config(8, 2))
+        _train(engine, 2)
+        ckpt = str(tmp_path / "ckpt")
+        engine.save_checkpoint(ckpt, tag="latest-run")
+        src_w = np.asarray(engine.master_params["block"]["w"], np.float32)
+        comm.destroy()
+
+        monkeypatch.setenv("DSTPU_ELASTIC", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_CKPT", ckpt)
+        resumed, *_ = deepspeed_tpu.initialize(loss_fn=_loss_fn, params=_params(), config=_config(8, 2))
+        assert resumed.global_steps == 2
+        np.testing.assert_array_equal(
+            np.asarray(resumed.master_params["block"]["w"], np.float32), src_w
+        )
+
     def test_save_at_8_resume_at_4(self, tmp_path):
         """The VERDICT r1 #10 done-criterion: save at 8 devices, rescale to
         4, resume with identical master weights (+ moments), keep training."""

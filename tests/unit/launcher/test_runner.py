@@ -119,6 +119,24 @@ class TestCommands:
         assert cmds[0][1][0] == "srun"
 
 
+class TestElasticFlag:
+    def test_parse_elastic_args(self):
+        args = R.parse_args(["--elastic", "--elastic_checkpoint_dir", "/ckpt", "train.py"])
+        assert args.elastic
+        assert args.elastic_checkpoint_dir == "/ckpt"
+
+    def test_maybe_elastic_resume_gating(self, monkeypatch, tmp_path):
+        from deepspeed_tpu.elasticity import maybe_elastic_resume
+
+        # not launched elastically -> None
+        monkeypatch.delenv("DSTPU_ELASTIC", raising=False)
+        assert maybe_elastic_resume({}) is None
+        # elastic but no checkpoint -> None (cold start)
+        monkeypatch.setenv("DSTPU_ELASTIC", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_CKPT", str(tmp_path / "missing"))
+        assert maybe_elastic_resume({}) is None
+
+
 class TestLaunchEnv:
     def test_sparse_slot_ids_no_collision(self):
         """Filtered (sparse) slot lists must still give globally unique,
@@ -161,7 +179,7 @@ class TestEndToEnd:
             [sys.executable, "-m", "deepspeed_tpu.launcher.runner", "--hostfile",
              "/nonexistent", str(script)],
             cwd="/root/repo",
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
         )
         assert rc == 0
         assert out.read_text() == "1"
@@ -170,7 +188,7 @@ class TestEndToEnd:
         rc = subprocess.run(
             [sys.executable, "-m", "deepspeed_tpu.env_report"],
             cwd="/root/repo",
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            env={**os.environ, "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
             capture_output=True,
             text=True,
         )
