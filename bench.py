@@ -240,11 +240,16 @@ def bench_gpt2_train():
     from deepspeed_tpu.models.transformer import TransformerModel
 
     seq, micro_bs = (64, 2) if _SMOKE else (1024, 8)
+    # A/B knobs for on-chip tuning (PERF.md): attention impl + remat toggle
+    attn = os.environ.get("DSTPU_BENCH_ATTN", "xla")
+    remat = os.environ.get("DSTPU_BENCH_REMAT", "1") == "1"
+    micro_bs = int(os.environ.get("DSTPU_BENCH_BS", micro_bs))
     if _SMOKE:
-        model = _smoke_model(seq, remat=True, remat_policy="dots_saveable")
+        model = _smoke_model(seq, remat=remat, remat_policy="dots_saveable", attn_impl=attn)
     else:
         model = TransformerModel.from_preset(
-            "gpt2-125m", dtype="bfloat16", remat=True, remat_policy="dots_saveable", max_seq_len=seq
+            "gpt2-125m", dtype="bfloat16", remat=remat, remat_policy="dots_saveable",
+            max_seq_len=seq, attn_impl=attn,
         )
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
@@ -267,6 +272,8 @@ def bench_gpt2_train():
             "loss": loss,
             "seq_len": seq,
             "micro_bs": micro_bs,
+            "attn_impl": attn,
+            "remat": remat,
             "n_devices": jax.device_count(),
             "device_kind": jax.devices()[0].device_kind,
             "step_ms": round(dt * 1e3, 2),
