@@ -11,7 +11,9 @@ Covers the BASELINE.json tracked-config classes that fit one chip
   3. decode         — KV-cache greedy decode tokens/s (inference engine);
                       vs_baseline is the HBM-bandwidth roofline fraction
                       (decode is bandwidth-bound: bytes-of-weights/token).
-  4. gpt2-train     — headline GPT-2 125M causal-LM training (PRIMARY —
+  4. hybrid-rlhf    — hybrid-engine rollout (generate) + train step on the
+                      same weights, end-to-end tokens/s.
+  5. gpt2-train     — headline GPT-2 125M causal-LM training (PRIMARY —
                       printed LAST; the driver parses the final JSON line).
 
 Each config prints one JSON line; the primary line's extra.suite carries
@@ -236,6 +238,67 @@ def bench_decode():
     }
 
 
+def bench_hybrid_rlhf():
+    """RLHF hybrid-engine roundtrip: generate (rollout) + train step on the
+    same weights (BASELINE.json tracked config class; reference
+    DeepSpeed-Chat loop, hybrid_engine.py:168)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    seq, gen_tokens, micro_bs = (32, 8, 2) if _SMOKE else (256, 128, 4)
+    if _SMOKE:
+        model = _smoke_model(64)
+    else:
+        model = TransformerModel.from_preset(
+            "gpt2-125m", dtype="bfloat16", remat=True, remat_policy="dots_saveable", max_seq_len=1024
+        )
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-5}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "hybrid_engine": {"enabled": True},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rs = np.random.RandomState(0)
+    n_dev = jax.device_count()
+    prompts = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (micro_bs * n_dev, seq)), jnp.int32)
+
+    def roundtrip():
+        rollout = engine.generate(prompts, max_new_tokens=gen_tokens)
+        batch = {"input_ids": np.asarray(rollout)}
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    loss = roundtrip()  # compile both programs
+    _sync(engine, loss)
+    iters = 2 if _SMOKE else 5
+    t0 = time.time()
+    for _ in range(iters):
+        loss = roundtrip()
+    _sync(engine, loss)
+    dt = (time.time() - t0) / iters
+    # end-to-end RLHF tokens/s: generated tokens pushed through rollout+train
+    tok_s = micro_bs * n_dev * gen_tokens / dt
+    return {
+        "metric": "rlhf_hybrid_rollout_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # reference reports wall-clock-to-train, not tok/s
+        "extra": {
+            "roundtrip_ms": round(dt * 1e3, 1),
+            "prompt_len": seq,
+            "gen_tokens": gen_tokens,
+            "micro_bs": micro_bs,
+            "loss": float(loss),
+        },
+    }
+
+
 def bench_gpt2_train():
     from deepspeed_tpu.models.transformer import TransformerModel
 
@@ -289,6 +352,7 @@ def main():
             ("zero3_offload", bench_zero3_offload),
             ("moe_ep", bench_moe_ep),
             ("decode", bench_decode),
+            ("hybrid_rlhf", bench_hybrid_rlhf),
         ):
             try:
                 result = fn()

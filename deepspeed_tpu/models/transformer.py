@@ -848,11 +848,13 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
 
 def loss_fn(params, cfg: TransformerConfig, batch, rng=None, ltd_keep_len=None, pld_theta=None):
     """Next-token cross entropy. batch: {'input_ids': (B,S) int32} and
-    optional 'labels' (shifted internally if absent) and 'loss_mask'."""
+    optional 'labels' (shifted internally if absent), 'loss_mask', and
+    'token_type_ids' (BERT-family segment ids)."""
     tokens = batch["input_ids"]
     logits, moe_aux = forward(
         params, cfg, tokens, dropout_rng=rng,
         ltd_keep_len=ltd_keep_len, pld_theta=pld_theta,
+        token_types=batch.get("token_type_ids"),
     )
     ce = _ce_from_logits(logits, batch, tokens)
     if cfg.moe_num_experts > 0:
