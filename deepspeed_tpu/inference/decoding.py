@@ -49,31 +49,44 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
     return prefill_fn, decode_fn, cache_sh, batch_sh
 
 
-def select_token(logits, temperature: float, top_k: int, rng) -> jnp.ndarray:
-    """Greedy / temperature / top-k sampling of one token per row."""
+def select_token(logits, temperature: float, top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
+    """Greedy / temperature / top-k / nucleus (top-p) sampling, one token
+    per row."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        # keep the smallest prefix of the sorted distribution with
+        # cumulative mass >= top_p; the first token is always kept
+        # (top_p <= 0 therefore means top-1)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # mass BEFORE this token still below p; the epsilon floor keeps the
+        # top token in-support even at top_p=0.0
+        keep = cum - probs < max(top_p, 1e-9)
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: int,
-                temperature: float, top_k: int, rng) -> jnp.ndarray:
+                temperature: float, top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
     """Prefill + token-by-token decode; returns (B, S + max_new_tokens)."""
     if max_new_tokens <= 0:
         return tokens
     S = tokens.shape[1]
     logits, cache = prefill_fn(params, tokens, cache)
-    last = select_token(logits[:, -1], temperature, top_k, rng)
+    last = select_token(logits[:, -1], temperature, top_k, rng, top_p)
     out = [last]
     pos = S
     for _ in range(max_new_tokens - 1):
         rng, sub = jax.random.split(rng)
         step_logits, cache = decode_fn(params, out[-1][:, None], cache, pos)
-        out.append(select_token(step_logits, temperature, top_k, sub))
+        out.append(select_token(step_logits, temperature, top_k, sub, top_p))
         pos += 1
     return jnp.concatenate([tokens, jnp.stack(out, axis=1)], axis=1)
 

@@ -185,6 +185,7 @@ class InferenceEngine:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         top_k: int = 0,
+        top_p: float = 1.0,
         rng: Optional[jax.Array] = None,
         eos_token_id: Optional[int] = None,
     ):
@@ -207,7 +208,7 @@ class InferenceEngine:
         t0 = time.time()
         result = decode_loop(
             self._prefill_fn, self._decode_fn, self.params, tokens, cache,
-            max_new_tokens, temperature, top_k, rng,
+            max_new_tokens, temperature, top_k, rng, top_p=top_p,
         )
         if self.config.profile_model_time:
             jax.block_until_ready(result)
@@ -217,10 +218,10 @@ class InferenceEngine:
         return result
 
     @staticmethod
-    def _select(logits, temperature, top_k, rng):
+    def _select(logits, temperature, top_k, rng, top_p=1.0):
         from deepspeed_tpu.inference.decoding import select_token
 
-        return select_token(logits, temperature, top_k, rng)
+        return select_token(logits, temperature, top_k, rng, top_p)
 
     @staticmethod
     def _truncate_eos(tokens, prompt_len, eos_id):

@@ -106,7 +106,10 @@ class FlopsProfilerConfig:
 
 @dataclass
 class MeshConfig:
-    """Device mesh axis sizes; -1 on one axis absorbs the remainder."""
+    """Device mesh axis sizes; -1 on one axis absorbs the remainder.
+    ``dcn`` holds per-axis DCN (cross-slice) factors for multi-slice pods —
+    the per-axis ICI size times its DCN factor gives the full axis
+    (comm.build_mesh hybrid path)."""
 
     pipe: int = 1
     data: int = -1
@@ -114,9 +117,13 @@ class MeshConfig:
     expert: int = 1
     sequence: int = 1
     tensor: int = 1
+    dcn: Optional[dict] = None
 
     def to_dict(self):
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        if d.get("dcn") is None:
+            d.pop("dcn", None)
+        return d
 
 
 @dataclass
@@ -298,12 +305,21 @@ class TpuConfig:
 
     def mesh_axis_sizes(self) -> Dict[str, int]:
         import jax
+        import numpy as np
 
         n = self._mesh_device_count or jax.device_count()
         shape = self.mesh.to_dict()
-        from deepspeed_tpu.comm.comm import _normalize_mesh_shape
+        dcn = shape.pop("dcn", None) or {}
+        from deepspeed_tpu.comm.comm import MESH_AXES, _normalize_mesh_shape
 
-        return _normalize_mesh_shape(shape, n)
+        unknown = set(dcn) - set(MESH_AXES)
+        if unknown:
+            raise ConfigError(f"Unknown DCN mesh axes {unknown}; valid axes: {MESH_AXES}")
+        n_dcn = int(np.prod(list(dcn.values()))) if dcn else 1
+        if n % n_dcn != 0:
+            raise ConfigError(f"{n} devices not divisible by {n_dcn} DCN granules (mesh.dcn={dcn})")
+        ici = _normalize_mesh_shape(shape, n // n_dcn)
+        return {ax: ici[ax] * int(dcn.get(ax, 1)) for ax in ici}
 
     # --- dtype resolution ----------------------------------------------
     def model_dtype(self):

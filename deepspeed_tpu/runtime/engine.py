@@ -733,6 +733,22 @@ class TpuEngine:
                 out[key] = arr[:, :seqlen]
         return out
 
+    # -- trace capture (reference aux: NVTX ranges + torch profiler hooks;
+    # here the XLA-native equivalent is an xplane trace, SURVEY §5a) -------
+    def start_profile(self, logdir: str):
+        """Begin a jax.profiler trace (view in TensorBoard / xprof)."""
+        import jax.profiler
+
+        jax.profiler.start_trace(logdir)
+        self._profiling = True
+
+    def stop_profile(self):
+        import jax.profiler
+
+        if getattr(self, "_profiling", False):
+            jax.profiler.stop_trace()
+            self._profiling = False
+
     def forward(self, batch, rng=None):
         self.timers(EngineTimers.FORWARD).start()
         self.tput_timer.start()

@@ -136,7 +136,7 @@ class TpuHybridEngine(TpuEngine):
 
     # -- public generate surface ----------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
-                 top_k: int = 0, rng: Optional[jax.Array] = None):
+                 top_k: int = 0, top_p: float = 1.0, rng: Optional[jax.Array] = None):
         """Decode with the CURRENT training weights (reference generate :168).
 
         LoRA deltas are fused for the decode programs and the training
@@ -157,7 +157,8 @@ class TpuHybridEngine(TpuEngine):
         cache = jax.device_put(tf.init_cache(cfg, B, cache_len), cache_sh)
         rng = rng if rng is not None else self._next_rng()
         result = decode_loop(
-            prefill_fn, decode_fn, params, tokens, cache, max_new_tokens, temperature, top_k, rng
+            prefill_fn, decode_fn, params, tokens, cache, max_new_tokens, temperature, top_k, rng,
+            top_p=top_p
         )
         self._generate_calls += 1
         return result

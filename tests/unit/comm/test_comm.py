@@ -75,6 +75,58 @@ def test_all_to_all_transposes_shards():
     np.testing.assert_allclose(np.asarray(y).reshape(n, n), np.asarray(x).T)
 
 
+def test_hybrid_dcn_mesh_shapes():
+    """Multi-slice mesh: per-axis size = dcn x ici, DCN outermost (the
+    scaling-book layout: data over DCN, fsdp/tensor intra-slice)."""
+    from deepspeed_tpu import comm
+
+    comm.destroy()
+    mesh = comm.init_distributed(
+        mesh_shape={"data": 1, "fsdp": 4}, dcn_mesh_shape={"data": 2}, verbose=False
+    )
+    assert mesh.shape["data"] == 2 and mesh.shape["fsdp"] == 4
+    # DCN-outer layout: the two data-axis groups are contiguous device blocks
+    devs = mesh.devices.reshape(2, 4)
+    ids = [[d.id for d in row] for row in devs]
+    assert ids[0] == sorted(ids[0]) and max(ids[0]) < min(ids[1])
+
+
+def test_hybrid_dcn_mesh_via_config_key():
+    from deepspeed_tpu import comm
+
+    comm.destroy()
+    mesh = comm.init_distributed(
+        mesh_shape={"data": 1, "fsdp": 2, "tensor": 2, "dcn": {"data": 2}}, verbose=False
+    )
+    assert dict(mesh.shape)["data"] == 2
+    assert mesh.devices.size == 8
+
+
+def test_hybrid_dcn_mesh_trains():
+    import deepspeed_tpu
+    import jax.numpy as jnp
+    from deepspeed_tpu import comm
+
+    comm.destroy()
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": 1, "fsdp": 4, "dcn": {"data": 2}},
+    }
+
+    def loss_fn(params, batch, rng):
+        return jnp.mean((batch["x"] @ params["w"]) ** 2)
+
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    engine, *_ = deepspeed_tpu.initialize(loss_fn=loss_fn, params=params, config=cfg)
+    batch = {"x": np.ones((8, 8), np.float32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+
+
 def test_broadcast_from_src():
     comm.destroy()
     mesh = comm.init_distributed(mesh_shape={"data": -1}, verbose=False)

@@ -290,6 +290,46 @@ class TestInferenceEngine:
         assert c.dtype == "float16"
 
 
+class TestSampling:
+    def test_top_p_restricts_support(self):
+        """Nucleus sampling must only ever emit tokens from the smallest
+        prefix of the sorted distribution with cumulative mass >= p."""
+        from deepspeed_tpu.inference.decoding import select_token
+
+        # one peaked distribution: token 0 has ~0.97 mass
+        logits = jnp.asarray([[8.0, 2.0, 1.0, 0.0, -1.0]])
+        draws = {
+            int(select_token(logits, 1.0, 0, jax.random.PRNGKey(i), top_p=0.5)[0])
+            for i in range(50)
+        }
+        assert draws == {0}  # only the top token is inside the 0.5 nucleus
+
+    def test_top_p_one_is_plain_sampling(self):
+        from deepspeed_tpu.inference.decoding import select_token
+
+        logits = jnp.asarray([[0.0, 0.0, 0.0, 0.0]])
+        draws = {
+            int(select_token(logits, 1.0, 0, jax.random.PRNGKey(i), top_p=1.0)[0])
+            for i in range(60)
+        }
+        assert len(draws) > 1  # uniform distribution stays unrestricted
+
+    def test_generate_with_top_p(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab_size=64, hidden_size=32, num_layers=1, num_heads=2, max_seq_len=32
+        )
+        engine = deepspeed_tpu.init_inference(cfg, config={"dtype": "float32"})
+        tokens = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 4)), jnp.int32)
+        out = engine.generate(
+            tokens, max_new_tokens=4, temperature=0.8, top_p=0.9,
+            rng=jax.random.PRNGKey(0),
+        )
+        assert out.shape == (1, 8)
+
+
 class TestTopLevelAPI:
     def test_package_init_inference(self):
         """deepspeed_tpu.init_inference must forward params/mesh and accept
