@@ -87,10 +87,31 @@ def _normalize_mesh_shape(mesh_shape: Optional[dict], n_devices: int) -> dict:
     return shape
 
 
+def split_dcn_shape(mesh_shape: Optional[dict], dcn_mesh_shape: Optional[dict], n_devices: int):
+    """Validate and resolve a (possibly hybrid) mesh request into
+    (ici_sizes, dcn_sizes, combined_sizes) full per-axis dicts. The single
+    source of the DCN granule math (build_mesh and TpuConfig both use it)."""
+    mesh_shape = dict(mesh_shape or {})
+    popped = mesh_shape.pop("dcn", None)
+    dcn_mesh_shape = dcn_mesh_shape or popped
+    dcn_mesh_shape = dict(dcn_mesh_shape or {})
+    unknown = set(dcn_mesh_shape) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"Unknown DCN mesh axes {unknown}; valid axes: {MESH_AXES}")
+    dcn = {ax: int(dcn_mesh_shape.get(ax, 1)) for ax in MESH_AXES}
+    n_dcn = int(np.prod(list(dcn.values())))
+    if n_devices % n_dcn != 0:
+        raise ValueError(f"{n_devices} devices not divisible by {n_dcn} DCN granules (dcn={dcn_mesh_shape})")
+    ici = _normalize_mesh_shape(mesh_shape, n_devices // n_dcn)
+    combined = {ax: ici[ax] * dcn[ax] for ax in MESH_AXES}
+    return ici, dcn, combined
+
+
 def build_mesh(mesh_shape: Optional[dict] = None, devices=None, dcn_mesh_shape: Optional[dict] = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     mesh_shape = dict(mesh_shape or {})
-    dcn_mesh_shape = dcn_mesh_shape or mesh_shape.pop("dcn", None)
+    popped = mesh_shape.pop("dcn", None)
+    dcn_mesh_shape = dcn_mesh_shape or popped
     if dcn_mesh_shape:
         return _build_hybrid_mesh(mesh_shape, dcn_mesh_shape, devices)
     shape = _normalize_mesh_shape(mesh_shape, len(devices))
@@ -104,14 +125,7 @@ def _build_hybrid_mesh(ici_shape: dict, dcn_shape: dict, devices) -> Mesh:
     dimension so collectives along an axis stay intra-slice whenever the ICI
     factor covers them (the reference's analogue is multi-node NCCL rings;
     the scaling-book recipe is 'data/pipe over DCN, everything else ICI')."""
-    unknown = set(dcn_shape) - set(MESH_AXES)
-    if unknown:
-        raise ValueError(f"Unknown DCN mesh axes {unknown}; valid axes: {MESH_AXES}")
-    dcn = {ax: int(dcn_shape.get(ax, 1)) for ax in MESH_AXES}
-    n_dcn = int(np.prod(list(dcn.values())))
-    if len(devices) % n_dcn != 0:
-        raise ValueError(f"{len(devices)} devices not divisible by {n_dcn} DCN granules")
-    ici = _normalize_mesh_shape(ici_shape, len(devices) // n_dcn)
+    ici, dcn, _ = split_dcn_shape(ici_shape, dcn_shape, len(devices))
     dims_ici = tuple(ici[ax] for ax in MESH_AXES)
     dims_dcn = tuple(dcn[ax] for ax in MESH_AXES)
     try:

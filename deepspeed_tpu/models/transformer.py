@@ -400,7 +400,9 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
         if mesh.shape.get("sequence", 1) > 1:
             if cfg.pos_embedding == "alibi":
                 raise NotImplementedError("ALiBi bias is not supported under sequence parallelism")
-            return sequence_parallel_attention(q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh)
+            return sequence_parallel_attention(
+                q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh, attn_impl=cfg.attn_impl
+            )
     if cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -88,7 +88,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-20))  # (bq, 1)
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct with varying-axis metadata when running inside a
+    vma-checked shard_map (sequence-parallel Ulysses local attention)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma=None):
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     group = H // Hkv
@@ -113,8 +121,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq, 1), jnp.float32),
+            _sds((B, H, Sq, hd), q.dtype, vma),
+            _sds((B, H, Sq, 1), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -216,7 +224,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
     q, k, v, o, lse = res
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -238,7 +246,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_sds(q.shape, q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
@@ -262,8 +270,8 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
-            jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype),
+            _sds((B, H, Sk, hd), k.dtype, vma),
+            _sds((B, H, Sk, hd), v.dtype, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, hd), jnp.float32),
@@ -287,19 +295,19 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
-    return _bwd(causal, sm_scale, block_q, block_k, interpret, res, do)
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
+    return _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -314,19 +322,23 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    vma=None,
 ):
     """Flash attention on (B, S, H, head_dim) tensors (GQA via fewer KV heads).
 
     Differentiable (custom VJP with flash backward); runs compiled on TPU and
-    interpreted on CPU backends.
+    interpreted on CPU backends. ``vma``: varying mesh axes to stamp on the
+    kernel outputs when called inside a vma-checked ``shard_map`` (e.g.
+    ``("sequence",)`` for the Ulysses local attention).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     interpret = _auto_interpret(interpret)
+    vma = tuple(vma) if vma else None
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    o = _flash_bhsd(qt, kt, vt, causal, sm_scale, block_q, block_k, interpret)
+    o = _flash_bhsd(qt, kt, vt, causal, sm_scale, block_q, block_k, interpret, vma)
     return jnp.transpose(o, (0, 2, 1, 3))
 
 

@@ -129,10 +129,14 @@ def sequence_parallel_attention(
     causal: bool = True,
     mesh=None,
     seq_axis: str = "sequence",
+    attn_impl: str = "xla",
 ):
     """Top-level SPMD entry: q/k/v are (B, S, H, hd) global arrays; the
     attention runs sequence-parallel over ``seq_axis`` via partial-manual
-    shard_map (other mesh axes remain under GSPMD)."""
+    shard_map (other mesh axes remain under GSPMD). ``attn_impl='pallas'``
+    runs the Ulysses local (full-sequence, head-subset) attention through
+    the flash kernel — the memory win that makes long-context Ulysses
+    practical (ring attention has its own online softmax already)."""
     if mesh is None:
         from deepspeed_tpu import comm
 
@@ -144,7 +148,12 @@ def sequence_parallel_attention(
     assert S % n == 0, f"seq len {S} must divide over {n} sequence shards"
     if impl == "ulysses":
         assert q.shape[2] % n == 0, f"num_heads {q.shape[2]} must divide over {n} for Ulysses"
-        local = partial(ulysses_attention, causal=causal, axis_name=seq_axis)
+        attn_fn = None
+        if attn_impl == "pallas":
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+            attn_fn = partial(flash_attention, causal=causal, vma=(seq_axis,))
+        local = partial(ulysses_attention, causal=causal, axis_name=seq_axis, attn_fn=attn_fn)
     elif impl == "ring":
         local = partial(ring_attention, causal=causal, axis_name=seq_axis)
     else:

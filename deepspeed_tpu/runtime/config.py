@@ -308,18 +308,13 @@ class TpuConfig:
         import numpy as np
 
         n = self._mesh_device_count or jax.device_count()
-        shape = self.mesh.to_dict()
-        dcn = shape.pop("dcn", None) or {}
-        from deepspeed_tpu.comm.comm import MESH_AXES, _normalize_mesh_shape
+        from deepspeed_tpu.comm.comm import split_dcn_shape
 
-        unknown = set(dcn) - set(MESH_AXES)
-        if unknown:
-            raise ConfigError(f"Unknown DCN mesh axes {unknown}; valid axes: {MESH_AXES}")
-        n_dcn = int(np.prod(list(dcn.values()))) if dcn else 1
-        if n % n_dcn != 0:
-            raise ConfigError(f"{n} devices not divisible by {n_dcn} DCN granules (mesh.dcn={dcn})")
-        ici = _normalize_mesh_shape(shape, n // n_dcn)
-        return {ax: ici[ax] * int(dcn.get(ax, 1)) for ax in ici}
+        try:
+            _, _, combined = split_dcn_shape(self.mesh.to_dict(), None, n)
+        except ValueError as e:
+            raise ConfigError(str(e)) from e
+        return combined
 
     # --- dtype resolution ----------------------------------------------
     def model_dtype(self):

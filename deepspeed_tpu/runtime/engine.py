@@ -391,10 +391,17 @@ class TpuEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
 
-        # --- checkpoint engine
-        from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+        # --- checkpoint engine (config checkpoint.async_save selects the
+        # non-blocking engine — the reference's Nebula async service seam)
+        from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+            AsyncOrbaxCheckpointEngine,
+            OrbaxCheckpointEngine,
+        )
 
-        self.checkpoint_engine = OrbaxCheckpointEngine()
+        if config.checkpoint.get("async_save", False):
+            self.checkpoint_engine = AsyncOrbaxCheckpointEngine()
+        else:
+            self.checkpoint_engine = OrbaxCheckpointEngine()
 
         # --- activation checkpointing (reference: engine.py:872
         # _configure_checkpointing); models read the policy via
@@ -1004,9 +1011,15 @@ class TpuEngine:
         }
         self.checkpoint_engine.save(os.path.join(save_dir, tag), self._state_tree(), meta)
         if save_latest and jax.process_index() == 0:
-            os.makedirs(save_dir, exist_ok=True)
-            with open(os.path.join(save_dir, "latest"), "w") as fh:
-                fh.write(tag)
+
+            def _write_latest():
+                # runs at commit time ('latest' must only ever name durable
+                # checkpoints; async saves defer this to their fence)
+                os.makedirs(save_dir, exist_ok=True)
+                with open(os.path.join(save_dir, "latest"), "w") as fh:
+                    fh.write(tag)
+
+            self.checkpoint_engine.on_commit(_write_latest)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 

@@ -36,6 +36,18 @@ class TestSequenceParallelAttention:
         out = jax.jit(lambda q, k, v: sequence_parallel_attention(q, k, v, impl=impl, mesh=seq_mesh))(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
 
+    def test_ulysses_flash_kernel_matches(self, seq_mesh):
+        """attn_impl='pallas' routes the Ulysses local attention through the
+        flash kernel (interpret mode on CPU) — results must match xla."""
+        q, k, v = _mk_qkv(S=128, hd=8)
+        ref = _full_causal_attention(q, k, v)
+        out = jax.jit(
+            lambda q, k, v: sequence_parallel_attention(
+                q, k, v, impl="ulysses", mesh=seq_mesh, attn_impl="pallas"
+            )
+        )(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_gqa(self, seq_mesh, impl):
         q, k, v = _mk_qkv(H=8, nkv=2)
