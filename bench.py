@@ -13,7 +13,9 @@ Covers the BASELINE.json tracked-config classes that fit one chip
                       (decode is bandwidth-bound: bytes-of-weights/token).
   4. hybrid-rlhf    — hybrid-engine rollout (generate) + train step on the
                       same weights, end-to-end tokens/s.
-  5. gpt2-train     — headline GPT-2 125M causal-LM training (PRIMARY —
+  5. bert-mlm       — BERT-large MLM pretrain samples/s + TFLOPS/chip (the
+                      reference's headline bench: 64 TFLOPS/V100 @ seq 128).
+  6. gpt2-train     — headline GPT-2 125M causal-LM training (PRIMARY —
                       printed LAST; the driver parses the final JSON line).
 
 Each config prints one JSON line; the primary line's extra.suite carries
@@ -89,14 +91,18 @@ def _sync(engine, loss):
     return float(loss) + float(jnp.sum(jax.tree.leaves(engine.params)[0]))
 
 
-def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1):
+def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None):
+    """Shared measurement protocol (warmup, host-transfer sync barrier,
+    timed loop) for every training bench; ``batch`` overrides the default
+    causal-LM batch (the MLM bench passes labels/loss_mask/token_types)."""
     assert warmup_steps >= 1, "at least one warmup step (compile) is required"
     import deepspeed_tpu
 
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     rs = np.random.RandomState(0)
     n_dev = jax.device_count()
-    batch = {"input_ids": rs.randint(0, model.cfg.vocab_size, (micro_bs * n_dev, seq)).astype(np.int32)}
+    if batch is None:
+        batch = {"input_ids": rs.randint(0, model.cfg.vocab_size, (micro_bs * n_dev, seq)).astype(np.int32)}
 
     def step():
         loss = engine.forward(batch)
@@ -299,6 +305,58 @@ def bench_hybrid_rlhf():
     }
 
 
+def bench_bert_mlm():
+    """BERT-large MLM pretrain throughput — the reference's headline bench
+    (docs/_posts/2020-05-28-fastest-bert-training.md: 64 TFLOPS/V100 @ seq
+    128, 52% of peak per 2020-05-19-bert-record.md). Same task shape: seq
+    128, 15% tokens masked, samples/s + achieved TFLOPS per chip."""
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    seq, micro_bs = (64, 4) if _SMOKE else (128, int(os.environ.get("DSTPU_BENCH_BERT_BS", 64)))
+    if _SMOKE:
+        model = _smoke_model(seq, causal=False, norm_position="post", type_vocab_size=2,
+                             embed_norm=True)
+    else:
+        model = TransformerModel.from_preset("bert-large", dtype="bfloat16", max_seq_len=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    rs = np.random.RandomState(0)
+    n_dev = jax.device_count()
+    B = micro_bs * n_dev
+    ids = rs.randint(0, model.cfg.vocab_size, (B, seq)).astype(np.int32)
+    mask = (rs.rand(B, seq) < 0.15).astype(np.float32)
+    masked = np.where(mask > 0, 103, ids).astype(np.int32)  # [MASK] id
+    batch = {"input_ids": masked, "labels": ids, "loss_mask": mask,
+             "token_type_ids": np.zeros((B, seq), np.int32)}
+
+    toks, dt, loss, _ = _train_bench(model, config, micro_bs, seq,
+                                     iters=2 if _SMOKE else 20, batch=batch)
+    samples = toks / seq  # per chip
+    flops_per_sample = model.cfg.flops_per_token(seq) * seq
+    mfu = samples * flops_per_sample / peak_flops()
+    return {
+        "metric": "bert_large_mlm_samples_per_sec_per_chip",
+        "value": round(samples, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "tflops_per_chip": round(samples * flops_per_sample / 1e12, 1),
+            "seq_len": seq,
+            "micro_bs": micro_bs,
+            "step_ms": round(dt * 1e3, 2),
+            "loss": float(loss),
+            "reference": "64 TFLOPS/V100 (52% peak) seq128",
+        },
+    }
+
+
 def bench_gpt2_train():
     from deepspeed_tpu.models.transformer import TransformerModel
 
@@ -353,6 +411,7 @@ def main():
             ("moe_ep", bench_moe_ep),
             ("decode", bench_decode),
             ("hybrid_rlhf", bench_hybrid_rlhf),
+            ("bert_mlm", bench_bert_mlm),
         ):
             try:
                 result = fn()

@@ -104,19 +104,24 @@ def _is_pipeline_model(model) -> bool:
     return isinstance(model, PipelineModule)
 
 
-def init_inference(model=None, config=None, params=None, mesh=None, **kwargs):
+def init_inference(model=None, config=None, params=None, mesh=None,
+                   draft_model=None, draft_params=None, seed: int = 0, **kwargs):
     """Create an inference engine (reference: deepspeed/__init__.py:251).
 
     ``kwargs`` are reference-style config fields (mp_size=, dtype=, ...)
-    merged into ``config``; ``params``/``mesh`` pass through to the engine.
+    merged into ``config``; ``params``/``mesh``/``seed`` pass through to the
+    engine (seed is an engine argument, NOT a config field — it controls
+    model.init when no params are given). ``draft_model`` attaches a
+    speculative-decoding draft engine.
     """
-    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.engine import init_inference as _init
 
     if kwargs:
         merged = dict(config or {})
         merged.update(kwargs)
         config = merged
-    return InferenceEngine(model, config=config, params=params, mesh=mesh)
+    return _init(model, config=config, params=params, mesh=mesh,
+                 draft_model=draft_model, draft_params=draft_params, seed=seed)
 
 
 def add_config_arguments(parser):

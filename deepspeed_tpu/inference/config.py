@@ -27,11 +27,22 @@ class MoEInferenceConfig:
 
 
 @dataclass
+class SpeculativeConfig:
+    """Draft-model speculative decoding (lossless: emitted tokens follow the
+    target model's sampling distribution; greedy mode matches plain greedy
+    decode token-for-token)."""
+
+    enabled: bool = False
+    num_draft_tokens: int = 4  # gamma: draft proposals verified per round
+
+
+@dataclass
 class InferenceConfig:
     dtype: str = "bfloat16"  # float32 | float16 | bfloat16 | int8 (weight quant)
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     moe: MoEInferenceConfig = field(default_factory=MoEInferenceConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
+    speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
     max_tokens: int = 1024  # alias accepted from reference configs
@@ -67,12 +78,17 @@ class InferenceConfig:
         if not isinstance(dtype, str):
             dtype = {"torch.float32": "float32", "torch.float16": "float16",
                      "torch.bfloat16": "bfloat16", "torch.int8": "int8"}.get(str(dtype), "bfloat16")
+        spec = config.get("speculative", {})
+        if isinstance(spec, bool):
+            spec = {"enabled": spec}
         known = {f for f in cls.__dataclass_fields__}
-        base = {k: v for k, v in config.items() if k in known and k not in ("tensor_parallel", "moe", "quant", "dtype")}
+        base = {k: v for k, v in config.items()
+                if k in known and k not in ("tensor_parallel", "moe", "quant", "speculative", "dtype")}
         return cls(
             dtype=dtype,
             tensor_parallel=from_dict(TensorParallelConfig, tp if isinstance(tp, dict) else {}),
             moe=from_dict(MoEInferenceConfig, moe),
             quant=from_dict(QuantConfig, quant),
+            speculative=from_dict(SpeculativeConfig, spec),
             **base,
         )

@@ -13,9 +13,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 
-def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
-    """Build (prefill_fn, decode_fn, cache_sharding, batch_sharding) for a
-    TransformerConfig ``cfg`` with params placed per ``param_shardings``."""
+def _decode_shardings(mesh, cfg, batch_size: int):
+    """(batch_sharding, cache_sharding) — the ONE sharding-selection policy
+    for every cached-decode program (plain and speculative paths must place
+    batch/KV identically or each call pays a reshard)."""
     from deepspeed_tpu.models import transformer as tf
 
     dp = mesh.shape["data"] * mesh.shape["fsdp"]
@@ -26,6 +27,15 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
         lambda _: NamedSharding(mesh, PartitionSpec(None, batch_axes, None, kv_tensor, None)),
         tf.init_cache(cfg, 1, 8),
     )
+    return batch_sh, cache_sh
+
+
+def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
+    """Build (prefill_fn, decode_fn, cache_sharding, batch_sharding) for a
+    TransformerConfig ``cfg`` with params placed per ``param_shardings``."""
+    from deepspeed_tpu.models import transformer as tf
+
+    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
 
     def prefill(params, tokens, cache):
         return tf.forward_with_cache(params, cfg, tokens, cache, 0)
@@ -49,12 +59,12 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
     return prefill_fn, decode_fn, cache_sh, batch_sh
 
 
-def select_token(logits, temperature: float, top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
-    """Greedy / temperature / top-k / nucleus (top-p) sampling, one token
-    per row."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
+def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
+    """Temperature / top-k / nucleus filtering over (B, V) logits. The ONE
+    implementation shared by plain sampling (select_token) and the
+    speculative p/q distributions — speculative losslessness requires both
+    paths to filter identically."""
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
@@ -70,7 +80,17 @@ def select_token(logits, temperature: float, top_k: int, rng, top_p: float = 1.0
         keep = cum - probs < max(top_p, 1e-9)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def select_token(logits, temperature: float, top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
+    """Greedy / temperature / top-k / nucleus (top-p) sampling, one token
+    per row."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, _filter_logits(logits, temperature, top_k, top_p), axis=-1
+    ).astype(jnp.int32)
 
 
 def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: int,
@@ -89,6 +109,172 @@ def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: in
         out.append(select_token(step_logits, temperature, top_k, sub, top_p))
         pos += 1
     return jnp.concatenate([tokens, jnp.stack(out, axis=1)], axis=1)
+
+
+def compile_segment_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
+    """Jit a cached segment forward with PER-ROW positions (``pos``: (B,)
+    int32); any segment width retraces under the same jit wrapper. Used by
+    speculative decoding, where rows advance by their own accepted counts.
+    Returns (segment_fn, cache_sh, batch_sh)."""
+    from deepspeed_tpu.models import transformer as tf
+
+    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+
+    def segment(params, toks, cache, pos):
+        return tf.forward_with_cache(params, cfg, toks, cache, pos)
+
+    segment_fn = jax.jit(
+        segment,
+        in_shardings=(param_shardings, batch_sh, cache_sh, batch_sh),
+        out_shardings=(batch_sh, cache_sh),
+        donate_argnums=(2,),
+    )
+    return segment_fn, cache_sh, batch_sh
+
+
+def _filtered_probs(logits, temperature: float, top_k: int, top_p: float):
+    """Normalized sampling distribution after the same temperature/top-k/
+    top-p filtering select_token applies (shared _filter_logits) — the q/p
+    distributions of the speculative acceptance test must match what plain
+    sampling would use."""
+    return jax.nn.softmax(_filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def _sample_rows(probs, host_rng):
+    """One categorical draw per row of a (B, V) numpy prob matrix."""
+    import numpy as np
+
+    B = probs.shape[0]
+    out = np.zeros((B,), np.int32)
+    u = host_rng.random(B)
+    cum = np.cumsum(probs, axis=-1)
+    cum /= cum[:, -1:]
+    for b in range(B):
+        out[b] = int(np.searchsorted(cum[b], u[b], side="right"))
+    return np.minimum(out, probs.shape[1] - 1)
+
+
+def speculative_decode_loop(
+    t_prefill, t_segment, d_prefill, d_decode,
+    params_t, params_d, tokens, cache_t, cache_d,
+    max_new_tokens: int, gamma: int, temperature: float, top_k: int,
+    top_p: float, rng, eos_token_id: Optional[int] = None,
+) -> jnp.ndarray:
+    """Draft-model speculative decoding (lossless).
+
+    Each round: the draft proposes ``gamma`` tokens autoregressively, the
+    target verifies all of them in ONE (gamma+1)-wide segment forward, and
+    the standard accept/resample rule keeps the output distribution exactly
+    the target's (greedy mode: token-for-token identical to plain greedy
+    decode). Rows advance by their own accepted counts — the per-row
+    position generalization in models/transformer.forward_with_cache.
+
+    The reference has no counterpart (v0.9.1 predates spec-decode serving);
+    this is a capability the TPU design gets nearly for free from static
+    segment shapes. t_segment/d_decode take (B,) position vectors.
+    """
+    import numpy as np
+
+    if max_new_tokens <= 0:
+        return tokens
+    B, S = tokens.shape
+    greedy = temperature <= 0.0
+    host_rng = np.random.default_rng(int(jax.random.randint(rng, (), 0, 2**31 - 1)))
+
+    logits_t, cache_t = t_prefill(params_t, tokens, cache_t)
+    _, cache_d = d_prefill(params_d, tokens, cache_d)
+    last_logits = logits_t[:, -1]
+    if greedy:
+        t0 = np.asarray(jnp.argmax(last_logits, axis=-1), np.int32)
+    else:
+        t0 = _sample_rows(np.asarray(_filtered_probs(last_logits, temperature, top_k, top_p)), host_rng)
+    out = [[int(t0[b])] for b in range(B)]
+    last = t0.astype(np.int32)
+    pos = np.full((B,), S, np.int32)
+
+    # rows past their quota (or past eos, when the caller will truncate at
+    # eos anyway) freeze: they stop appending/advancing and stop gating the
+    # loop, though they still ride along in the static-shape batch
+    def _is_done(o):
+        return len(o) >= max_new_tokens or (eos_token_id is not None and o[-1] == eos_token_id)
+
+    done = np.array([_is_done(o) for o in out])
+
+    while not done.all():
+        # --- draft gamma proposals; one extra step caches d_gamma's kv so
+        # the draft context stays complete when every proposal is accepted
+        drafts = np.zeros((B, gamma), np.int32)
+        qdists = []
+        cur = last
+        for i in range(gamma + 1):
+            logits_d, cache_d = d_decode(
+                params_d, jnp.asarray(cur[:, None]), cache_d, jnp.asarray(pos + i)
+            )
+            if i == gamma:
+                break
+            if greedy:
+                d = np.asarray(jnp.argmax(logits_d[:, 0], axis=-1), np.int32)
+            else:
+                q = np.asarray(_filtered_probs(logits_d[:, 0], temperature, top_k, top_p))
+                qdists.append(q)
+                d = _sample_rows(q, host_rng)
+            drafts[:, i] = d
+            cur = d.astype(np.int32)
+
+        # --- verify all gamma proposals in one target forward
+        seg = np.concatenate([last[:, None], drafts], axis=1)  # (B, gamma+1)
+        logits_v, cache_t = t_segment(params_t, jnp.asarray(seg), cache_t, jnp.asarray(pos))
+        if greedy:
+            tgt = np.asarray(jnp.argmax(logits_v, axis=-1), np.int32)  # (B, gamma+1)
+        else:
+            V = logits_v.shape[-1]
+            pdists = np.asarray(
+                _filtered_probs(logits_v.reshape(B * (gamma + 1), V), temperature, top_k, top_p)
+            ).reshape(B, gamma + 1, V)
+
+        # --- accept / correct per row (frozen rows skip entirely)
+        for b in range(B):
+            if done[b]:
+                continue
+            n_acc = 0
+            for i in range(gamma):
+                d = int(drafts[b, i])
+                if greedy:
+                    ok = d == int(tgt[b, i])
+                else:
+                    p_d = float(pdists[b, i, d])
+                    q_d = float(qdists[i][b, d])
+                    ok = host_rng.random() < min(1.0, p_d / max(q_d, 1e-20))
+                if not ok:
+                    break
+                out[b].append(d)
+                n_acc += 1
+                if _is_done(out[b]):
+                    break
+            if not _is_done(out[b]):
+                if greedy:
+                    nxt = int(tgt[b, n_acc])
+                elif n_acc == gamma:
+                    nxt = int(_sample_rows(pdists[b, gamma][None], host_rng)[0])
+                else:
+                    residual = np.maximum(pdists[b, n_acc] - qdists[n_acc][b], 0.0)
+                    tot = residual.sum()
+                    dist = residual / tot if tot > 0 else pdists[b, n_acc]
+                    nxt = int(_sample_rows(dist[None], host_rng)[0])
+                out[b].append(nxt)
+                last[b] = nxt
+            pos[b] += n_acc + 1
+            done[b] = _is_done(out[b])
+
+    # rows that stopped at eos may be short of the quota: pad with eos
+    # (the caller's eos truncation overwrites everything past the first
+    # eos with eos anyway, so plain-decode parity is preserved)
+    gen = np.stack([
+        np.asarray((o + [eos_token_id] * max_new_tokens)[:max_new_tokens]
+                   if len(o) < max_new_tokens else o[:max_new_tokens], np.int32)
+        for o in out
+    ])
+    return jnp.concatenate([tokens, jnp.asarray(gen)], axis=1)
 
 
 def bounded_cache_len(total: int, max_seq_len: int, max_out_tokens: Optional[int]) -> int:

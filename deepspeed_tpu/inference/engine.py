@@ -188,8 +188,16 @@ class InferenceEngine:
         top_p: float = 1.0,
         rng: Optional[jax.Array] = None,
         eos_token_id: Optional[int] = None,
+        draft: Optional["InferenceEngine"] = None,
+        num_draft_tokens: Optional[int] = None,
     ):
-        """Greedy / temperature sampling with a compiled decode loop."""
+        """Greedy / temperature sampling with a compiled decode loop.
+
+        Passing ``draft`` (a second, smaller InferenceEngine on the same
+        tokenizer/vocab) switches to lossless speculative decoding: the
+        draft proposes ``num_draft_tokens`` tokens per round and this
+        engine verifies them in one segment forward (config block
+        ``speculative.num_draft_tokens`` sets the default)."""
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = tokens.shape
         total = S + max_new_tokens
@@ -200,9 +208,28 @@ class InferenceEngine:
         # inference/config.py max_out_tokens), grown only if the request needs it
         from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
 
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if draft is None and self.config.speculative.enabled:
+            draft = getattr(self, "_draft_engine", None)
+            if draft is None:
+                raise ValueError(
+                    "speculative.enabled but no draft model: pass draft= to "
+                    "generate() or draft_model= to init_inference()"
+                )
+        if draft is not None:
+            gamma = (num_draft_tokens if num_draft_tokens is not None
+                     else self.config.speculative.num_draft_tokens)
+            assert gamma >= 1, f"num_draft_tokens must be >= 1, got {gamma}"
+            result = self._generate_speculative(
+                draft, tokens, max_new_tokens, temperature, top_k, top_p, rng,
+                gamma, eos_token_id,
+            )
+            if eos_token_id is not None:
+                result = self._truncate_eos(result, S, eos_token_id)
+            return result
+
         max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
         self._ensure_compiled(B, max_len)
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), self._cache_sharding)
         t0 = time.time()
@@ -215,6 +242,54 @@ class InferenceEngine:
             self._model_times.append(time.time() - t0)
         if eos_token_id is not None:
             result = self._truncate_eos(result, S, eos_token_id)
+        return result
+
+    def _spec_fns(self, batch_size: int, max_len: int):
+        """(prefill_fn, segment_fn, cache_sharding) for speculative decoding.
+        Keyed by (B, cache_len) only — segment width retraces under the same
+        jit wrapper, so target (gamma+1-wide) and draft (1-wide) roles share
+        one compiled-fn cache even when one engine plays both (self-draft)."""
+        from deepspeed_tpu.inference.decoding import compile_decode_fns, compile_segment_fn
+
+        key = (batch_size, max_len)
+        if getattr(self, "_spec_cache_key", None) != key:
+            prefill_fn, _, cache_sh, _ = compile_decode_fns(
+                self.mesh, self.cfg, self.param_shardings, batch_size, max_len
+            )
+            segment_fn, _, _ = compile_segment_fn(
+                self.mesh, self.cfg, self.param_shardings, batch_size, max_len
+            )
+            self._spec_fns_cached = (prefill_fn, segment_fn, cache_sh)
+            self._spec_cache_key = key
+        return self._spec_fns_cached
+
+    def _generate_speculative(self, draft, tokens, max_new_tokens, temperature,
+                              top_k, top_p, rng, gamma: int,
+                              eos_token_id: Optional[int] = None):
+        from deepspeed_tpu.inference.decoding import bounded_cache_len, speculative_decode_loop
+
+        assert draft.cfg.vocab_size == self.cfg.vocab_size, (
+            "draft and target must share a vocabulary"
+        )
+        B, S = tokens.shape
+        # slack for the up-to-gamma overrun of the final verify round
+        total = S + max_new_tokens + gamma + 1
+        max_len = bounded_cache_len(total, max(self.cfg.max_seq_len, total),
+                                    self.config.max_out_tokens)
+        t_prefill, t_segment, t_cache_sh = self._spec_fns(B, max_len)
+        d_prefill, d_decode, d_cache_sh = draft._spec_fns(B, max_len)
+        cache_t = jax.device_put(tf.init_cache(self.cfg, B, max_len), t_cache_sh)
+        cache_d = jax.device_put(tf.init_cache(draft.cfg, B, max_len), d_cache_sh)
+        t0 = time.time()
+        result = speculative_decode_loop(
+            t_prefill, t_segment, d_prefill, d_decode,
+            self.params, draft.params, tokens, cache_t, cache_d,
+            max_new_tokens, gamma, temperature, top_k, top_p, rng,
+            eos_token_id=eos_token_id,
+        )
+        if self.config.profile_model_time:
+            jax.block_until_ready(result)
+            self._model_times.append(time.time() - t0)
         return result
 
     @staticmethod
@@ -233,8 +308,19 @@ class InferenceEngine:
         return jnp.asarray(arr)
 
 
-def init_inference(model, config=None, params=None, mesh=None, **kwargs) -> InferenceEngine:
-    """Reference: deepspeed.init_inference (deepspeed/__init__.py:251)."""
+def init_inference(model, config=None, params=None, mesh=None, draft_model=None,
+                   draft_params=None, seed: int = 0, **kwargs) -> InferenceEngine:
+    """Reference: deepspeed.init_inference (deepspeed/__init__.py:251).
+
+    ``draft_model`` (plus ``config.speculative.enabled``) attaches a smaller
+    same-vocabulary model whose engine drives speculative decoding on every
+    generate() call."""
     if kwargs and config is None:
         config = kwargs
-    return InferenceEngine(model, config=config, params=params, mesh=mesh)
+    engine = InferenceEngine(model, config=config, params=params, mesh=mesh, seed=seed)
+    if draft_model is not None:
+        engine._draft_engine = InferenceEngine(
+            draft_model, config={"dtype": engine.config.dtype},
+            params=draft_params, mesh=mesh, seed=seed,
+        )
+    return engine

@@ -163,6 +163,22 @@ PRESETS = {
         ffn_hidden_size=24576, max_seq_len=2048, pos_embedding="rope", rope_dim=24,
         parallel_residual=True, tie_embeddings=False,
     ),
+    # Reference headline-bench family (docs/_posts/2020-05-28-fastest-bert-training.md:
+    # BERT-large pretrain, 64 TFLOPS/V100 @ seq 128). Bidirectional post-LN
+    # encoder: tok+pos+type embeddings -> LayerNorm, no final norm (post-LN
+    # already normalizes the last residual), MLM via labels+loss_mask in
+    # loss_fn. Deviation from HF BERT: the MLM head ties directly to the
+    # token embedding (no extra transform dense); pooler/NSP head omitted.
+    "bert-large": dict(
+        vocab_size=30522, hidden_size=1024, num_layers=24, num_heads=16,
+        max_seq_len=512, pos_embedding="learned", type_vocab_size=2,
+        embed_norm=True, norm_position="post", causal=False,
+    ),
+    "bert-base": dict(
+        vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=512, pos_embedding="learned", type_vocab_size=2,
+        embed_norm=True, norm_position="post", causal=False,
+    ),
 }
 
 
@@ -760,8 +776,11 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] =
 def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos):
     """One decoder layer over a segment of S new tokens with KV cache.
 
-    x: (B, S, D); k_cache/v_cache: (B, T, nkv, hd) for THIS layer; pos: scalar
-    count of tokens already cached. Returns (x, new_k_cache, new_v_cache).
+    x: (B, S, D); k_cache/v_cache: (B, T, nkv, hd) for THIS layer; pos: the
+    count of tokens already cached — a scalar (all rows aligned: plain
+    prefill/decode) or an (B,) vector (rows at different depths: the
+    speculative-decode verify/draft path writes each row's segment at its
+    own offset). Returns (x, new_k_cache, new_v_cache).
     """
     attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
     ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
@@ -773,8 +792,17 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
     q, k, v = _qkv(h, attn_p, cfg, positions)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    if jnp.ndim(pos) == 0:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    else:
+        # per-row offsets: scatter each row's S new entries at its own pos
+        # (out-of-bounds writes past T are dropped, matching the clamped
+        # read mask below)
+        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+        cols = positions  # (B, S) absolute positions of the new tokens
+        k_cache = k_cache.at[rows, cols].set(k.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[rows, cols].set(v.astype(v_cache.dtype), mode="drop")
 
     kk, vv = k_cache, v_cache
     if nkv != nh:
@@ -783,12 +811,19 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     scale = 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale  # (B,nh,S,T)
     kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
-    qpos = positions[0][:, None]  # (S, 1): absolute positions of new tokens
-    if cfg.pos_embedding == "alibi":
-        rel = kpos.astype(jnp.float32) - qpos.astype(jnp.float32)  # (S, T)
-        logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
-    mask = kpos <= qpos  # attend to everything written up to and incl. self
-    logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+    if jnp.ndim(pos) == 0:
+        qpos = positions[0][:, None]  # (S, 1): absolute positions of new tokens
+        if cfg.pos_embedding == "alibi":
+            rel = kpos.astype(jnp.float32) - qpos.astype(jnp.float32)  # (S, T)
+            logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
+        mask = (kpos <= qpos)[None, None]  # attend up to and incl. self
+    else:
+        qpos = positions[:, :, None]  # (B, S, 1) per-row positions
+        if cfg.pos_embedding == "alibi":
+            rel = kpos[None].astype(jnp.float32) - qpos.astype(jnp.float32)  # (B, S, T)
+            logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[:, None]
+        mask = (kpos[None] <= qpos)[:, None]  # (B, 1, S, T)
+    logits = jnp.where(mask, logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     attn_out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(B, S, nh * hd)
     attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
@@ -813,14 +848,21 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
 
 def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
     """Segment forward with KV cache (prefill: S = prompt len, pos = 0;
-    decode: S = 1). Returns (logits (B,S,V), updated cache)."""
+    decode: S = 1). ``pos`` may be a scalar (all rows aligned) or an (B,)
+    vector of per-row depths (speculative decoding — rows advance by their
+    own accepted counts). Returns (logits (B,S,V), updated cache)."""
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
-    positions = pos + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    if jnp.ndim(pos) == 1:
+        positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # (B, S)
+    else:
+        positions = pos + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     if cfg.pos_embedding == "learned":
         pos_table = params["embed"]["pos"].astype(dtype)
-        x = x + jnp.take(pos_table, jnp.minimum(positions[0], pos_table.shape[0] - 1), axis=0)
+        clamped = jnp.minimum(positions, pos_table.shape[0] - 1)
+        x = x + (jnp.take(pos_table, clamped, axis=0) if jnp.ndim(pos) == 1
+                 else jnp.take(pos_table, clamped[0], axis=0))
     if cfg.type_vocab_size > 0:
         # decode has no token-type stream; type 0 matches forward()'s default
         x = x + params["embed"]["type"][0].astype(dtype)

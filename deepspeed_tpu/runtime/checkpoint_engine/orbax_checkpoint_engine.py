@@ -12,7 +12,6 @@ for free: the on-disk format is logical-array-shaped, not rank-shaped.
 import atexit
 import json
 import os
-import weakref
 
 import jax
 
@@ -78,14 +77,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         callback()
 
 
-# async engines are drained at interpreter exit via a weak set: instances
-# stay collectable, and a pending metadata write into an already-deleted
-# directory (test tmp dirs) can't break teardown
-_LIVE_ASYNC_ENGINES = weakref.WeakSet()
+# Engines with a pending (unfenced) save are pinned by a STRONG reference
+# until the fence runs: if they were only weakly held, a gc before any
+# wait()/atexit drain would drop the pending ds_metadata.json write and
+# leave a fully-durable checkpoint permanently flagged as uncommitted.
+# Idle engines are not pinned and stay collectable.
+_PENDING_ASYNC_ENGINES = set()
 
 
 def _drain_async_engines():
-    for engine in list(_LIVE_ASYNC_ENGINES):
+    for engine in list(_PENDING_ASYNC_ENGINES):
         try:
             engine.wait()
         except Exception:
@@ -107,7 +108,6 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
         self._async = self._ocp.AsyncCheckpointer(self._ocp.PyTreeCheckpointHandler())
         self._pending_meta = None
         self._pending_commits = []
-        _LIVE_ASYNC_ENGINES.add(self)
 
     def save(self, path: str, state_tree, metadata: dict) -> None:
         ocp = self._ocp
@@ -121,18 +121,31 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
         # implies the arrays are durable, matching the sync engine's
         # "metadata last" ordering.
         self._pending_meta = (path, dict(metadata))
+        _PENDING_ASYNC_ENGINES.add(self)
 
     def on_commit(self, callback) -> None:
         self._pending_commits.append(callback)
+        _PENDING_ASYNC_ENGINES.add(self)
 
     def wait(self) -> None:
-        self._async.wait_until_finished()
-        if self._pending_meta is not None:
-            path, metadata = self._pending_meta
-            self._pending_meta = None
-            if jax.process_index() == 0:
-                with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
-                    json.dump(metadata, fh, default=str)
-        commits, self._pending_commits = self._pending_commits, []
-        for cb in commits:
-            cb()
+        # exception safety: _pending_meta is only cleared AFTER a successful
+        # metadata write (a failed fence can be retried without losing the
+        # commit marker), and the strong-ref unpin runs regardless — a
+        # raising fence must not leave the engine pinned forever
+        try:
+            self._async.wait_until_finished()
+            if self._pending_meta is not None:
+                path, metadata = self._pending_meta
+                # the directory can legitimately be gone (test tmp dirs
+                # removed between save and teardown drain) — skip the write
+                # but don't break the fence
+                if jax.process_index() == 0 and os.path.isdir(path):
+                    with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
+                        json.dump(metadata, fh, default=str)
+                self._pending_meta = None
+            for cb in list(self._pending_commits):
+                cb()
+                self._pending_commits.remove(cb)
+        finally:
+            if self._pending_meta is None and not self._pending_commits:
+                _PENDING_ASYNC_ENGINES.discard(self)

@@ -34,6 +34,16 @@ def _reset_comm_state():
         pass
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free compiled executables between modules. A full-suite run holds
+    hundreds of XLA:CPU executables in one process; the LLVM JIT has been
+    observed to segfault during late-suite compiles under that accumulation
+    (tests pass in isolation). Module scope keeps intra-module caching."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def mesh8():
     """Default 8-device mesh, all devices on the fsdp axis."""

@@ -87,6 +87,50 @@ def test_architecture_variants_train(variant, overrides):
     assert l1 < l0, f"{variant}: loss did not drop ({l0} -> {l1})"
 
 
+def test_bert_mlm_loss_path():
+    """MLM objective (bench_bert_mlm / reference BERT headline bench): loss
+    is computed over full-length logits at masked positions only — an
+    unmasked label must not affect it — and the bert presets are valid."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        TINY, norm_position="post", causal=False, type_vocab_size=2, embed_norm=True
+    )
+    model = TransformerModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    B, S = 4, 16
+    ids = rs.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    mask = (rs.rand(B, S) < 0.2).astype(np.float32)
+    mask[0, 0] = 1.0  # ensure non-empty
+    masked = np.where(mask > 0, 103, ids).astype(np.int32)
+    batch = {"input_ids": masked, "labels": ids, "loss_mask": mask}
+    loss = float(model.loss(params, batch))
+    assert np.isfinite(loss) and loss > 0
+
+    # corrupting a label at an UNmasked position leaves the loss unchanged
+    ids2 = ids.copy()
+    unmasked = np.argwhere(mask == 0)
+    r, c = unmasked[0]
+    ids2[r, c] = (ids2[r, c] + 1) % cfg.vocab_size
+    loss2 = float(model.loss(params, {**batch, "labels": ids2}))
+    assert loss2 == pytest.approx(loss, rel=1e-6)
+
+    # corrupting a label at a masked position changes it
+    ids3 = ids.copy()
+    r, c = np.argwhere(mask > 0)[0]
+    ids3[r, c] = (ids3[r, c] + 7) % cfg.vocab_size
+    loss3 = float(model.loss(params, {**batch, "labels": ids3}))
+    assert loss3 != pytest.approx(loss, rel=1e-6)
+
+    # presets construct and count params (bert-large ~ 335M incl. MLM-tied head)
+    large = get_config("bert-large")
+    assert not large.causal and large.norm_position == "post"
+    assert 3.2e8 < large.num_params() < 3.5e8
+    base = get_config("bert-base")
+    assert 1.0e8 < base.num_params() < 1.2e8
+
+
 def test_scan_matches_unrolled():
     cfg_scan = TINY
     cfg_loop = TransformerConfig(**{**cfg_scan.__dict__, "scan_layers": False})
