@@ -200,6 +200,20 @@ def bench_moe_ep():
     }
 
 
+def _decode_window(engine, tokens, new_tokens):
+    """Steady-state decode seconds: total generate minus (prefill + one
+    decode step), both paths pre-compiled."""
+    out = engine.generate(tokens, max_new_tokens=new_tokens)  # compile + warmup
+    _ = np.asarray(out)
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))  # compile 1-token path
+    t0 = time.time()
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))
+    t_prefill = time.time() - t0
+    t0 = time.time()
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=new_tokens))
+    return max(time.time() - t0 - t_prefill, 1e-9)
+
+
 def bench_decode():
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer import TransformerModel
@@ -212,23 +226,26 @@ def bench_decode():
     engine = deepspeed_tpu.init_inference(model, config={"dtype": "bfloat16"})
     rs = np.random.RandomState(0)
     tokens = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (B, prompt_len)), jnp.int32)
-    out = engine.generate(tokens, max_new_tokens=new_tokens)  # compile + warmup
-    _ = np.asarray(out)
-    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))  # compile 1-token path
-    # decode-only window: subtract the (prefill + 1 decode step) time so the
-    # reported number is steady-state decode, not prefill-diluted
-    t0 = time.time()
-    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))
-    t_prefill = time.time() - t0
-    t0 = time.time()
-    out = engine.generate(tokens, max_new_tokens=new_tokens)
-    _ = np.asarray(out)
-    dt = max(time.time() - t0 - t_prefill, 1e-9)
+    dt = _decode_window(engine, tokens, new_tokens)
     decoded = new_tokens - 1
     tok_s = B * decoded / dt
     # bandwidth roofline: every decoded token reads all weights once
     weight_bytes = model.cfg.num_params() * 2  # bf16
     achieved_bw = (tok_s / B) * weight_bytes  # per-sequence steps are the bound
+
+    # A/B: REAL-int8 weight storage (W8A8 MXU path) — decode is bandwidth-
+    # bound, so int8 weights should push tokens/s toward 2x
+    extra_int8 = {}
+    try:
+        eng8 = deepspeed_tpu.init_inference(model, config={"dtype": "int8"})
+        dt8 = _decode_window(eng8, tokens, new_tokens)
+        extra_int8 = {
+            "int8_tokens_per_sec": round(B * decoded / dt8, 1),
+            "int8_speedup": round(dt / dt8, 3),
+        }
+    except Exception as e:
+        extra_int8 = {"int8_error": f"{type(e).__name__}: {e}"[:200]}
+
     return {
         "metric": "gpt2_350m_decode_tokens_per_sec",
         "value": round(tok_s, 1),
@@ -240,6 +257,7 @@ def bench_decode():
             "new_tokens": new_tokens,
             "ms_per_step": round(dt / max(new_tokens - 1, 1) * 1e3, 2),
             "roofline_gbps": round(achieved_bw / 1e9, 1),
+            **extra_int8,
         },
     }
 

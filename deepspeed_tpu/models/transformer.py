@@ -450,13 +450,13 @@ def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False)
 
         def expert_fn(ep, t):
             if cfg.activation == "silu_glu":
-                a = jax.nn.silu(t @ ep["wg"]) * (t @ ep["wi"])
+                a = jax.nn.silu(_linear(t, ep["wg"])) * _linear(t, ep["wi"])
             else:
-                a = t @ ep["wi"]
+                a = _linear(t, ep["wi"])
                 if cfg.use_bias:
                     a = a + ep["bi"]
                 a = _dense_act(cfg)(a)
-            out = a @ ep["wo"]
+            out = _linear(a, ep["wo"])
             if cfg.use_bias:
                 out = out + ep["bo"]
             return out
@@ -477,27 +477,53 @@ def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False)
         return mlp_out, aux
     aux = jnp.float32(0.0)
     if cfg.activation == "silu_glu":
-        up = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
-        gate = jnp.einsum("bsd,df->bsf", h, mlp_p["wg"])
+        up = _linear(h, mlp_p["wi"])
+        gate = _linear(h, mlp_p["wg"])
         act = jax.nn.silu(gate) * up
     else:
-        act = jnp.einsum("bsd,df->bsf", h, mlp_p["wi"])
+        act = _linear(h, mlp_p["wi"])
         if cfg.use_bias:
             act = act + mlp_p["bi"]
         act = _dense_act(cfg)(act)
-    mlp_out = jnp.einsum("bsf,fd->bsd", act, mlp_p["wo"])
+    mlp_out = _linear(act, mlp_p["wo"])
     if cfg.use_bias:
         mlp_out = mlp_out + mlp_p["bo"]
     return mlp_out, aux
+
+
+def _cast_layers(tree, dtype):
+    """fp32->model-dtype cast for layer params that leaves int8-quantized
+    weights' fp32 per-channel scales ("s" siblings of "q8") untouched —
+    downcasting scales to bf16 would add dequant error comparable to the
+    int8 quantization error itself."""
+    def cast(path, p):
+        if getattr(path[-1], "key", None) == "s":
+            return p
+        return p.astype(dtype) if p.dtype == jnp.float32 else p
+
+    return jax.tree_util.tree_map_with_path(cast, tree)
+
+
+def _linear(x, w):
+    """Last-dim contraction ``x @ w`` that also accepts a REAL-int8 weight
+    ({"q8": int8 (K,N), "s": per-channel scales} — built by the inference
+    engine's weight quantizer). Raw arrays take the plain matmul path, so
+    training is untouched; quantized leaves run the W8A8 int8-MXU kernel
+    (ops/quantizer.int8_linear)."""
+    if isinstance(w, dict):
+        from deepspeed_tpu.ops.quantizer import int8_linear
+
+        return int8_linear(x, w["q8"], w["s"])
+    return x @ w
 
 
 def _qkv(h, attn_p, cfg: TransformerConfig, positions):
     """Project h -> (q, k, v) heads with positional transform applied."""
     B, S, _ = h.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-    q = jnp.einsum("bsd,dk->bsk", h, attn_p["wq"])
-    k = jnp.einsum("bsd,dk->bsk", h, attn_p["wk"])
-    v = jnp.einsum("bsd,dk->bsk", h, attn_p["wv"])
+    q = _linear(h, attn_p["wq"])
+    k = _linear(h, attn_p["wk"])
+    v = _linear(h, attn_p["wv"])
     if cfg.use_bias:
         q, k, v = q + attn_p["bq"], k + attn_p["bk"], v + attn_p["bv"]
     q = q.reshape(B, S, nh, hd)
@@ -532,7 +558,7 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     h = maybe_quant(h)
     q, k, v = _qkv(h, attn_p, cfg, positions)
     attn_out = _attention(q, k, v, cfg, positions).reshape(B, S, nh * hd)
-    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
+    attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
     if cfg.dropout > 0.0 and dropout_rng is not None:
@@ -621,7 +647,7 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy), static_argnums=())
 
-    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+    layers = _cast_layers(params["layers"], dtype)
     needs_rng = (
         cfg.dropout > 0.0 or cfg.moe_use_rts or ltd_on or pld_on
     ) and dropout_rng is not None
@@ -656,7 +682,8 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+        w = params["lm_head"]["w"]
+        logits = _linear(x, w if isinstance(w, dict) else w.astype(dtype))
         if "b" in params.get("lm_head", {}):
             logits = logits + params["lm_head"]["b"].astype(dtype)
     return logits, aux_total
@@ -708,7 +735,7 @@ def layer_slice_fwd(layers_slice, cfg: TransformerConfig, x):
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy))
     dtype = cfg.jnp_dtype
-    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, layers_slice)
+    layers = _cast_layers(layers_slice, dtype)
 
     def scan_step(carry, layer_p):
         new_x, aux = layer_fn(carry, layer_p)
@@ -826,7 +853,7 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     logits = jnp.where(mask, logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
     attn_out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(B, S, nh * hd)
-    attn_out = jnp.einsum("bsk,kd->bsd", attn_out, attn_p["wo"])
+    attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
 
@@ -870,7 +897,7 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
         en = params["embed_norm"]
         x = _norm(x, en["scale"], en.get("bias"), cfg)
 
-    layers = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32 else p, params["layers"])
+    layers = _cast_layers(params["layers"], dtype)
 
     def body(carry, inp):
         h = carry
@@ -884,7 +911,8 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
     if cfg.tie_embeddings:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]["w"].astype(dtype))
+        w = params["lm_head"]["w"]
+        logits = _linear(x, w if isinstance(w, dict) else w.astype(dtype))
         if "b" in params.get("lm_head", {}):
             logits = logits + params["lm_head"]["b"].astype(dtype)
     return logits, {"k": new_k, "v": new_v}

@@ -16,6 +16,7 @@ import os
 import jax
 
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.utils.logging import logger
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
@@ -134,18 +135,33 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
         # raising fence must not leave the engine pinned forever
         try:
             self._async.wait_until_finished()
+            marker_written = True
             if self._pending_meta is not None:
                 path, metadata = self._pending_meta
                 # the directory can legitimately be gone (test tmp dirs
                 # removed between save and teardown drain) — skip the write
                 # but don't break the fence
-                if jax.process_index() == 0 and os.path.isdir(path):
-                    with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
-                        json.dump(metadata, fh, default=str)
+                if jax.process_index() == 0:
+                    if os.path.isdir(path):
+                        with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
+                            json.dump(metadata, fh, default=str)
+                    else:
+                        marker_written = False
+                        logger.warning(
+                            f"checkpoint dir {path} vanished before the async "
+                            "fence; commit marker not written — this tag will "
+                            "load as uncommitted and its commit callbacks "
+                            "(e.g. the 'latest' pointer) are dropped"
+                        )
                 self._pending_meta = None
-            for cb in list(self._pending_commits):
-                cb()
-                self._pending_commits.remove(cb)
+            if marker_written:
+                for cb in list(self._pending_commits):
+                    cb()
+                    self._pending_commits.remove(cb)
+            else:
+                # never point 'latest' (or anything else) at a checkpoint
+                # whose commit marker could not be placed
+                self._pending_commits.clear()
         finally:
             if self._pending_meta is None and not self._pending_commits:
                 _PENDING_ASYNC_ENGINES.discard(self)
