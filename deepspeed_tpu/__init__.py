@@ -67,6 +67,25 @@ def initialize(
         if engine is not None:
             return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
+    # autotuning block (reference: --autotuning run): fast-mode tuning picks
+    # ZeRO stage / micro-batch / remat from the memory model before the
+    # engine is built; measured mode is the Autotuner API (autotuning/)
+    raw = config if isinstance(config, dict) else None
+    if raw is not None and (raw.get("autotuning") or {}).get("enabled", False) \
+            and hasattr(model, "cfg"):
+        import jax as _jax
+
+        from deepspeed_tpu.accelerator import get_accelerator
+        from deepspeed_tpu.autotuning.autotuner import autotune_config
+
+        try:
+            hbm = get_accelerator().total_memory()
+        except Exception:
+            hbm = 0
+        if not hbm or hbm <= 0:  # CPU backend reports no device memory
+            hbm = 16e9
+        config = autotune_config(model.cfg, raw, _jax.device_count(), hbm)
+
     # an explicit mesh fixes the device count (it may cover a subset of local
     # devices, e.g. an elastic shrink — elasticity/elastic_agent.py)
     cfg = TpuConfig(config, mesh_device_count=mesh.devices.size if mesh is not None else None)

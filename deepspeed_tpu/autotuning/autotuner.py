@@ -83,7 +83,7 @@ class Autotuner:
         return (c.micro_batch, -c.zero_stage, not c.remat)
 
     def tune(self, run_fn: Optional[Callable[[Candidate], float]] = None,
-             max_trials: int = 8) -> Candidate:
+             max_trials: int = 8, results_dir: Optional[str] = None) -> Candidate:
         feasible = self.feasible()
         if not feasible:
             raise RuntimeError(
@@ -94,6 +94,7 @@ class Autotuner:
         if run_fn is None:
             best = feasible[0]
             log_dist(f"autotuner(fast): {best}", ranks=[0])
+            self._persist(results_dir, feasible[:max_trials], best, mode="fast")
             return best
         best, best_metric = None, float("-inf")
         for cand in feasible[:max_trials]:
@@ -108,4 +109,110 @@ class Autotuner:
         if best is None:
             raise RuntimeError("all measured candidates failed")
         log_dist(f"autotuner(measured): {best} metric={best_metric}", ranks=[0])
+        self._persist(results_dir, feasible[:max_trials], best, mode="measured")
         return best
+
+    def _persist(self, results_dir, tried, best: Candidate, mode: str):
+        """Experiment records (reference: the autotuner's exps/results dirs
+        with one JSON per experiment + the selected ds_config)."""
+        if not results_dir:
+            return
+        import dataclasses
+        import json
+        import os
+
+        os.makedirs(results_dir, exist_ok=True)
+        for i, cand in enumerate(tried):
+            with open(os.path.join(results_dir, f"exp_{i:03d}.json"), "w") as fh:
+                json.dump({**dataclasses.asdict(cand), "mode": mode}, fh, indent=1)
+        with open(os.path.join(results_dir, "best.json"), "w") as fh:
+            json.dump({**dataclasses.asdict(best), "mode": mode,
+                       "config_patch": best.to_config_patch()}, fh, indent=1)
+
+
+def mesh_shape_candidates(n_devices: int, want_expert: bool = False) -> List[Dict[str, int]]:
+    """All fsdp × tensor (× expert) factorizations of the device count —
+    the mesh-shape axis of the tuning space (the reference tunes ZeRO
+    stage/micro-batch only; on TPU the mesh split is an equally first-class
+    knob)."""
+    shapes = []
+    t = 1
+    while t <= n_devices:
+        if n_devices % t == 0:
+            if want_expert:
+                e = 1
+                while e <= n_devices // t:
+                    if (n_devices // t) % e == 0:
+                        shapes.append({"fsdp": n_devices // t // e, "tensor": t, "expert": e})
+                    e *= 2
+            else:
+                shapes.append({"fsdp": n_devices // t, "tensor": t})
+        t *= 2
+    return shapes
+
+
+def autotune_config(model_cfg, ds_config: Dict[str, Any], n_devices: int,
+                    hbm_bytes: float, run_fn=None) -> Dict[str, Any]:
+    """Consume the ds_config ``autotuning`` block (reference: the
+    ``--autotuning run`` flow materializing an autotuned ds_config):
+    pick ZeRO stage / micro-batch / remat (fast: memory model; measured:
+    ``run_fn(candidate) -> metric``) and return the patched config."""
+    block = dict(ds_config.get("autotuning") or {})
+    if not block.get("enabled", False):
+        return ds_config
+    space = dict(DEFAULT_TUNING_SPACE)
+    for key in ("zero_stage", "micro_batch", "remat"):
+        if key in block:
+            space[key] = list(block[key])
+
+    def make_tuner(fsdp: int, tp: int, sp: int) -> Autotuner:
+        return Autotuner(
+            num_params=model_cfg.num_params(),
+            hbm_bytes=hbm_bytes,
+            fsdp=fsdp, tp=tp, sp=sp,
+            seq_len=getattr(model_cfg, "max_seq_len", 2048),
+            hidden=getattr(model_cfg, "hidden_size", 4096),
+            num_layers=getattr(model_cfg, "num_layers", 32),
+            tuning_space=space,
+        )
+
+    mesh = dict(ds_config.get("mesh") or {})
+    mode_run_fn = run_fn if block.get("mode", "fast") == "measured" else None
+    mesh_patch = None
+    if block.get("tune_mesh", False):
+        # mesh-shape axis: rank each fsdp×tensor factorization of the
+        # device count by its best candidate (larger micro-batch, then
+        # lower stage, then fewer tensor splits = less per-layer comm)
+        best, best_key = None, None
+        for shape in mesh_shape_candidates(n_devices):
+            tuner = make_tuner(shape["fsdp"], shape["tensor"], 1)
+            feasible = tuner.feasible()
+            if not feasible:
+                continue
+            feasible.sort(key=Autotuner._fast_key, reverse=True)
+            key = (*Autotuner._fast_key(feasible[0]), -shape["tensor"])
+            if best_key is None or key > best_key:
+                best, best_key, mesh_patch = feasible[0], key, shape
+        if best is None:
+            raise RuntimeError(
+                f"autotuning: no mesh shape over {n_devices} devices fits "
+                f"{hbm_bytes / 1024**3:.1f} GB HBM"
+            )
+    else:
+        tuner = make_tuner(max(1, mesh.get("fsdp", 1)), max(1, mesh.get("tensor", 1)),
+                           max(1, mesh.get("sequence", 1)))
+        best = tuner.tune(
+            run_fn=mode_run_fn,
+            max_trials=int(block.get("max_trials", 8)),
+            results_dir=block.get("results_dir"),
+        )
+    patched = dict(ds_config)
+    for key, val in best.to_config_patch().items():
+        if isinstance(val, dict):
+            patched[key] = {**dict(patched.get(key) or {}), **val}
+        else:
+            patched[key] = val
+    if mesh_patch is not None:
+        patched["mesh"] = mesh_patch
+    log_dist(f"autotuning applied: {best.to_config_patch()} mesh={mesh_patch or mesh}", ranks=[0])
+    return patched

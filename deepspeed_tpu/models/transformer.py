@@ -51,7 +51,13 @@ class TransformerConfig:
     dropout: float = 0.0
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | dots_with_no_batch_dims
-    attn_impl: str = "xla"  # xla | pallas (flash attention kernel)
+    attn_impl: str = "xla"  # xla | pallas (flash) | block_sparse (layout kernel)
+    # block-sparse attention pattern (attn_impl="block_sparse"): mode is one
+    # of dense|fixed|bigbird|bslongformer|variable plus that mode's kwargs
+    # (ops/sparse_attention/sparsity_config.py; reference
+    # ops/sparse_attention/sparse_self_attention.py + docs "~10x longer
+    # sequences"). Tuple-of-pairs so the frozen config stays hashable.
+    sparse_attention: Optional[tuple] = None  # e.g. (("mode","fixed"),("block",128))
     use_bias: bool = True  # linear/ln biases (gpt2 yes, llama no)
     scan_layers: bool = True
     # --- architecture variants for the HF injection-policy families
@@ -86,6 +92,14 @@ class TransformerConfig:
     # p_l = 1 - (l/L) * (1 - theta); theta is a dynamic scalar from the
     # engine's PLD schedule (runtime/progressive_layer_drop.py)
     pld_enabled: bool = False
+
+    def __post_init__(self):
+        # accept a dict for sparse_attention (user-facing) but store a
+        # tuple-of-pairs so the frozen config stays hashable
+        if isinstance(self.sparse_attention, dict):
+            object.__setattr__(
+                self, "sparse_attention", tuple(sorted(self.sparse_attention.items()))
+            )
 
     @property
     def head_dim(self):
@@ -400,6 +414,28 @@ def _alibi_slopes(n_heads: int) -> jnp.ndarray:
     return jnp.asarray(slopes, jnp.float32)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _sparse_layout(sparse_attention: tuple, num_heads: int, seq_len: int):
+    """Static block-sparse layout for (pattern, heads, seq) — numpy, built
+    once per shape and embedded as a jit constant. Returns (layout, block)."""
+    from deepspeed_tpu.ops.sparse_attention import sparsity_config as sc
+
+    opts = dict(sparse_attention)
+    mode = opts.pop("mode", "fixed")
+    cls = {
+        "dense": sc.DenseSparsityConfig,
+        "fixed": sc.FixedSparsityConfig,
+        "bigbird": sc.BigBirdSparsityConfig,
+        "bslongformer": sc.BSLongformerSparsityConfig,
+        "variable": sc.VariableSparsityConfig,
+    }[mode]
+    config = cls(num_heads=num_heads, **opts)
+    return config.make_layout(seq_len), config.block
+
+
 def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
     """Causal multi-head / grouped-query attention.
 
@@ -419,6 +455,20 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
             return sequence_parallel_attention(
                 q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh, attn_impl=cfg.attn_impl
             )
+    if cfg.attn_impl == "block_sparse":
+        # layout-aware Pallas kernel: long-sequence training/prefill path
+        # (reference SparseSelfAttention; decode stays dense — the KV-cache
+        # loop attends a single query row)
+        if cfg.pos_embedding == "alibi":
+            raise NotImplementedError("ALiBi bias is not supported with block-sparse attention")
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import block_sparse_attention
+
+        if nkv != nh:
+            k = jnp.repeat(k, nh // nkv, axis=2)
+            v = jnp.repeat(v, nh // nkv, axis=2)
+        layout, block = _sparse_layout(cfg.sparse_attention or (("mode", "fixed"),), nh, S)
+        # kernel convention matches the model: (B, S, H, hd)
+        return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block)
     if cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 

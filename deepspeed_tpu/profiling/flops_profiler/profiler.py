@@ -176,10 +176,69 @@ def get_model_profile(
     prof.profile_fn(fn_, *args_, **kwargs)
     if print_profile:
         prof.print_model_profile(detailed=detailed)
+        if detailed and fn is None and model is not None and hasattr(model, "cfg") and input_shape:
+            print_component_table(
+                component_breakdown(params, model.cfg, input_shape[0], input_shape[1])
+            )
     flops = prof.get_total_flops(as_string)
     macs = number_to_string(prof.flops / 2, "MACs") if as_string else prof.flops / 2
     params_out = prof.get_total_params(as_string)
     return flops, macs, params_out
+
+
+def component_breakdown(params, cfg, batch_size: int, seq_len: int) -> Dict[str, Dict[str, float]]:
+    """Per-component params + forward-FLOPs table (the reference profiler's
+    depth-wise module table, profiler.py:23 aggregated over hooks; here the
+    components are the flagship tree's top-level subtrees and the FLOPs are
+    the analytic matmul counts — XLA fusion dissolves module boundaries, so
+    analytic per-component is the faithful equivalent)."""
+    D = cfg.hidden_size
+    L = cfg.num_layers
+    V = cfg.vocab_size
+    kvd = cfg.kv_heads * cfg.head_dim
+    B, S = batch_size, seq_len
+    tok = B * S
+
+    def subtree_params(name):
+        sub = params.get(name, {}) if isinstance(params, dict) else {}
+        return count_params(sub)
+
+    mlp_params_per_layer = (3 if cfg.activation == "silu_glu" else 2) * D * cfg.ffn_size
+    if cfg.moe_num_experts > 0:
+        mlp_params_per_layer = mlp_params_per_layer * cfg.moe_num_experts + D * cfg.moe_num_experts
+    attn_matmul_params = 2 * D * D + 2 * D * kvd
+
+    table = {
+        "embed": {"params": subtree_params("embed"), "flops": 0.0},
+        "attn (qkvo)": {"params": L * attn_matmul_params,
+                        "flops": 2.0 * tok * L * attn_matmul_params},
+        "attn (scores+pv)": {"params": 0,
+                             "flops": 2.0 * 2.0 * B * S * S * D * L},
+        "mlp": {"params": L * mlp_params_per_layer,
+                "flops": 2.0 * tok * L * mlp_params_per_layer
+                * (min(cfg.moe_top_k, cfg.moe_num_experts) / cfg.moe_num_experts
+                   if cfg.moe_num_experts > 0 else 1.0)},
+        "lm_head": {"params": subtree_params("lm_head"), "flops": 2.0 * tok * D * V},
+    }
+    total_flops = sum(row["flops"] for row in table.values())
+    for row in table.values():
+        row["flops_pct"] = 100.0 * row["flops"] / total_flops if total_flops else 0.0
+    return table
+
+
+def print_component_table(table: Dict[str, Dict[str, float]], output_file=None):
+    lines = ["  component breakdown (fwd):"]
+    for name, row in table.items():
+        lines.append(
+            f"    {name:<18} params={number_to_string(row['params'], ''):>10} "
+            f"flops={number_to_string(row['flops'], 'FLOPs'):>12} ({row['flops_pct']:.1f}%)"
+        )
+    text = "\n".join(lines)
+    if output_file:
+        with open(output_file, "a") as fh:
+            fh.write(text + "\n")
+    else:
+        log_dist(text, ranks=[0])
 
 
 def number_to_string(num: float, unit: str = "") -> str:

@@ -79,3 +79,31 @@ class TestFormatting:
         assert number_to_string(2.5e12, "FLOPs") == "2.50 TFLOPs"
         assert number_to_string(3.2e6, "") == "3.20 M"
         assert number_to_string(12.0, "B") == "12.00 B"
+
+
+def test_component_breakdown():
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+    from deepspeed_tpu.profiling.flops_profiler.profiler import component_breakdown
+
+    cfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=64, dtype="float32")
+    model = TransformerModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    table = component_breakdown(params, cfg, batch_size=2, seq_len=32)
+    assert set(table) == {"embed", "attn (qkvo)", "attn (scores+pv)", "mlp", "lm_head"}
+    # percentages sum to 100; params match the analytic counts
+    assert abs(sum(r["flops_pct"] for r in table.values()) - 100.0) < 1e-6
+    assert table["attn (qkvo)"]["params"] == 2 * 4 * 64 * 64  # L * 4 * D^2
+    assert table["mlp"]["params"] == 2 * 2 * 64 * 256
+    assert table["embed"]["params"] > 0
+
+
+def test_get_model_profile_detailed_table(capsys):
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+    from deepspeed_tpu.profiling.flops_profiler.profiler import get_model_profile
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                            num_heads=2, max_seq_len=32, dtype="float32")
+    flops, macs, params = get_model_profile(TransformerModel(cfg), input_shape=(2, 16),
+                                            as_string=False)
+    assert flops > 0 and params > 0 and macs == flops / 2
