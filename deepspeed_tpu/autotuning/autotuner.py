@@ -121,6 +121,10 @@ class Autotuner:
         import json
         import os
 
+        import jax
+
+        if jax.process_index() != 0:  # shared results_dir: one writer
+            return
         os.makedirs(results_dir, exist_ok=True)
         for i, cand in enumerate(tried):
             with open(os.path.join(results_dir, f"exp_{i:03d}.json"), "w") as fh:
@@ -134,20 +138,17 @@ def mesh_shape_candidates(n_devices: int, want_expert: bool = False) -> List[Dic
     """All fsdp × tensor (× expert) factorizations of the device count —
     the mesh-shape axis of the tuning space (the reference tunes ZeRO
     stage/micro-batch only; on TPU the mesh split is an equally first-class
-    knob)."""
+    knob). Every divisor is enumerated, not just powers of two (a 12-chip
+    slice legitimately wants tensor=3)."""
+    divisors = [d for d in range(1, n_devices + 1) if n_devices % d == 0]
     shapes = []
-    t = 1
-    while t <= n_devices:
-        if n_devices % t == 0:
-            if want_expert:
-                e = 1
-                while e <= n_devices // t:
-                    if (n_devices // t) % e == 0:
-                        shapes.append({"fsdp": n_devices // t // e, "tensor": t, "expert": e})
-                    e *= 2
-            else:
-                shapes.append({"fsdp": n_devices // t, "tensor": t})
-        t *= 2
+    for t in divisors:
+        rest = n_devices // t
+        if want_expert:
+            for e in (d for d in range(1, rest + 1) if rest % d == 0):
+                shapes.append({"fsdp": rest // e, "tensor": t, "expert": e})
+        else:
+            shapes.append({"fsdp": rest, "tensor": t})
     return shapes
 
 
@@ -178,34 +179,42 @@ def autotune_config(model_cfg, ds_config: Dict[str, Any], n_devices: int,
 
     mesh = dict(ds_config.get("mesh") or {})
     mode_run_fn = run_fn if block.get("mode", "fast") == "measured" else None
+    max_trials = int(block.get("max_trials", 8))
+    results_dir = block.get("results_dir")
     mesh_patch = None
     if block.get("tune_mesh", False):
-        # mesh-shape axis: rank each fsdp×tensor factorization of the
-        # device count by its best candidate (larger micro-batch, then
-        # lower stage, then fewer tensor splits = less per-layer comm)
-        best, best_key = None, None
-        for shape in mesh_shape_candidates(n_devices):
-            tuner = make_tuner(shape["fsdp"], shape["tensor"], 1)
+        # mesh-shape axis: rank each fsdp×tensor factorization of the FREE
+        # device budget (user-pinned axes like sequence/pipe/expert are
+        # reserved, their product divides out) by its best memory-model
+        # candidate (larger micro-batch, then lower stage, then fewer
+        # tensor splits = less per-layer comm)
+        sp = max(1, mesh.get("sequence", 1))
+        reserved = sp * max(1, mesh.get("pipe", 1)) * max(1, mesh.get("expert", 1))
+        n_free = max(1, n_devices // reserved)
+        best_shape, best_key = None, None
+        for shape in mesh_shape_candidates(n_free):
+            tuner = make_tuner(shape["fsdp"], shape["tensor"], sp)
             feasible = tuner.feasible()
             if not feasible:
                 continue
             feasible.sort(key=Autotuner._fast_key, reverse=True)
             key = (*Autotuner._fast_key(feasible[0]), -shape["tensor"])
             if best_key is None or key > best_key:
-                best, best_key, mesh_patch = feasible[0], key, shape
-        if best is None:
+                best_shape, best_key = shape, key
+        if best_shape is None:
             raise RuntimeError(
                 f"autotuning: no mesh shape over {n_devices} devices fits "
                 f"{hbm_bytes / 1024**3:.1f} GB HBM"
             )
+        # within the chosen shape, run the full tune (honors measured-mode
+        # run_fn and persists experiment records)
+        tuner = make_tuner(best_shape["fsdp"], best_shape["tensor"], sp)
+        best = tuner.tune(run_fn=mode_run_fn, max_trials=max_trials, results_dir=results_dir)
+        mesh_patch = {**mesh, **best_shape}  # user-pinned axes survive
     else:
         tuner = make_tuner(max(1, mesh.get("fsdp", 1)), max(1, mesh.get("tensor", 1)),
                            max(1, mesh.get("sequence", 1)))
-        best = tuner.tune(
-            run_fn=mode_run_fn,
-            max_trials=int(block.get("max_trials", 8)),
-            results_dir=block.get("results_dir"),
-        )
+        best = tuner.tune(run_fn=mode_run_fn, max_trials=max_trials, results_dir=results_dir)
     patched = dict(ds_config)
     for key, val in best.to_config_patch().items():
         if isinstance(val, dict):

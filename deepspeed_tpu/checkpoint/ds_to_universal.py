@@ -1,0 +1,33 @@
+"""``python -m deepspeed_tpu.checkpoint.ds_to_universal`` — convert an
+engine checkpoint into the universal interchange format (reference:
+``deepspeed/checkpoint/ds_to_universal.py`` CLI).
+
+The universal tree is mesh-shape-free (one npz per logical array + JSON
+manifest), so the output resumes on ANY mesh / ZeRO stage / pipeline cut
+(checkpoint/universal_checkpoint.py).
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "ds_to_universal", description="engine checkpoint -> universal format"
+    )
+    ap.add_argument("--input_folder", required=True, help="engine checkpoint dir")
+    ap.add_argument("--output_folder", required=True, help="universal output dir")
+    ap.add_argument("--tag", default=None, help="checkpoint tag (default: latest)")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.checkpoint.universal_checkpoint import ds_to_universal
+
+    manifest = ds_to_universal(args.input_folder, args.output_folder, tag=args.tag)
+    print(json.dumps({"output": args.output_folder, "tag": manifest.get("tag"),
+                      "tensors": len(manifest.get("tensors", {}))}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -489,8 +489,13 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _quick_gelu(x):
+    # CLIP's approximation: x * sigmoid(1.702 x)
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
 def _dense_act(cfg: TransformerConfig):
-    return jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+    return {"relu": jax.nn.relu, "quick_gelu": _quick_gelu}.get(cfg.activation, jax.nn.gelu)
 
 
 def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False):

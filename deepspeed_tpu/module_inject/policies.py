@@ -567,7 +567,284 @@ class BertPolicy(HFPolicy):
         return params
 
 
-POLICIES = [GPT2Policy, LlamaPolicy, OPTPolicy, BloomPolicy, GPTNeoXPolicy, GPTJPolicy, BertPolicy]
+class DistilBertPolicy(HFPolicy):
+    """reference: HFDistilBertLayerPolicy (module_inject/containers/
+    distil_bert.py) — BERT-family post-LN encoder without token types;
+    torch Linear weights are (out, in) so every matmul transposes."""
+
+    ARCHITECTURES = ("DistilBertModel", "DistilBertForMaskedLM",
+                     "DistilBertForSequenceClassification", "distilbert")
+
+    def config(self, hf_config) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.dim,
+            num_layers=hf_config.n_layers,
+            num_heads=hf_config.n_heads,
+            ffn_hidden_size=hf_config.hidden_dim,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_embedding="learned",
+            norm_type="layernorm",
+            activation="gelu",
+            norm_position="post",
+            causal=False,
+            type_vocab_size=0,
+            embed_norm=True,
+            tie_embeddings=True,
+            use_bias=True,
+            norm_eps=1e-12,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        pre = "distilbert." if any(k.startswith("distilbert.") for k in state) else ""
+
+        def g(name):
+            return _np(state[pre + name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        return {
+            "embed": {
+                "tok": g("embeddings.word_embeddings.weight"),
+                "pos": g("embeddings.position_embeddings.weight"),
+            },
+            "embed_norm": {
+                "scale": g("embeddings.LayerNorm.weight"),
+                "bias": g("embeddings.LayerNorm.bias"),
+            },
+            "layers": {
+                "attn": {
+                    "wq": stackT("transformer.layer.{}.attention.q_lin.weight"),
+                    "wk": stackT("transformer.layer.{}.attention.k_lin.weight"),
+                    "wv": stackT("transformer.layer.{}.attention.v_lin.weight"),
+                    "wo": stackT("transformer.layer.{}.attention.out_lin.weight"),
+                    "bq": stackB("transformer.layer.{}.attention.q_lin.bias"),
+                    "bk": stackB("transformer.layer.{}.attention.k_lin.bias"),
+                    "bv": stackB("transformer.layer.{}.attention.v_lin.bias"),
+                    "bo": stackB("transformer.layer.{}.attention.out_lin.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("transformer.layer.{}.ffn.lin1.weight"),
+                    "wo": stackT("transformer.layer.{}.ffn.lin2.weight"),
+                    "bi": stackB("transformer.layer.{}.ffn.lin1.bias"),
+                    "bo": stackB("transformer.layer.{}.ffn.lin2.bias"),
+                },
+                # post-LN: ln1 after attention residual, ln2 after ffn residual
+                "ln1": {
+                    "scale": stackB("transformer.layer.{}.sa_layer_norm.weight"),
+                    "bias": stackB("transformer.layer.{}.sa_layer_norm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("transformer.layer.{}.output_layer_norm.weight"),
+                    "bias": stackB("transformer.layer.{}.output_layer_norm.bias"),
+                },
+            },
+            "final_norm": {"scale": np.ones(D, np.float32), "bias": np.zeros(D, np.float32)},
+        }
+
+
+class MegatronGPTPolicy(HFPolicy):
+    """reference: MegatronLayerPolicy (module_inject/containers/megatron_gpt.py)
+    — Megatron-LM GPT checkpoints with FUSED query_key_value projections.
+    Both row layouts are handled: checkpoint_version >= 2 stores per-head
+    [q;k;v] blocks, version 0 stores [all-q; all-k; all-v] (the reference
+    splits via megatron's fix_query_key_value_ordering)."""
+
+    ARCHITECTURES = ("MegatronGPT2LMHeadModel", "megatron-gpt2", "megatron_gpt2")
+
+    def __init__(self, checkpoint_version: int = 2):
+        self.checkpoint_version = checkpoint_version
+
+    def config(self, hf_config) -> TransformerConfig:
+        # the dispatch path (policy_for) constructs with no arguments, so a
+        # checkpoint that carries its version must win over the default —
+        # version 0 split with the v2 layout scrambles heads silently
+        # (both layouts have identical shapes, so no error would surface)
+        if hasattr(hf_config, "checkpoint_version"):
+            self.checkpoint_version = int(hf_config.checkpoint_version)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=getattr(hf_config, "hidden_size", getattr(hf_config, "n_embd", None)),
+            num_layers=getattr(hf_config, "num_layers", getattr(hf_config, "n_layer", None)),
+            num_heads=getattr(hf_config, "num_attention_heads", getattr(hf_config, "n_head", None)),
+            max_seq_len=getattr(hf_config, "max_position_embeddings", 1024),
+            pos_embedding="learned",
+            norm_type="layernorm",
+            activation="gelu",
+            tie_embeddings=True,
+            use_bias=True,
+        )
+
+    def _split_qkv(self, w, nh, hd):
+        """(D, 3*D) fused matrix -> three (D, D) matrices, by row layout."""
+        if self.checkpoint_version >= 2:
+            # columns grouped per head: [h0q h0k h0v h1q ...]
+            cols = w.reshape(w.shape[0], nh, 3, hd)
+            return (cols[:, :, 0].reshape(w.shape[0], nh * hd),
+                    cols[:, :, 1].reshape(w.shape[0], nh * hd),
+                    cols[:, :, 2].reshape(w.shape[0], nh * hd))
+        D = nh * hd
+        return w[:, :D], w[:, D:2 * D], w[:, 2 * D:]
+
+    def _split_qkv_bias(self, b, nh, hd):
+        if self.checkpoint_version >= 2:
+            cols = b.reshape(nh, 3, hd)
+            return cols[:, 0].ravel(), cols[:, 1].ravel(), cols[:, 2].ravel()
+        D = nh * hd
+        return b[:D], b[D:2 * D], b[2 * D:]
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        nh, hd = cfg.num_heads, cfg.head_dim
+        pre = ""
+        for cand in ("model.language_model.", "language_model.", ""):
+            if any(k.startswith(cand + "embedding") for k in state):
+                pre = cand
+                break
+
+        def g(name):
+            return _np(state[pre + name])
+
+        qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+        for i in range(L):
+            # megatron Linear stores (out, in): transpose to (in, out) first
+            w = g(f"transformer.layers.{i}.attention.query_key_value.weight").T
+            b = g(f"transformer.layers.{i}.attention.query_key_value.bias")
+            wq, wk, wv = self._split_qkv(w, nh, hd)
+            bq, bk, bv = self._split_qkv_bias(b, nh, hd)
+            qs.append(wq), ks.append(wk), vs.append(wv)
+            bqs.append(bq), bks.append(bk), bvs.append(bv)
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        return {
+            "embed": {
+                "tok": g("embedding.word_embeddings.weight"),
+                "pos": g("embedding.position_embeddings.weight"),
+            },
+            "layers": {
+                "attn": {
+                    "wq": np.stack(qs), "wk": np.stack(ks), "wv": np.stack(vs),
+                    "wo": stackT("transformer.layers.{}.attention.dense.weight"),
+                    "bq": np.stack(bqs), "bk": np.stack(bks), "bv": np.stack(bvs),
+                    "bo": stackB("transformer.layers.{}.attention.dense.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("transformer.layers.{}.mlp.dense_h_to_4h.weight"),
+                    "wo": stackT("transformer.layers.{}.mlp.dense_4h_to_h.weight"),
+                    "bi": stackB("transformer.layers.{}.mlp.dense_h_to_4h.bias"),
+                    "bo": stackB("transformer.layers.{}.mlp.dense_4h_to_h.bias"),
+                },
+                "ln1": {
+                    "scale": stackB("transformer.layers.{}.input_layernorm.weight"),
+                    "bias": stackB("transformer.layers.{}.input_layernorm.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("transformer.layers.{}.post_attention_layernorm.weight"),
+                    "bias": stackB("transformer.layers.{}.post_attention_layernorm.bias"),
+                },
+            },
+            "final_norm": {
+                "scale": g("transformer.final_layernorm.weight"),
+                "bias": g("transformer.final_layernorm.bias"),
+            },
+        }
+
+
+class CLIPTextPolicy(HFPolicy):
+    """reference: HFCLIPLayerPolicy (module_inject/containers/clip.py) —
+    the CLIP TEXT encoder (pre-LN, causal attention, quick_gelu). The
+    vision tower's conv patch-embedding is outside the injected layer set
+    in the reference too; its transformer layers share this shape."""
+
+    ARCHITECTURES = ("CLIPTextModel", "CLIPModel", "clip", "clip_text_model")
+
+    def config(self, hf_config) -> TransformerConfig:
+        # CLIPModel configs nest the text tower under .text_config
+        tc = getattr(hf_config, "text_config", hf_config)
+        return TransformerConfig(
+            vocab_size=tc.vocab_size,
+            hidden_size=tc.hidden_size,
+            num_layers=tc.num_hidden_layers,
+            num_heads=tc.num_attention_heads,
+            ffn_hidden_size=tc.intermediate_size,
+            max_seq_len=tc.max_position_embeddings,
+            pos_embedding="learned",
+            norm_type="layernorm",
+            activation="quick_gelu" if getattr(tc, "hidden_act", "quick_gelu") == "quick_gelu" else "gelu",
+            norm_position="pre",
+            causal=True,  # CLIP text attention is causal
+            tie_embeddings=True,
+            use_bias=True,
+            norm_eps=tc.layer_norm_eps,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        L = cfg.num_layers
+        pre = ""
+        for cand in ("text_model.", "model.text_model.", ""):
+            if any(k.startswith(cand + "embeddings") for k in state):
+                pre = cand
+                break
+
+        def g(name):
+            return _np(state[pre + name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        return {
+            "embed": {
+                "tok": g("embeddings.token_embedding.weight"),
+                "pos": g("embeddings.position_embedding.weight"),
+            },
+            "layers": {
+                "attn": {
+                    "wq": stackT("encoder.layers.{}.self_attn.q_proj.weight"),
+                    "wk": stackT("encoder.layers.{}.self_attn.k_proj.weight"),
+                    "wv": stackT("encoder.layers.{}.self_attn.v_proj.weight"),
+                    "wo": stackT("encoder.layers.{}.self_attn.out_proj.weight"),
+                    "bq": stackB("encoder.layers.{}.self_attn.q_proj.bias"),
+                    "bk": stackB("encoder.layers.{}.self_attn.k_proj.bias"),
+                    "bv": stackB("encoder.layers.{}.self_attn.v_proj.bias"),
+                    "bo": stackB("encoder.layers.{}.self_attn.out_proj.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("encoder.layers.{}.mlp.fc1.weight"),
+                    "wo": stackT("encoder.layers.{}.mlp.fc2.weight"),
+                    "bi": stackB("encoder.layers.{}.mlp.fc1.bias"),
+                    "bo": stackB("encoder.layers.{}.mlp.fc2.bias"),
+                },
+                "ln1": {
+                    "scale": stackB("encoder.layers.{}.layer_norm1.weight"),
+                    "bias": stackB("encoder.layers.{}.layer_norm1.bias"),
+                },
+                "ln2": {
+                    "scale": stackB("encoder.layers.{}.layer_norm2.weight"),
+                    "bias": stackB("encoder.layers.{}.layer_norm2.bias"),
+                },
+            },
+            "final_norm": {
+                "scale": g("final_layer_norm.weight"),
+                "bias": g("final_layer_norm.bias"),
+            },
+        }
+
+
+POLICIES = [GPT2Policy, LlamaPolicy, OPTPolicy, BloomPolicy, GPTNeoXPolicy, GPTJPolicy,
+            BertPolicy, DistilBertPolicy, MegatronGPTPolicy, CLIPTextPolicy]
 
 
 def policy_for(hf_config) -> HFPolicy:

@@ -121,3 +121,34 @@ def test_client_state_and_latest_tag(tmp_path):
     e2 = make_engine(tmp_path, stage=0)
     _, client = e2.load_checkpoint(ckpt)
     assert client["epoch"] == 7
+
+
+def test_ds_to_universal_cli(tmp_path):
+    """The ds_to_universal CLI (reference checkpoint/ds_to_universal.py)
+    converts a saved engine checkpoint via argv."""
+    import deepspeed_tpu
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.checkpoint.ds_to_universal import main
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+    comm.destroy()
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+                            max_seq_len=16, dtype="float32")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerModel(cfg),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {"data": -1}, "steps_per_print": 10_000},
+    )
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    ckpt = str(tmp_path / "ck")
+    engine.save_checkpoint(ckpt, tag="t0")
+    out = str(tmp_path / "universal")
+    assert main(["--input_folder", ckpt, "--output_folder", out, "--tag", "t0"]) == 0
+    assert (tmp_path / "universal").is_dir()
+    import os
+    assert any(f.endswith(".npz") for f in os.listdir(out)) or \
+        any((tmp_path / "universal").rglob("*.npz"))

@@ -147,3 +147,19 @@ def test_group_world_sizes():
     assert comm.get_world_size(("data", "fsdp")) == 4
     assert comm.get_world_size() == 8
     assert comm.dp_world_size() == 4
+
+
+def test_dstpu_bench_comm_sweep():
+    """dstpu_bench (reference bin/ds_bench): every collective produces a
+    bandwidth record over the sweep on the virtual mesh."""
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.launcher.bench_comm import run
+
+    comm.destroy()
+    report = run(sizes_mb=[0.125], iters=1, axis="data")
+    assert report["devices"] == 8
+    ops = {r["op"] for r in report["results"]}
+    assert ops == {"all_reduce", "all_gather", "reduce_scatter", "all_to_all", "ppermute"}
+    for r in report["results"]:
+        assert "error" not in r, r
+        assert r["algbw_gbps"] >= 0 and r["busbw_gbps"] >= 0

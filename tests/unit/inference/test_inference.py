@@ -407,3 +407,123 @@ class TestRealInt8:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
         # and close to the unquantized product (W8A8 error ~ 1/127 per factor)
         np.testing.assert_allclose(got, np.asarray(x @ w), atol=0.15)
+
+
+class TestNewPolicies:
+    def test_distilbert_hidden_state_parity(self):
+        import torch
+        from transformers import DistilBertConfig, DistilBertModel
+
+        torch.manual_seed(0)
+        hf = DistilBertModel(DistilBertConfig(
+            vocab_size=128, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+            max_position_embeddings=64, dropout=0.0, attention_dropout=0.0,
+        )).eval()
+        from deepspeed_tpu.models.transformer import encode
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+        cfg, params = convert_hf_model(hf)
+        rs = np.random.RandomState(0)
+        tokens = rs.randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).last_hidden_state.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(encode(params, cfg, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_megatron_fused_qkv_split(self):
+        """Synthetic megatron-format state dict: the fused query_key_value
+        splits must land in the right wq/wk/wv slots for BOTH row layouts
+        (reference fix_query_key_value_ordering)."""
+        from deepspeed_tpu.module_inject.policies import MegatronGPTPolicy
+
+        D, L, nh, hd, V, S = 8, 1, 2, 4, 32, 16
+        rs = np.random.RandomState(0)
+
+        class FakeCfg:
+            vocab_size = V
+            hidden_size = D
+            num_layers = L
+            num_attention_heads = nh
+            max_position_embeddings = S
+
+        def mk_state(version):
+            # build fused (3D, D) torch-layout weight whose per-head q/k/v
+            # blocks carry distinct constants
+            wq = np.full((D, D), 1.0); wk = np.full((D, D), 2.0); wv = np.full((D, D), 3.0)
+            if version >= 2:
+                # rows per head: [h0q(hd) h0k h0v h1q ...] in (out, in)
+                rows = []
+                for h in range(nh):
+                    rows += [wq.T[h * hd:(h + 1) * hd], wk.T[h * hd:(h + 1) * hd], wv.T[h * hd:(h + 1) * hd]]
+                fused = np.concatenate(rows, axis=0)
+            else:
+                fused = np.concatenate([wq.T, wk.T, wv.T], axis=0)
+            bias = np.arange(3 * D, dtype=np.float32)
+            state = {
+                "embedding.word_embeddings.weight": rs.randn(V, D).astype(np.float32),
+                "embedding.position_embeddings.weight": rs.randn(S, D).astype(np.float32),
+                "transformer.layers.0.attention.query_key_value.weight": fused.astype(np.float32),
+                "transformer.layers.0.attention.query_key_value.bias": bias,
+                "transformer.layers.0.attention.dense.weight": rs.randn(D, D).astype(np.float32),
+                "transformer.layers.0.attention.dense.bias": np.zeros(D, np.float32),
+                "transformer.layers.0.mlp.dense_h_to_4h.weight": rs.randn(4 * D, D).astype(np.float32),
+                "transformer.layers.0.mlp.dense_h_to_4h.bias": np.zeros(4 * D, np.float32),
+                "transformer.layers.0.mlp.dense_4h_to_h.weight": rs.randn(D, 4 * D).astype(np.float32),
+                "transformer.layers.0.mlp.dense_4h_to_h.bias": np.zeros(D, np.float32),
+                "transformer.layers.0.input_layernorm.weight": np.ones(D, np.float32),
+                "transformer.layers.0.input_layernorm.bias": np.zeros(D, np.float32),
+                "transformer.layers.0.post_attention_layernorm.weight": np.ones(D, np.float32),
+                "transformer.layers.0.post_attention_layernorm.bias": np.zeros(D, np.float32),
+                "transformer.final_layernorm.weight": np.ones(D, np.float32),
+                "transformer.final_layernorm.bias": np.zeros(D, np.float32),
+            }
+            return state
+
+        for version in (0, 2):
+            policy = MegatronGPTPolicy(checkpoint_version=version)
+            cfg = policy.config(FakeCfg())
+            params = policy.params(mk_state(version), cfg)
+            np.testing.assert_array_equal(params["layers"]["attn"]["wq"][0], np.full((D, D), 1.0))
+            np.testing.assert_array_equal(params["layers"]["attn"]["wk"][0], np.full((D, D), 2.0))
+            np.testing.assert_array_equal(params["layers"]["attn"]["wv"][0], np.full((D, D), 3.0))
+            if version == 0:
+                np.testing.assert_array_equal(params["layers"]["attn"]["bq"][0], np.arange(D))
+
+    def test_policy_dispatch_new_archs(self):
+        from deepspeed_tpu.module_inject.policies import (
+            DistilBertPolicy, MegatronGPTPolicy, policy_for)
+
+        class C1:
+            architectures = ["DistilBertForMaskedLM"]
+            model_type = "distilbert"
+
+        class C2:
+            architectures = ["MegatronGPT2LMHeadModel"]
+            model_type = "megatron_gpt2"
+
+        assert isinstance(policy_for(C1()), DistilBertPolicy)
+        assert isinstance(policy_for(C2()), MegatronGPTPolicy)
+
+    def test_clip_text_hidden_state_parity(self):
+        import torch
+        from transformers import CLIPTextConfig, CLIPTextModel
+
+        torch.manual_seed(0)
+        hf = CLIPTextModel(CLIPTextConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64, hidden_act="quick_gelu",
+            attention_dropout=0.0,
+        )).eval()
+        from deepspeed_tpu.models.transformer import encode
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+        cfg, params = convert_hf_model(hf)
+        assert cfg.activation == "quick_gelu" and cfg.causal
+        rs = np.random.RandomState(0)
+        tokens = rs.randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).last_hidden_state.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(encode(params, cfg, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)

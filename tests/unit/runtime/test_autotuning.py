@@ -91,6 +91,9 @@ def test_mesh_shape_candidates():
     with_ep = mesh_shape_candidates(8, want_expert=True)
     assert {"fsdp": 2, "tensor": 2, "expert": 2} in with_ep
     assert all(s["fsdp"] * s["tensor"] * s.get("expert", 1) == 8 for s in with_ep)
+    # non-power-of-two device counts enumerate every divisor
+    twelve = mesh_shape_candidates(12)
+    assert {"fsdp": 4, "tensor": 3} in twelve and {"fsdp": 2, "tensor": 6} in twelve
 
 
 def test_autotune_config_block(tmp_path):
@@ -153,6 +156,11 @@ def test_autotune_mesh_search():
     out = autotune_config(cfg, ds, n_devices=8, hbm_bytes=16e9)
     mesh = out["mesh"]
     assert mesh["fsdp"] * mesh["tensor"] == 8
+    # user-pinned axes are reserved out of the budget and survive the patch
+    ds2 = {"mesh": {"sequence": 2}, "autotuning": {"enabled": True, "tune_mesh": True}}
+    out2 = autotune_config(cfg, ds2, n_devices=8, hbm_bytes=16e9)
+    assert out2["mesh"]["sequence"] == 2
+    assert out2["mesh"]["fsdp"] * out2["mesh"]["tensor"] == 4
     # 2.8B at 16GB cannot fit unsharded: SOME model-sharding axis must be used
     assert mesh["fsdp"] > 1 or mesh["tensor"] > 1
     assert out["train_micro_batch_size_per_gpu"] >= 1
