@@ -1,4 +1,4 @@
-"""``python -m deepspeed_tpu.checkpoint.ds_to_universal`` — convert an
+"""``python -m deepspeed_tpu.checkpoint.ds_to_universal_cli`` (or ``dstpu_to_universal``) — convert an
 engine checkpoint into the universal interchange format (reference:
 ``deepspeed/checkpoint/ds_to_universal.py`` CLI).
 

@@ -128,7 +128,7 @@ def test_ds_to_universal_cli(tmp_path):
     converts a saved engine checkpoint via argv."""
     import deepspeed_tpu
     from deepspeed_tpu import comm
-    from deepspeed_tpu.checkpoint.ds_to_universal import main
+    from deepspeed_tpu.checkpoint.ds_to_universal_cli import main
     from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
 
     comm.destroy()
