@@ -59,6 +59,64 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
     return prefill_fn, decode_fn, cache_sh, batch_sh
 
 
+def compile_ragged_prefill_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
+    """Jit a prefill over LEFT- or RIGHT-padded prompts: explicit (B, S)
+    positions (pads carry position >= cache_len so their KV writes drop;
+    real tokens pack densely at 0..len-1 per row). Returns
+    (ragged_prefill_fn, cache_sh, batch_sh)."""
+    from deepspeed_tpu.models import transformer as tf
+
+    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+
+    def prefill(params, tokens, positions, cache):
+        zero = jnp.zeros((tokens.shape[0],), jnp.int32)
+        return tf.forward_with_cache(params, cfg, tokens, cache, zero, positions=positions)
+
+    fn = jax.jit(
+        prefill,
+        in_shardings=(param_shardings, batch_sh, batch_sh, cache_sh),
+        out_shardings=(batch_sh, cache_sh),
+        donate_argnums=(3,),
+    )
+    return fn, cache_sh, batch_sh
+
+
+def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_mask,
+                       cache, cache_len: int, max_new_tokens: int, temperature: float,
+                       top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
+    """Generate over a PADDED prompt batch (HF attention_mask semantics,
+    left or right padding): prefill once with per-row dense positions, then
+    per-row-position decode. Returns (B, S + max_new_tokens) — the prompt
+    region is returned as given (pads included); generated tokens follow.
+    """
+    import numpy as np
+
+    mask = np.asarray(attention_mask)
+    B, S = tokens.shape
+    if max_new_tokens <= 0:
+        return tokens
+    assert mask.shape == (B, S), (mask.shape, (B, S))
+    prompt_lens = mask.sum(axis=1).astype(np.int32)
+    assert (prompt_lens > 0).all(), "every row needs at least one real token"
+    # dense per-row positions; pads land at cache_len -> dropped writes
+    positions = np.where(mask > 0, np.cumsum(mask, axis=1) - 1, cache_len).astype(np.int32)
+    logits, cache = ragged_prefill_fn(params, jnp.asarray(tokens), jnp.asarray(positions), cache)
+    # logits column of each row's LAST real token
+    last_col = np.array([np.nonzero(mask[b])[0][-1] for b in range(B)])
+    last_logits = jnp.take_along_axis(
+        logits, jnp.asarray(last_col)[:, None, None], axis=1
+    )[:, 0]
+    nxt = select_token(last_logits, temperature, top_k, rng, top_p)
+    out = [nxt]
+    pos = jnp.asarray(prompt_lens)
+    for _ in range(max_new_tokens - 1):
+        rng, sub = jax.random.split(rng)
+        step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos)
+        out.append(select_token(step_logits[:, 0], temperature, top_k, sub, top_p))
+        pos = pos + 1
+    return jnp.concatenate([jnp.asarray(tokens), jnp.stack(out, axis=1)], axis=1)
+
+
 def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
     """Temperature / top-k / nucleus filtering over (B, V) logits. The ONE
     implementation shared by plain sampling (select_token) and the

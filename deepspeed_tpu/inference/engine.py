@@ -230,8 +230,13 @@ class InferenceEngine:
         eos_token_id: Optional[int] = None,
         draft: Optional["InferenceEngine"] = None,
         num_draft_tokens: Optional[int] = None,
+        attention_mask=None,
     ):
         """Greedy / temperature sampling with a compiled decode loop.
+
+        ``attention_mask`` ((B, S) of 0/1, HF semantics) enables ragged
+        prompts — left or right padding; pad slots never enter the KV
+        cache, and each row decodes from its own length.
 
         Passing ``draft`` (a second, smaller InferenceEngine on the same
         tokenizer/vocab) switches to lossless speculative decoding: the
@@ -240,15 +245,40 @@ class InferenceEngine:
         ``speculative.num_draft_tokens`` sets the default)."""
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = tokens.shape
-        total = S + max_new_tokens
+        # with a mask, capacity is governed by the longest REAL prompt, not
+        # the padded width (padding='max_length' batches are legal even at
+        # S == max_seq_len)
+        longest = int(np.asarray(attention_mask).sum(axis=1).max()) if attention_mask is not None else S
+        total = longest + max_new_tokens
         assert total <= self.cfg.max_seq_len, (
-            f"prompt {S} + {max_new_tokens} new > max_seq_len {self.cfg.max_seq_len}"
+            f"prompt {longest} + {max_new_tokens} new > max_seq_len {self.cfg.max_seq_len}"
         )
         # KV-cache allocation bounded by max_out_tokens (reference
         # inference/config.py max_out_tokens), grown only if the request needs it
         from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
 
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if attention_mask is not None:
+            if draft is not None or self.config.speculative.enabled:
+                raise NotImplementedError(
+                    "speculative decoding does not take attention_mask yet"
+                )
+            from deepspeed_tpu.inference.decoding import ragged_decode_loop
+
+            max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
+            prefill_fn, segment_fn, cache_sh = self._ragged_fns_for(B, max_len)
+            cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), cache_sh)
+            t0 = time.time()
+            result = ragged_decode_loop(
+                prefill_fn, segment_fn, self.params, tokens, attention_mask,
+                cache, max_len, max_new_tokens, temperature, top_k, rng, top_p,
+            )
+            if self.config.profile_model_time:
+                jax.block_until_ready(result)
+                self._model_times.append(time.time() - t0)
+            if eos_token_id is not None:
+                result = self._truncate_eos(result, S, eos_token_id)
+            return result
         if draft is None and self.config.speculative.enabled:
             draft = getattr(self, "_draft_engine", None)
             if draft is None:
@@ -283,6 +313,22 @@ class InferenceEngine:
         if eos_token_id is not None:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
+
+    def _ragged_fns_for(self, batch_size: int, max_len: int):
+        """(ragged_prefill_fn, segment_fn, cache_sharding) for attention_mask
+        generation, memoized per (B, cache_len) like _spec_fns."""
+        from deepspeed_tpu.inference.decoding import (
+            compile_ragged_prefill_fn, compile_segment_fn)
+
+        key = (batch_size, max_len)
+        if getattr(self, "_ragged_key", None) != key:
+            prefill_fn, cache_sh, _ = compile_ragged_prefill_fn(
+                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
+            segment_fn, _, _ = compile_segment_fn(
+                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
+            self._ragged_fns = (prefill_fn, segment_fn, cache_sh)
+            self._ragged_key = key
+        return self._ragged_fns
 
     def _spec_fns(self, batch_size: int, max_len: int):
         """(prefill_fn, segment_fn, cache_sharding) for speculative decoding.

@@ -928,15 +928,21 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     return _norm(x + mlp_out, ln2["scale"], ln2.get("bias"), cfg), k_cache, v_cache
 
 
-def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos):
+def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, positions=None):
     """Segment forward with KV cache (prefill: S = prompt len, pos = 0;
     decode: S = 1). ``pos`` may be a scalar (all rows aligned) or an (B,)
     vector of per-row depths (speculative decoding — rows advance by their
-    own accepted counts). Returns (logits (B,S,V), updated cache)."""
+    own accepted counts). ``positions`` (B, S) overrides the derived token
+    positions for RAGGED/padded prompts: pad slots carry position >= cache
+    length, so their KV writes drop out of bounds and real tokens pack
+    densely per row (requires vector ``pos``). Returns (logits (B,S,V),
+    updated cache)."""
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
-    if jnp.ndim(pos) == 1:
+    if positions is not None:
+        assert jnp.ndim(pos) == 1, "explicit positions require vector pos"
+    elif jnp.ndim(pos) == 1:
         positions = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # (B, S)
     else:
         positions = pos + jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))

@@ -527,3 +527,65 @@ class TestNewPolicies:
         params = jax.tree.map(jnp.asarray, params)
         ours = np.asarray(encode(params, cfg, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+class TestRaggedGenerate:
+    """attention_mask generate (HF semantics): padded rows must produce the
+    same continuations as each row generated alone, for both paddings."""
+
+    def _engine(self):
+        comm.destroy()
+        comm.init_distributed(mesh_shape={"data": -1}, verbose=False)
+        from deepspeed_tpu.inference.engine import init_inference
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                max_seq_len=128, dtype="float32")
+        return init_inference(TransformerModel(cfg), config={"dtype": "float32"})
+
+    @pytest.mark.parametrize("side", ["left", "right"])
+    def test_padding_parity(self, side):
+        eng = self._engine()
+        rs = np.random.RandomState(0)
+        lens = [5, 9, 3]
+        S = max(lens)
+        rows = [rs.randint(0, 128, (n,)).astype(np.int32) for n in lens]
+        toks = np.zeros((3, S), np.int32)
+        mask = np.zeros((3, S), np.float32)
+        for b, r in enumerate(rows):
+            if side == "left":
+                toks[b, S - lens[b]:] = r
+                mask[b, S - lens[b]:] = 1
+            else:
+                toks[b, :lens[b]] = r
+                mask[b, :lens[b]] = 1
+        out = np.asarray(eng.generate(toks, max_new_tokens=8, attention_mask=mask))
+        assert out.shape == (3, S + 8)
+        for b, r in enumerate(rows):
+            solo = np.asarray(eng.generate(r[None, :], max_new_tokens=8))
+            np.testing.assert_array_equal(out[b, S:], solo[0, lens[b]:],
+                                          err_msg=f"row {b} ({side} padding)")
+
+    def test_full_mask_matches_plain(self):
+        eng = self._engine()
+        rs = np.random.RandomState(1)
+        toks = rs.randint(0, 128, (2, 7)).astype(np.int32)
+        plain = np.asarray(eng.generate(toks, max_new_tokens=6))
+        ragged = np.asarray(eng.generate(toks, max_new_tokens=6,
+                                         attention_mask=np.ones((2, 7), np.float32)))
+        np.testing.assert_array_equal(plain, ragged)
+
+    def test_max_length_padding_allowed(self):
+        """padding='max_length' batches (padded width == max_seq_len) are
+        legal when the real prompts + new tokens fit."""
+        eng = self._engine()
+        S = eng.cfg.max_seq_len  # 128
+        toks = np.zeros((2, S), np.int32)
+        mask = np.zeros((2, S), np.float32)
+        rs = np.random.RandomState(2)
+        toks[0, S - 6:] = rs.randint(0, 128, 6)
+        mask[0, S - 6:] = 1
+        toks[1, S - 3:] = rs.randint(0, 128, 3)
+        mask[1, S - 3:] = 1
+        out = np.asarray(eng.generate(toks, max_new_tokens=4, attention_mask=mask))
+        assert out.shape == (2, S + 4)
