@@ -66,11 +66,22 @@ class Compressor:
                     # same guard as runtime/quantize.py: a leaf whose element
                     # count doesn't divide into the group count falls back to
                     # per-tensor (groups=1) instead of crashing at trace time
-                    fns.append(
-                        lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(
-                            w, b, s, ng if ng > 0 and w.size % ng == 0 else 1
+                    if bits == 1:
+                        # 1-bit -> XNOR binarization (reference BinaryQuantizer)
+                        fns.append(
+                            lambda w, ng=groups: ops.binary_quantize_ste(
+                                w, ng if ng > 0 and w.size % ng == 0 else 1))
+                    elif bits == 2:
+                        # 2-bit -> TWN ternarization (reference TernaryQuantizer)
+                        fns.append(
+                            lambda w, ng=groups: ops.ternary_quantize_ste(
+                                w, ng if ng > 0 and w.size % ng == 0 else 1))
+                    else:
+                        fns.append(
+                            lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(
+                                w, b, s, ng if ng > 0 and w.size % ng == 0 else 1
+                            )
                         )
-                    )
                     break
         if self._active(cfg.sparse_pruning):
             for g in cfg.sparse_pruning.groups():

@@ -57,6 +57,32 @@ def quantize_activation_ste(x: jnp.ndarray, bits: int = 8, symmetric: bool = Fal
     return ste(q, x)
 
 
+def binary_quantize_ste(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
+    """1-bit XNOR-style binarization with STE: per-group sign(w) scaled by
+    mean|w| (reference compression/basic_layer.py BinaryQuantizer)."""
+    orig_shape = w.shape
+    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    alpha = jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
+    q = jnp.sign(flat)
+    q = jnp.where(q == 0, jnp.ones_like(q), q) * alpha
+    return ste(q.reshape(orig_shape), w)
+
+
+def ternary_quantize_ste(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
+    """2-bit ternarization with STE: threshold 0.7·mean|w| per group, kept
+    weights collapse to ±mean of the kept magnitudes (reference
+    compression/basic_layer.py TernaryQuantizer, TWN-style)."""
+    orig_shape = w.shape
+    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    thresh = 0.7 * jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
+    keep = (jnp.abs(flat) > thresh).astype(flat.dtype)
+    kept_sum = jnp.sum(jnp.abs(flat) * keep, axis=1, keepdims=True)
+    kept_n = jnp.maximum(jnp.sum(keep, axis=1, keepdims=True), 1.0)
+    alpha = kept_sum / kept_n
+    q = jnp.sign(flat) * keep * alpha
+    return ste(q.reshape(orig_shape), w)
+
+
 # ---------------------------------------------------------------------------
 # pruning (reference: basic_layer SparsePruningMask / row / head)
 # ---------------------------------------------------------------------------

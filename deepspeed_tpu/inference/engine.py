@@ -314,40 +314,54 @@ class InferenceEngine:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
 
+    def _cached_fn(self, kind: str, key, builder):
+        """ONE single-slot memoization for every compiled-fn family on the
+        engine (plain decode, speculative, ragged) — the slots live in one
+        dict keyed by family name, so the pattern exists in one place."""
+        cache = getattr(self, "_fn_cache", None)
+        if cache is None:
+            cache = self._fn_cache = {}
+        slot = cache.get(kind)
+        if slot is None or slot[0] != key:
+            cache[kind] = (key, builder())
+        return cache[kind][1]
+
+    def _segment_fn(self, batch_size: int, max_len: int):
+        """Per-row-position segment forward, shared by the speculative and
+        ragged paths (any segment width retraces under the same wrapper)."""
+        from deepspeed_tpu.inference.decoding import compile_segment_fn
+
+        return self._cached_fn(
+            "segment", (batch_size, max_len),
+            lambda: compile_segment_fn(self.mesh, self.cfg, self.param_shardings,
+                                       batch_size, max_len)[0],
+        )
+
     def _ragged_fns_for(self, batch_size: int, max_len: int):
         """(ragged_prefill_fn, segment_fn, cache_sharding) for attention_mask
-        generation, memoized per (B, cache_len) like _spec_fns."""
-        from deepspeed_tpu.inference.decoding import (
-            compile_ragged_prefill_fn, compile_segment_fn)
+        generation."""
+        from deepspeed_tpu.inference.decoding import compile_ragged_prefill_fn
 
-        key = (batch_size, max_len)
-        if getattr(self, "_ragged_key", None) != key:
-            prefill_fn, cache_sh, _ = compile_ragged_prefill_fn(
-                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
-            segment_fn, _, _ = compile_segment_fn(
-                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
-            self._ragged_fns = (prefill_fn, segment_fn, cache_sh)
-            self._ragged_key = key
-        return self._ragged_fns
+        prefill_fn, cache_sh = self._cached_fn(
+            "ragged_prefill", (batch_size, max_len),
+            lambda: compile_ragged_prefill_fn(self.mesh, self.cfg, self.param_shardings,
+                                              batch_size, max_len)[:2],
+        )
+        return prefill_fn, self._segment_fn(batch_size, max_len), cache_sh
 
     def _spec_fns(self, batch_size: int, max_len: int):
         """(prefill_fn, segment_fn, cache_sharding) for speculative decoding.
-        Keyed by (B, cache_len) only — segment width retraces under the same
-        jit wrapper, so target (gamma+1-wide) and draft (1-wide) roles share
-        one compiled-fn cache even when one engine plays both (self-draft)."""
-        from deepspeed_tpu.inference.decoding import compile_decode_fns, compile_segment_fn
+        Keyed by (B, cache_len) only, so target (gamma+1-wide) and draft
+        (1-wide) roles share one compiled-fn cache even when one engine
+        plays both (self-draft)."""
+        from deepspeed_tpu.inference.decoding import compile_decode_fns
 
-        key = (batch_size, max_len)
-        if getattr(self, "_spec_cache_key", None) != key:
-            prefill_fn, _, cache_sh, _ = compile_decode_fns(
-                self.mesh, self.cfg, self.param_shardings, batch_size, max_len
-            )
-            segment_fn, _, _ = compile_segment_fn(
-                self.mesh, self.cfg, self.param_shardings, batch_size, max_len
-            )
-            self._spec_fns_cached = (prefill_fn, segment_fn, cache_sh)
-            self._spec_cache_key = key
-        return self._spec_fns_cached
+        prefill_fn, cache_sh = self._cached_fn(
+            "spec_prefill", (batch_size, max_len),
+            lambda: (lambda r: (r[0], r[2]))(compile_decode_fns(
+                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)),
+        )
+        return prefill_fn, self._segment_fn(batch_size, max_len), cache_sh
 
     def _generate_speculative(self, draft, tokens, max_new_tokens, temperature,
                               top_k, top_p, rng, gamma: int,

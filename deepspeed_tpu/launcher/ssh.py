@@ -1,0 +1,66 @@
+"""``dstpu_ssh`` — run a command on every host of a hostfile (reference:
+``bin/ds_ssh``, a pdsh wrapper). TPU-pod equivalent: iterate the hostfile
+(or a TPU pod's worker list via ``--workers host1,host2``) and fan the
+command out over ssh, streaming each host's output with a prefix."""
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import Dict, List
+
+
+def _hosts(args) -> List[str]:
+    if args.workers:
+        return [w for w in args.workers.split(",") if w]
+    from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+    table: Dict[str, int] = fetch_hostfile(args.hostfile)
+    return list(table.keys())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("dstpu_ssh", description="run a command on all hosts")
+    ap.add_argument("-H", "--hostfile", default="/job/hostfile")
+    ap.add_argument("--workers", default=None, help="comma-separated host list (overrides hostfile)")
+    ap.add_argument("--ssh-args", default="-o StrictHostKeyChecking=no", help="extra ssh options")
+    ap.add_argument("command", nargs=argparse.REMAINDER, help="command to run")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    # preserve the caller's tokenization on the remote shell (a quoted
+    # "python train.py" pattern must survive as one argument)
+    cmd = shlex.join(args.command)
+    hosts = _hosts(args)
+    if not hosts:
+        print("no hosts found", file=sys.stderr)
+        return 1
+    procs = {
+        h: subprocess.Popen(
+            ["ssh", *args.ssh_args.split(), h, cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for h in hosts
+    }
+    # stream all hosts concurrently, line-tagged
+    import threading
+
+    rcs = {}
+    lock = threading.Lock()
+
+    def pump(h, p):
+        for line in p.stdout or ():
+            with lock:
+                print(f"[{h}] {line.rstrip()}", flush=True)
+        rcs[h] = p.wait()
+
+    threads = [threading.Thread(target=pump, args=(h, p), daemon=True) for h, p in procs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return next((rc for rc in rcs.values() if rc), 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -261,3 +261,37 @@ class TestElasticity:
 
         with pytest.raises(ElasticityConfigError):
             compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_dstpu_ssh_fanout(tmp_path, monkeypatch):
+    """dstpu_ssh (reference bin/ds_ssh): fans the command over every
+    hostfile host via ssh subprocesses."""
+    import subprocess
+
+    from deepspeed_tpu.launcher import ssh as dssh
+
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("hostA slots=4\nhostB slots=4\n")
+    launched = []
+
+    class FakeProc:
+        returncode = 0
+        stdout = iter(["ok\n"])
+
+        def __init__(self, cmd, **kw):
+            launched.append(cmd)
+            self.stdout = iter(["ok\n"])
+
+        def wait(self):
+            return 0
+
+    monkeypatch.setattr(subprocess, "Popen", FakeProc)
+    rc = dssh.main(["-H", str(hostfile), "echo", "hi"])
+    assert rc == 0
+    assert len(launched) == 2
+    assert launched[0][0] == "ssh" and launched[0][-1] == "echo hi"
+    assert {c[-2] for c in launched} == {"hostA", "hostB"}
+
+    launched.clear()
+    rc = dssh.main(["--workers", "w1,w2,w3", "uptime"])
+    assert rc == 0 and len(launched) == 3

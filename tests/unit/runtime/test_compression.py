@@ -264,3 +264,62 @@ class TestEigenvalue:
         assert eig == pytest.approx(7.0, rel=1e-2)
         v = np.abs(np.asarray(vec["x"]))
         assert np.argmax(v) == 2
+
+
+class TestExtremeQuantizers:
+    """1/2-bit quantizers (reference basic_layer Binary/TernaryQuantizer)."""
+
+    def test_binary_values_and_grads(self):
+        from deepspeed_tpu.compression.ops import binary_quantize_ste
+
+        rs = np.random.RandomState(0)
+        w = jnp.asarray(rs.randn(8, 16), jnp.float32)
+        q = binary_quantize_ste(w)
+        alpha = float(jnp.mean(jnp.abs(w)))
+        vals = np.unique(np.round(np.abs(np.asarray(q)), 6))
+        assert len(vals) == 1 and abs(vals[0] - alpha) < 1e-5
+        assert np.array_equal(np.sign(np.asarray(q)), np.sign(np.asarray(w)))
+        # STE: gradient flows as identity
+        g = jax.grad(lambda x: jnp.sum(binary_quantize_ste(x) * 2.0))(w)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
+
+    def test_ternary_threshold_and_scale(self):
+        from deepspeed_tpu.compression.ops import ternary_quantize_ste
+
+        rs = np.random.RandomState(1)
+        w = jnp.asarray(rs.randn(128), jnp.float32)
+        q = np.asarray(ternary_quantize_ste(w))
+        thresh = 0.7 * float(jnp.mean(jnp.abs(w)))
+        wn = np.asarray(w)
+        assert (q[np.abs(wn) <= thresh] == 0).all()
+        kept = np.abs(wn) > thresh
+        alpha = np.abs(wn[kept]).mean()
+        np.testing.assert_allclose(np.abs(q[kept]), alpha, rtol=1e-5)
+        assert np.array_equal(np.sign(q[kept]), np.sign(wn[kept]))
+
+    def test_compress_routes_extreme_bits(self):
+        """bits 1/2 in the weight_quantization block route to the binary/
+        ternary quantizers through the compressor."""
+        from deepspeed_tpu.compression.compress import Compressor
+        from deepspeed_tpu.compression.config import CompressionConfig
+
+        w = jnp.asarray(np.random.RandomState(0).randn(2, 4, 4), jnp.float32)  # (L, in, out)
+        for bits, n_levels in ((1, 1), (2, 2)):
+            cfg = CompressionConfig.parse({
+                "compression_training": {
+                    "weight_quantization": {
+                        "different_groups": {
+                            "g": {"params": {"target_bits": bits}, "modules": ["attn"]}
+                        }
+                    }
+                }
+            })
+            out = np.asarray(
+                Compressor(cfg).transform_params({"layers": {"attn": {"wq": w}}})
+                ["layers"]["attn"]["wq"]
+            )
+            # binarized: one magnitude level; ternarized: zero + one level
+            mags = np.unique(np.round(np.abs(out[0]), 5))
+            assert len(mags[mags > 0]) == 1, (bits, mags)
+            if bits == 2:
+                assert (out == 0).any()

@@ -1,0 +1,29 @@
+"""Every examples/ script must run end-to-end in smoke mode (the reference
+keeps its examples out-of-repo in DeepSpeedExamples; here they ship and are
+CI-exercised)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+
+
+@pytest.mark.parametrize(
+    "script", ["train_gpt2.py", "bert_mlm.py", "inference_speculative.py", "rlhf_hybrid.py"]
+)
+def test_example_runs(script, tmp_path, monkeypatch):
+    from deepspeed_tpu import comm
+
+    comm.destroy()
+    monkeypatch.setenv("EXAMPLE_SMOKE", "1")
+    monkeypatch.setenv("EXAMPLE_CKPT", str(tmp_path / "ck"))
+    path = os.path.join(EXAMPLES, script)
+    argv = sys.argv
+    try:
+        sys.argv = [path]
+        runpy.run_path(path, run_name="__main__")
+    finally:
+        sys.argv = argv
