@@ -63,24 +63,23 @@ class Compressor:
                 if _match(path, g.modules):
                     bits, sym = g.bits, g.params.get("quantization_type", "symmetric") == "symmetric"
                     groups = int(g.params.get("quantize_groups", 1))
+
                     # same guard as runtime/quantize.py: a leaf whose element
                     # count doesn't divide into the group count falls back to
                     # per-tensor (groups=1) instead of crashing at trace time
+                    def safe_groups(w, ng=groups):
+                        return ng if ng > 0 and w.size % ng == 0 else 1
+
                     if bits == 1:
                         # 1-bit -> XNOR binarization (reference BinaryQuantizer)
-                        fns.append(
-                            lambda w, ng=groups: ops.binary_quantize_ste(
-                                w, ng if ng > 0 and w.size % ng == 0 else 1))
+                        fns.append(lambda w, sg=safe_groups: ops.binary_quantize_ste(w, sg(w)))
                     elif bits == 2:
                         # 2-bit -> TWN ternarization (reference TernaryQuantizer)
-                        fns.append(
-                            lambda w, ng=groups: ops.ternary_quantize_ste(
-                                w, ng if ng > 0 and w.size % ng == 0 else 1))
+                        fns.append(lambda w, sg=safe_groups: ops.ternary_quantize_ste(w, sg(w)))
                     else:
                         fns.append(
-                            lambda w, b=bits, s=sym, ng=groups: ops.quantize_weight_ste(
-                                w, b, s, ng if ng > 0 and w.size % ng == 0 else 1
-                            )
+                            lambda w, b=bits, s=sym, sg=safe_groups: ops.quantize_weight_ste(
+                                w, b, s, sg(w))
                         )
                     break
         if self._active(cfg.sparse_pruning):

@@ -32,7 +32,7 @@ def quantize_weight_ste(w: jnp.ndarray, bits: int = 8, symmetric: bool = True,
                         num_groups: int = 1) -> jnp.ndarray:
     """Groupwise fake-quant with STE (QAT weight path)."""
     orig_shape = w.shape
-    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    flat = _grouped(w, num_groups)
     if symmetric:
         scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / (2 ** (bits - 1) - 1)
         scale = jnp.maximum(scale, 1e-8)
@@ -57,11 +57,16 @@ def quantize_activation_ste(x: jnp.ndarray, bits: int = 8, symmetric: bool = Fal
     return ste(q, x)
 
 
+def _grouped(w: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """(num_groups, -1) view shared by every groupwise quantizer."""
+    return w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+
+
 def binary_quantize_ste(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
     """1-bit XNOR-style binarization with STE: per-group sign(w) scaled by
     mean|w| (reference compression/basic_layer.py BinaryQuantizer)."""
     orig_shape = w.shape
-    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    flat = _grouped(w, num_groups)
     alpha = jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
     q = jnp.sign(flat)
     q = jnp.where(q == 0, jnp.ones_like(q), q) * alpha
@@ -73,7 +78,7 @@ def ternary_quantize_ste(w: jnp.ndarray, num_groups: int = 1) -> jnp.ndarray:
     weights collapse to ±mean of the kept magnitudes (reference
     compression/basic_layer.py TernaryQuantizer, TWN-style)."""
     orig_shape = w.shape
-    flat = w.reshape(num_groups, -1) if num_groups > 1 else w.reshape(1, -1)
+    flat = _grouped(w, num_groups)
     thresh = 0.7 * jnp.mean(jnp.abs(flat), axis=1, keepdims=True)
     keep = (jnp.abs(flat) > thresh).astype(flat.dtype)
     kept_sum = jnp.sum(jnp.abs(flat) * keep, axis=1, keepdims=True)

@@ -314,17 +314,25 @@ class InferenceEngine:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
 
+    # a few entries per family: the speculative and ragged paths share the
+    # "segment" family but legitimately use different cache lengths (the
+    # spec path adds gamma+1 slack) — single-slot caching would recompile
+    # on every alternation between them
+    _FN_CACHE_SLOTS = 4
+
     def _cached_fn(self, kind: str, key, builder):
-        """ONE single-slot memoization for every compiled-fn family on the
+        """ONE bounded memoization for every compiled-fn family on the
         engine (plain decode, speculative, ragged) — the slots live in one
         dict keyed by family name, so the pattern exists in one place."""
         cache = getattr(self, "_fn_cache", None)
         if cache is None:
             cache = self._fn_cache = {}
-        slot = cache.get(kind)
-        if slot is None or slot[0] != key:
-            cache[kind] = (key, builder())
-        return cache[kind][1]
+        family = cache.setdefault(kind, {})
+        if key not in family:
+            if len(family) >= self._FN_CACHE_SLOTS:
+                family.pop(next(iter(family)))  # drop oldest (insertion order)
+            family[key] = builder()
+        return family[key]
 
     def _segment_fn(self, batch_size: int, max_len: int):
         """Per-row-position segment forward, shared by the speculative and

@@ -37,7 +37,7 @@ def main(argv=None) -> int:
         return 1
     procs = {
         h: subprocess.Popen(
-            ["ssh", *args.ssh_args.split(), h, cmd],
+            ["ssh", *shlex.split(args.ssh_args), h, cmd],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for h in hosts

@@ -874,6 +874,21 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
     q, k, v = _qkv(h, attn_p, cfg, positions)
 
+    # PREFILL fast path: pos is the literal int 0 only in the prefill
+    # program (compile_decode_fns traces with a Python 0), where attention
+    # over the segment is exactly causal self-attention — the Pallas flash
+    # kernel computes it without materializing the (B, H, S, T) logits
+    # (reference: the inference softmax_context kernel family)
+    use_flash_prefill = (
+        isinstance(pos, int) and pos == 0 and S > 1
+        and cfg.attn_impl == "pallas" and cfg.causal
+        and cfg.pos_embedding != "alibi"
+        # the kernel tiles the q/k sequence by min(128, S): any S under 128
+        # works (one block), past that only multiples of 128 — everything
+        # else stays on the einsum path rather than asserting at trace time
+        and (S < 128 or S % 128 == 0)
+    )
+
     if jnp.ndim(pos) == 0:
         k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
@@ -885,6 +900,15 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
         cols = positions  # (B, S) absolute positions of the new tokens
         k_cache = k_cache.at[rows, cols].set(k.astype(k_cache.dtype), mode="drop")
         v_cache = v_cache.at[rows, cols].set(v.astype(v_cache.dtype), mode="drop")
+
+    if use_flash_prefill:
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        attn_out = flash_attention(q, k, v, causal=True).reshape(B, S, nh * hd)
+        attn_out = _linear(attn_out, attn_p["wo"])
+        if cfg.use_bias:
+            attn_out = attn_out + attn_p["bo"]
+        return _finish_layer_cached(x, h, attn_out, layer_params, cfg, k_cache, v_cache)
 
     kk, vv = k_cache, v_cache
     if nkv != nh:
@@ -911,13 +935,21 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
+    return _finish_layer_cached(x, h, attn_out, layer_params, cfg, k_cache, v_cache)
+
+
+def _finish_layer_cached(x, h, attn_out, layer_params, cfg: TransformerConfig, k_cache, v_cache):
+    """Residual topology + MLP tail of a cached layer (shared by the einsum
+    and flash-prefill attention paths)."""
+    mlp_p = layer_params["mlp"]
+    ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
 
     if cfg.parallel_residual:
         h2 = h if cfg.shared_ln else _norm(x, ln2["scale"], ln2.get("bias"), cfg)
         mlp_out, _ = _mlp_block(h2, mlp_p, cfg, decode=True)
         return x + attn_out + mlp_out, k_cache, v_cache
 
-    if pre_ln:
+    if cfg.norm_position == "pre":
         x = x + attn_out
         h = _norm(x, ln2["scale"], ln2.get("bias"), cfg)
         mlp_out, _ = _mlp_block(h, mlp_p, cfg, decode=True)

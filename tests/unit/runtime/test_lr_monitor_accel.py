@@ -1,0 +1,171 @@
+"""Direct coverage for LR schedules, monitor writers, timers, comms logging,
+and the accelerator ABC (reference: tests/unit/runtime/test_lr_schedules.py,
+tests/unit/monitor/, tests/accelerator/ conformance)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+
+class TestLRSchedules:
+    def test_warmup_lr(self):
+        from deepspeed_tpu.runtime.lr_schedules import WarmupLR
+
+        s = WarmupLR(0.001, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                     warmup_num_steps=10, warmup_type="linear")
+        assert s.lr_at(0) == 0.0
+        assert s.lr_at(5) == pytest.approx(0.05)
+        assert s.lr_at(10) == pytest.approx(0.1)
+        assert s.lr_at(1000) == pytest.approx(0.1)  # flat after warmup
+
+    def test_warmup_decay_lr(self):
+        from deepspeed_tpu.runtime.lr_schedules import WarmupDecayLR
+
+        s = WarmupDecayLR(0.001, total_num_steps=110, warmup_max_lr=0.1,
+                          warmup_num_steps=10, warmup_type="linear")
+        assert s.lr_at(10) == pytest.approx(0.1)
+        assert s.lr_at(60) == pytest.approx(0.05)  # halfway through decay
+        assert s.lr_at(110) == pytest.approx(0.0)
+        assert s.lr_at(500) == pytest.approx(0.0)  # clamped
+
+    def test_cosine_annealing(self):
+        from deepspeed_tpu.runtime.lr_schedules import CosineAnnealing
+
+        s = CosineAnnealing(0.1, total_num_steps=100)
+        assert s.lr_at(0) == pytest.approx(0.1)
+        assert s.lr_at(50) == pytest.approx(0.05)
+        assert s.lr_at(100) == pytest.approx(0.0, abs=1e-9)
+
+    def test_lr_range_test(self):
+        from deepspeed_tpu.runtime.lr_schedules import LRRangeTest
+
+        s = LRRangeTest(0.001, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=10, lr_range_test_step_rate=1.0)
+        assert s.lr_at(0) == pytest.approx(0.01)
+        assert s.lr_at(10) == pytest.approx(0.02)  # continuous ramp
+        stair = LRRangeTest(0.001, lr_range_test_min_lr=0.01,
+                            lr_range_test_step_size=10, lr_range_test_step_rate=1.0,
+                            lr_range_test_staircase=True)
+        assert stair.lr_at(9) == pytest.approx(0.01)
+        assert stair.lr_at(10) == pytest.approx(0.02)
+
+    def test_one_cycle_lr_and_momentum(self):
+        from deepspeed_tpu.runtime.lr_schedules import OneCycle
+
+        s = OneCycle(0.001, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, decay_lr_rate=1.0, decay_step_size=10)
+        assert s.lr_at(0) == pytest.approx(0.01)
+        assert s.lr_at(10) == pytest.approx(0.1)   # peak
+        assert s.lr_at(20) == pytest.approx(0.01)  # back down
+        assert s.lr_at(30) < 0.01                   # post-cycle decay
+        assert s.mom_at(0) == pytest.approx(0.99)
+        assert s.mom_at(10) == pytest.approx(0.85)
+
+    def test_registry_and_state_dict(self):
+        from types import SimpleNamespace
+
+        from deepspeed_tpu.runtime.lr_schedules import create_lr_scheduler
+
+        cfg = SimpleNamespace(type="WarmupLR",
+                              params={"warmup_max_lr": 0.1, "warmup_num_steps": 5,
+                                      "warmup_type": "linear"})
+        s = create_lr_scheduler(cfg, base_lr=0.001)
+        for _ in range(3):
+            s.step()
+        sd = s.state_dict()
+        s2 = create_lr_scheduler(cfg, base_lr=0.001)
+        s2.load_state_dict(sd)
+        assert s2.get_lr() == s.get_lr()
+        assert create_lr_scheduler(None, 0.1) is None
+
+
+class TestMonitor:
+    def _config(self, tmp_path, tb=False, csv=True):
+        from types import SimpleNamespace
+
+        from deepspeed_tpu.runtime.config import CSVConfig, TensorboardConfig, WandbConfig
+
+        return SimpleNamespace(
+            tensorboard=TensorboardConfig(enabled=tb, output_path=str(tmp_path / "tb"),
+                                          job_name="job"),
+            csv_monitor=CSVConfig(enabled=csv, output_path=str(tmp_path / "csv"),
+                                  job_name="job"),
+            wandb=WandbConfig(enabled=False),
+        )
+
+    def test_csv_writer_rows(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        mon = MonitorMaster(self._config(tmp_path))
+        assert mon.enabled
+        mon.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.2, 2)])
+        fname = tmp_path / "csv" / "job" / "Train_loss.csv"
+        lines = fname.read_text().strip().splitlines()
+        assert lines[0] == "step,Train/loss"
+        assert lines[1] == "1,1.5" and lines[2] == "2,1.2"
+
+    def test_disabled_monitor_noops(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        mon = MonitorMaster(self._config(tmp_path, csv=False))
+        assert not mon.enabled
+        mon.write_events([("x", 1.0, 1)])  # must not raise
+        assert not (tmp_path / "csv").exists()
+
+
+class TestTimersAndCommsLogging:
+    def test_throughput_timer(self):
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+
+        t = ThroughputTimer(batch_size=4, start_step=0)
+        for _ in range(3):
+            t.start()
+            t.stop(global_step=True, report_speed=False)
+        assert t.global_step_count == 3
+        assert t.avg_samples_per_sec() > 0
+
+    def test_comms_logger_accounting(self):
+        import jax.numpy as jnp
+
+        from deepspeed_tpu.comm.comms_logging import CommsLogger, convert_size, get_msg_size
+
+        x = jnp.zeros((1024,), jnp.float32)
+        assert get_msg_size(x) == 4096
+        assert convert_size(4096) == "4.00 KB"
+        log = CommsLogger(verbose=False)
+        log.append("all_reduce", x, ("data",))
+        log.append("all_reduce", x, ("data",))
+        summary = log.summary()
+        assert summary["all_reduce"]["count"] == 2
+        assert summary["all_reduce"]["total_bytes"] == 8192
+
+
+class TestAcceleratorConformance:
+    """reference: tests/accelerator/ — the ABC surface every backend must
+    provide (SURVEY §1: the pluggable-accelerator seam)."""
+
+    def test_abc_surface(self):
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        acc = get_accelerator()
+        assert acc.device_count() >= 1
+        assert isinstance(acc.device_name(0), str) and acc.device_name(0)
+        assert isinstance(acc.communication_backend_name(), str)
+        # memory stats are integers (0 allowed on CPU backends)
+        assert acc.total_memory() >= 0
+        # profiler range push/pop must nest without error
+        acc.range_push("test")
+        acc.range_pop()
+        assert acc.is_available()
+
+    def test_set_accelerator_injection(self):
+        from deepspeed_tpu import accelerator as accel_mod
+
+        current = accel_mod.get_accelerator()
+        try:
+            accel_mod.set_accelerator(current)
+            assert accel_mod.get_accelerator() is current
+        finally:
+            accel_mod.set_accelerator(current)
