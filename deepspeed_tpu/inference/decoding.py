@@ -335,6 +335,49 @@ def speculative_decode_loop(
     return jnp.concatenate([tokens, jnp.asarray(gen)], axis=1)
 
 
+def cached_fn(holder, kind: str, key, builder, slots: int = 4):
+    """Bounded per-family memoization of compiled functions on ``holder``
+    (InferenceEngine and TpuHybridEngine share this; a long-running server
+    alternating shapes must not retain unbounded compiled programs)."""
+    cache = getattr(holder, "_fn_cache", None)
+    if cache is None:
+        cache = holder._fn_cache = {}
+    family = cache.setdefault(kind, {})
+    if key not in family:
+        if len(family) >= slots:
+            family.pop(next(iter(family)))  # drop oldest (insertion order)
+        family[key] = builder()
+    return family[key]
+
+
+def speculative_generate(cfg, params, draft, tokens, max_new_tokens: int,
+                         temperature: float, top_k: int, top_p: float, rng,
+                         gamma: int, max_out_tokens: Optional[int], get_fns,
+                         eos_token_id: Optional[int] = None) -> jnp.ndarray:
+    """Shared speculative-decoding orchestration (cache sizing with the
+    verify-round overrun slack, fn lookup, cache init, loop) for BOTH the
+    InferenceEngine and the RLHF hybrid engine. ``get_fns(B, cache_len) ->
+    (t_prefill, t_segment, cache_sh)`` supplies the target programs;
+    ``draft`` is an InferenceEngine providing its own via _spec_fns."""
+    from deepspeed_tpu.models import transformer as tf
+
+    assert draft.cfg.vocab_size == cfg.vocab_size, "draft must share the vocabulary"
+    assert gamma >= 1, f"num_draft_tokens must be >= 1, got {gamma}"
+    B, S = tokens.shape
+    total = S + max_new_tokens + gamma + 1  # verify-round overrun slack
+    cache_len = bounded_cache_len(total, max(cfg.max_seq_len, total), max_out_tokens)
+    t_prefill, t_segment, cache_sh = get_fns(B, cache_len)
+    d_prefill, d_decode, d_cache_sh = draft._spec_fns(B, cache_len)
+    cache_t = jax.device_put(tf.init_cache(cfg, B, cache_len), cache_sh)
+    cache_d = jax.device_put(tf.init_cache(draft.cfg, B, cache_len), d_cache_sh)
+    return speculative_decode_loop(
+        t_prefill, t_segment, d_prefill, d_decode,
+        params, draft.params, tokens, cache_t, cache_d,
+        max_new_tokens, gamma, temperature, top_k, top_p, rng,
+        eos_token_id=eos_token_id,
+    )
+
+
 def bounded_cache_len(total: int, max_seq_len: int, max_out_tokens: Optional[int]) -> int:
     """KV-cache allocation: bounded by max_out_tokens, grown when the request
     needs more, never past max_seq_len."""

@@ -314,25 +314,15 @@ class InferenceEngine:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
 
-    # a few entries per family: the speculative and ragged paths share the
-    # "segment" family but legitimately use different cache lengths (the
-    # spec path adds gamma+1 slack) — single-slot caching would recompile
-    # on every alternation between them
-    _FN_CACHE_SLOTS = 4
-
     def _cached_fn(self, kind: str, key, builder):
-        """ONE bounded memoization for every compiled-fn family on the
-        engine (plain decode, speculative, ragged) — the slots live in one
-        dict keyed by family name, so the pattern exists in one place."""
-        cache = getattr(self, "_fn_cache", None)
-        if cache is None:
-            cache = self._fn_cache = {}
-        family = cache.setdefault(kind, {})
-        if key not in family:
-            if len(family) >= self._FN_CACHE_SLOTS:
-                family.pop(next(iter(family)))  # drop oldest (insertion order)
-            family[key] = builder()
-        return family[key]
+        """Bounded memoization for every compiled-fn family on the engine
+        (plain decode, speculative, ragged) — decoding.cached_fn, shared
+        with the hybrid engine. Multiple slots matter: the speculative and
+        ragged paths share the "segment" family but legitimately use
+        different cache lengths (the spec path adds gamma+1 slack)."""
+        from deepspeed_tpu.inference.decoding import cached_fn
+
+        return cached_fn(self, kind, key, builder)
 
     def _segment_fn(self, batch_size: int, max_len: int):
         """Per-row-position segment forward, shared by the speculative and
@@ -374,26 +364,13 @@ class InferenceEngine:
     def _generate_speculative(self, draft, tokens, max_new_tokens, temperature,
                               top_k, top_p, rng, gamma: int,
                               eos_token_id: Optional[int] = None):
-        from deepspeed_tpu.inference.decoding import bounded_cache_len, speculative_decode_loop
+        from deepspeed_tpu.inference.decoding import speculative_generate
 
-        assert draft.cfg.vocab_size == self.cfg.vocab_size, (
-            "draft and target must share a vocabulary"
-        )
-        B, S = tokens.shape
-        # slack for the up-to-gamma overrun of the final verify round
-        total = S + max_new_tokens + gamma + 1
-        max_len = bounded_cache_len(total, max(self.cfg.max_seq_len, total),
-                                    self.config.max_out_tokens)
-        t_prefill, t_segment, t_cache_sh = self._spec_fns(B, max_len)
-        d_prefill, d_decode, d_cache_sh = draft._spec_fns(B, max_len)
-        cache_t = jax.device_put(tf.init_cache(self.cfg, B, max_len), t_cache_sh)
-        cache_d = jax.device_put(tf.init_cache(draft.cfg, B, max_len), d_cache_sh)
         t0 = time.time()
-        result = speculative_decode_loop(
-            t_prefill, t_segment, d_prefill, d_decode,
-            self.params, draft.params, tokens, cache_t, cache_d,
-            max_new_tokens, gamma, temperature, top_k, top_p, rng,
-            eos_token_id=eos_token_id,
+        result = speculative_generate(
+            self.cfg, self.params, draft, tokens, max_new_tokens, temperature,
+            top_k, top_p, rng, gamma, self.config.max_out_tokens,
+            get_fns=self._spec_fns, eos_token_id=eos_token_id,
         )
         if self.config.profile_model_time:
             jax.block_until_ready(result)

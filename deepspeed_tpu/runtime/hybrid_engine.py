@@ -136,12 +136,19 @@ class TpuHybridEngine(TpuEngine):
 
     # -- public generate surface ----------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0, rng: Optional[jax.Array] = None):
+                 top_k: int = 0, top_p: float = 1.0, rng: Optional[jax.Array] = None,
+                 draft=None, num_draft_tokens: int = 4):
         """Decode with the CURRENT training weights (reference generate :168).
 
         LoRA deltas are fused for the decode programs and the training
         params are left untouched (fuse produces a derived tree; no unfuse
         pass needed — the reference mutates in place, hence its pairing).
+
+        ``draft`` (an InferenceEngine on a smaller same-vocabulary model)
+        switches the rollout to lossless speculative decoding — RLHF
+        rollouts are decode-bound, so a cheap frozen draft multiplies
+        tokens/s while the verified outputs still follow the live policy's
+        distribution exactly.
         """
         tf, cfg = self._model_tf()
         from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
@@ -150,18 +157,44 @@ class TpuHybridEngine(TpuEngine):
         B, S = tokens.shape
         total = S + max_new_tokens
         assert total <= cfg.max_seq_len, f"{total} > max_seq_len {cfg.max_seq_len}"
+        rng = rng if rng is not None else self._next_rng()
+        params = self._lora_fused_params()
+        if draft is not None:
+            result = self._generate_speculative(
+                tf, cfg, params, draft, tokens, max_new_tokens, temperature,
+                top_k, top_p, rng, num_draft_tokens)
+            self._generate_calls += 1
+            return result
         cache_len = bounded_cache_len(total, cfg.max_seq_len, self.config.hybrid_engine.max_out_tokens)
         prefill_fn, decode_fn, cache_sh = self._ensure_generate_compiled(B, cache_len)
 
-        params = self._lora_fused_params()
         cache = jax.device_put(tf.init_cache(cfg, B, cache_len), cache_sh)
-        rng = rng if rng is not None else self._next_rng()
         result = decode_loop(
             prefill_fn, decode_fn, params, tokens, cache, max_new_tokens, temperature, top_k, rng,
             top_p=top_p
         )
         self._generate_calls += 1
         return result
+
+    def _generate_speculative(self, tf, cfg, params, draft, tokens, max_new_tokens,
+                              temperature, top_k, top_p, rng, gamma: int):
+        from deepspeed_tpu.inference.decoding import (
+            cached_fn, compile_segment_fn, speculative_generate)
+
+        def get_fns(B, cache_len):
+            prefill_fn, _, cache_sh = self._ensure_generate_compiled(B, cache_len)
+            t_segment = cached_fn(
+                self, "segment", (B, cache_len),
+                lambda: compile_segment_fn(self.mesh, cfg, self.param_shardings,
+                                           B, cache_len)[0],
+            )
+            return prefill_fn, t_segment, cache_sh
+
+        return speculative_generate(
+            cfg, params, draft, tokens, max_new_tokens, temperature, top_k,
+            top_p, rng, gamma, self.config.hybrid_engine.max_out_tokens,
+            get_fns=get_fns,
+        )
 
     def step(self, *args, **kwargs):
         out = super().step(*args, **kwargs)
