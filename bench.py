@@ -122,7 +122,31 @@ def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None
     return toks / n_dev, dt, float(loss), engine
 
 
-def bench_zero3_offload():
+def _transfer_bandwidth_probe(nbytes=1 << 27):
+    """Measured D2H + H2D bandwidth (bytes/s) through whatever link this
+    process has to the chip (direct PCIe/HBM or a remote relay). Used to
+    pre-size the offload bench instead of timing out (VERDICT r2 weak #3)."""
+    dev = jax.devices()[0]
+    x_host = np.zeros(nbytes // 4, np.float32)
+    x = jax.device_put(x_host, dev)
+    x.block_until_ready()
+    t0 = time.time()
+    _ = np.asarray(x)
+    d2h = nbytes / max(time.time() - t0, 1e-9)
+    t0 = time.time()
+    y = jax.device_put(x_host, dev)
+    y.block_until_ready()
+    h2d = nbytes / max(time.time() - t0, 1e-9)
+    return d2h, h2d
+
+
+def bench_zero3_offload(budget_s=240):
+    """ZeRO-3 + optimizer host offload (the max-params-per-chip story).
+
+    Re-sized per VERDICT r2 weak #3: GPT-2 ~760M (not 1.5B), 1 measured
+    iter, bf16 grad wire, and a bandwidth pre-probe that emits a
+    diagnostic skip line instead of burning the cap when the relay is too
+    slow for the transfer volume."""
     from deepspeed_tpu.models.transformer import TransformerModel
 
     seq, micro_bs = 1024, 1
@@ -131,24 +155,47 @@ def bench_zero3_offload():
         model = _smoke_model(seq, remat=True, remat_policy="nothing_saveable")
     else:
         model = TransformerModel.from_preset(
-            "gpt2-1.5b", dtype="bfloat16", remat=True, remat_policy="nothing_saveable", max_seq_len=seq
+            "gpt2-760m", dtype="bfloat16", remat=True, remat_policy="nothing_saveable", max_seq_len=seq
         )
+        # pre-probe: per step the offload path moves ~2 bytes/param D2H
+        # (bf16 grad wire) + ~2 bytes/param H2D (bf16 params back)
+        d2h, h2d = _transfer_bandwidth_probe()
+        n_params = model.cfg.num_params()
+        est_step = 2 * n_params / d2h + 2 * n_params / h2d
+        n_steps = 3  # warmup + 2 measured
+        compile_margin = 120.0
+        if est_step * n_steps + compile_margin > budget_s:
+            return {
+                "metric": "gpt2_760m_zero3_offload_skipped",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "extra": {
+                    "reason": "transfer bandwidth too low for budget",
+                    "d2h_gbps": round(d2h / 1e9, 2),
+                    "h2d_gbps": round(h2d / 1e9, 2),
+                    "est_step_s": round(est_step, 1),
+                    "budget_s": budget_s,
+                },
+            }
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {
             "stage": 3,
-            "offload_optimizer": {"device": "cpu"},
+            # bf16 grad wire: half the D2H bytes per step (the transfer is
+            # the offload bottleneck through a remote relay)
+            "offload_optimizer": {"device": "cpu", "wire_dtype": "bfloat16"},
         },
         "steps_per_print": 1000000,
         "mesh": {"data": -1},
     }
-    toks, dt, loss, engine = _train_bench(model, config, micro_bs, seq, iters=3)
+    toks, dt, loss, engine = _train_bench(model, config, micro_bs, seq, iters=2)
     n_params = model.cfg.num_params()
     mfu = toks * model.flops_per_token(seq) / peak_flops()
     return {
-        "metric": "gpt2_1.5b_zero3_offload_tokens_per_sec_per_chip",
+        "metric": "gpt2_760m_zero3_offload_tokens_per_sec_per_chip",
         "value": round(toks, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -397,30 +444,82 @@ def _gpt2_config(micro_bs):
     }
 
 
+_WINNER_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_winner.json")
+
+
+def _bench_digest():
+    """Cache-invalidation key: the probe winner is only valid for the code
+    that produced it — digest this file + the kernels/model the candidates
+    exercise, so any perf-relevant change re-probes."""
+    import hashlib
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("bench.py", "deepspeed_tpu/ops/pallas/flash_attention.py",
+                "deepspeed_tpu/models/transformer.py", "deepspeed_tpu/runtime/engine.py"):
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())
+    return h.hexdigest()[:16]
+
+
+def _cached_winner(device_kind):
+    try:
+        with open(_WINNER_CACHE) as f:
+            cache = json.load(f)
+        entry = cache.get(device_kind)
+        if entry and entry.get("digest") == _bench_digest():
+            return entry["attn"], entry["remat"], entry["bs"]
+    except Exception:
+        pass
+    return None
+
+
+def _save_winner(device_kind, attn, remat, bs):
+    try:
+        cache = {}
+        if os.path.exists(_WINNER_CACHE):
+            with open(_WINNER_CACHE) as f:
+                cache = json.load(f)
+        cache[device_kind] = {"attn": attn, "remat": remat, "bs": bs,
+                              "digest": _bench_digest()}
+        with open(_WINNER_CACHE, "w") as f:
+            json.dump(cache, f)
+    except Exception:
+        pass
+
+
 def bench_gpt2_train():
     """Headline bench, SELF-TUNING: unless DSTPU_BENCH_ATTN pins a config,
-    briefly probe the candidate attention/remat/micro-batch configs (PERF.md
+    briefly probe ≤3 candidate attention/remat/micro-batch configs (PERF.md
     sweep: attention softmax HBM traffic + the dots_saveable remat stash are
     the two dominant costs; the Pallas flash kernel removes both) and run
-    the full measurement on the winner. A failing candidate (e.g. OOM at
+    the full measurement on the winner. The winner is cached per device
+    kind in .bench_winner.json so later runs skip the probes entirely
+    (VERDICT r2 #1: bounded probe list). A failing candidate (e.g. OOM at
     no-remat) is skipped, so the bench always reports a number."""
     seq = 64 if _SMOKE else 1024
     pinned_attn = os.environ.get("DSTPU_BENCH_ATTN")
     pinned_remat = os.environ.get("DSTPU_BENCH_REMAT")
     pinned_bs = os.environ.get("DSTPU_BENCH_BS")
     default_bs = 2 if _SMOKE else 8
+    device_kind = jax.devices()[0].device_kind
+    cached = None if (pinned_attn or pinned_remat or pinned_bs or _SMOKE
+                      or os.environ.get("DSTPU_BENCH_NOCACHE") == "1") else _cached_winner(device_kind)
     if pinned_attn or pinned_remat or _SMOKE:
         # any explicit A/B pin disables self-tuning for that axis
         attn = pinned_attn or "xla"
         remat = (pinned_remat or "1") == "1"
         candidates = [(attn, remat, int(pinned_bs or default_bs))]
+    elif cached is not None:
+        candidates = [cached]
     else:
         candidates = [
             ("xla", True, 8),
-            ("pallas", True, 8),
             ("pallas", False, 8),   # flash frees the logits stash: no-remat may fit
             ("pallas", False, 16),
-            ("xla", True, 16),
         ]
         if pinned_bs:
             candidates = list(dict.fromkeys(
@@ -440,6 +539,12 @@ def bench_gpt2_train():
             probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = round(toks, 1)
             if best is None or toks > best[0]:
                 best = (toks, dt, loss, attn, remat, bs)
+        except _BenchTimeout:
+            # the PRIMARY deadline fired mid-probe: propagate so main()'s
+            # fallback path runs under a fresh alarm — swallowing it here
+            # would leave the rest of the probe sweep unbounded (the exact
+            # rc=124 failure mode this protocol exists to prevent)
+            raise
         except Exception as e:
             probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = f"{type(e).__name__}"[:40]
     assert best is not None, f"every bench candidate failed: {probes}"
@@ -448,6 +553,7 @@ def bench_gpt2_train():
         # full measurement on the winning config
         toks, dt, loss, _ = _train_bench(
             _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=20)
+        _save_winner(device_kind, attn, remat, bs)
 
     model = _gpt2_model(seq, attn, remat)
     mfu = toks * model.cfg.flops_per_token(seq) / peak_flops()
@@ -475,45 +581,99 @@ class _BenchTimeout(Exception):
     pass
 
 
-def main():
+def _run_with_alarm(fn, cap_s):
+    """Run fn under a SIGALRM deadline. Returns (result, None) or
+    (None, error_string). Caveat: SIGALRM is delivered at the next Python
+    bytecode boundary — it bounds slow multi-step loops (every train/decode
+    iteration returns to Python) but cannot interrupt one native call that
+    never returns (a truly stuck XLA compile); the driver's outer timeout
+    is the backstop for that."""
     import signal
 
-    which = os.environ.get("DSTPU_BENCH_CONFIGS", "all")
-    # bound each secondary so a slow one doesn't starve the PRIMARY metric
-    # the driver parses from the last line. Caveat: SIGALRM is delivered at
-    # the next Python bytecode boundary — it bounds slow multi-step loops
-    # (every train/decode iteration returns to Python) but cannot interrupt
-    # a single native call that never returns (a truly stuck XLA compile).
-    per_config_s = int(os.environ.get("DSTPU_BENCH_CONFIG_TIMEOUT", "600"))
-
     def _alarm(signum, frame):
-        raise _BenchTimeout(f"exceeded {per_config_s}s")
+        raise _BenchTimeout(f"exceeded {cap_s}s")
 
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(int(cap_s))
+    try:
+        return fn(), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"[:300]
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def main():
+    """Bench protocol (VERDICT r2 #1 — the bench must be un-killable):
+
+    1. The PRIMARY headline bench runs FIRST, under its own deadline, and
+       its JSON prints IMMEDIATELY — if the driver's global timeout kills
+       the process at any later point, the headline metric is already on
+       stdout.
+    2. Secondaries then run under one shared wall-clock budget, checked
+       between configs, each additionally capped (≤240 s default).
+    3. The primary line is RE-printed last (with the suite summary
+       attached) so a driver that parses only the final line still gets
+       the headline metric.
+    """
+    t_start = time.time()
+    which = os.environ.get("DSTPU_BENCH_CONFIGS", "all")
+    primary_cap = int(os.environ.get("DSTPU_BENCH_PRIMARY_TIMEOUT", "900"))
+    per_config_s = int(os.environ.get("DSTPU_BENCH_CONFIG_TIMEOUT", "240"))
+    total_budget = int(os.environ.get("DSTPU_BENCH_TOTAL_BUDGET", "2100"))
+
+    # ---- primary first, printed immediately -------------------------------
+    primary, err = _run_with_alarm(bench_gpt2_train, primary_cap)
+    if primary is None:
+        # fallback: single pinned fast config, few iters — always a number
+        def _fallback():
+            os.environ["DSTPU_BENCH_ATTN"] = "xla"
+            os.environ["DSTPU_BENCH_REMAT"] = "1"
+            try:
+                return bench_gpt2_train()
+            finally:
+                os.environ.pop("DSTPU_BENCH_ATTN", None)
+                os.environ.pop("DSTPU_BENCH_REMAT", None)
+
+        primary, err2 = _run_with_alarm(_fallback, 300)
+        if primary is not None:
+            primary["extra"]["self_tune_error"] = err
+        else:
+            primary = {
+                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+                "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
+                "extra": {"error": err, "fallback_error": err2},
+            }
+    print(json.dumps(primary), flush=True)
+
+    # ---- secondaries under a global budget --------------------------------
     suite = {}
     if which != "primary":
         for name, fn in (
-            ("zero3_offload", bench_zero3_offload),
-            ("moe_ep", bench_moe_ep),
             ("decode", bench_decode),
-            ("hybrid_rlhf", bench_hybrid_rlhf),
             ("bert_mlm", bench_bert_mlm),
+            ("moe_ep", bench_moe_ep),
+            ("hybrid_rlhf", bench_hybrid_rlhf),
+            ("zero3_offload", lambda: bench_zero3_offload(budget_s=per_config_s)),
         ):
-            old = signal.signal(signal.SIGALRM, _alarm)
-            signal.alarm(per_config_s)
-            try:
-                result = fn()
+            remaining = total_budget - (time.time() - t_start)
+            if remaining < 90:
+                print(json.dumps({"metric": f"bench_{name}_skipped",
+                                  "reason": f"global budget exhausted ({int(remaining)}s left)"}),
+                      flush=True)
+                continue
+            cap = min(per_config_s, remaining)
+            result, err = _run_with_alarm(fn, cap)
+            if result is not None:
                 print(json.dumps(result), flush=True)
                 suite[result["metric"]] = {"value": result["value"], "vs_baseline": result["vs_baseline"]}
-            except Exception as e:  # a broken secondary must not kill the headline bench
-                print(json.dumps({"metric": f"bench_{name}_error", "error": f"{type(e).__name__}: {e}"[:300]}),
-                      flush=True)
-            finally:
-                signal.alarm(0)
-                signal.signal(signal.SIGALRM, old)
+            else:  # a broken secondary must not kill the headline metric
+                print(json.dumps({"metric": f"bench_{name}_error", "error": err}), flush=True)
 
-    primary = bench_gpt2_train()
+    # ---- re-print primary last so last-line parsers see it ----------------
     if suite:
-        primary["extra"]["suite"] = suite
+        primary.setdefault("extra", {})["suite"] = suite
     print(json.dumps(primary), flush=True)
 
 

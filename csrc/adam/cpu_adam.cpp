@@ -16,7 +16,10 @@
 // C ABI (loaded via ctypes from deepspeed_tpu/ops/adam/cpu_adam.py):
 //   ds_adam_step(params, grads, exp_avg, exp_avg_sq, n,
 //                lr, beta1, beta2, eps, weight_decay, step, adamw_mode,
-//                bias_correction)
+//                bias_correction, grad_scale)
+// grad_scale multiplies each gradient element inline (fuses the host-side
+// loss-scale/accumulation divide + clip factor into the update kernel, so
+// the gradient buffer is read exactly once).
 // All buffers are float32, updated in place (params included).
 
 #include <algorithm>
@@ -65,7 +68,7 @@ extern "C" {
 void ds_adam_step(float* params, const float* grads, float* exp_avg,
                   float* exp_avg_sq, long long n, float lr, float beta1,
                   float beta2, float eps, float weight_decay, long long step,
-                  int adamw_mode, int bias_correction) {
+                  int adamw_mode, int bias_correction, float grad_scale) {
   float bc1 = 1.0f, bc2 = 1.0f;
   if (bias_correction) {
     bc1 = 1.0f - std::pow(beta1, (float)step);
@@ -76,13 +79,14 @@ void ds_adam_step(float* params, const float* grads, float* exp_avg,
   const float b1 = beta1, b2 = beta2;
   const float omb1 = 1.0f - beta1, omb2 = 1.0f - beta2;
   const float wd = weight_decay;
+  const float gs = grad_scale;
 
   if (adamw_mode) {
     // decoupled decay applied to params directly
     parallel_for(n, [=](long long lo, long long hi) {
 #pragma omp simd
       for (long long i = lo; i < hi; ++i) {
-        float g = grads[i];
+        float g = grads[i] * gs;
         float m = b1 * exp_avg[i] + omb1 * g;
         float v = b2 * exp_avg_sq[i] + omb2 * g * g;
         exp_avg[i] = m;
@@ -98,7 +102,7 @@ void ds_adam_step(float* params, const float* grads, float* exp_avg,
     parallel_for(n, [=](long long lo, long long hi) {
 #pragma omp simd
       for (long long i = lo; i < hi; ++i) {
-        float g = grads[i];
+        float g = grads[i] * gs;
         if (wd > 0.0f) g += wd * params[i];
         float m = b1 * exp_avg[i] + omb1 * g;
         float v = b2 * exp_avg_sq[i] + omb2 * g * g;

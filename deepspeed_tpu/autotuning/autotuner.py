@@ -189,7 +189,12 @@ def autotune_config(model_cfg, ds_config: Dict[str, Any], n_devices: int,
         # candidate (larger micro-batch, then lower stage, then fewer
         # tensor splits = less per-layer comm)
         sp = max(1, mesh.get("sequence", 1))
-        reserved = sp * max(1, mesh.get("pipe", 1)) * max(1, mesh.get("expert", 1))
+        # a user-pinned data axis is reserved too — otherwise the chosen
+        # fsdp×tensor product can oversubscribe the device count and fail
+        # later at mesh build instead of tuning within the remaining budget
+        data_pin = mesh.get("data", 1)
+        reserved = (sp * max(1, mesh.get("pipe", 1)) * max(1, mesh.get("expert", 1))
+                    * max(1, data_pin if isinstance(data_pin, int) and data_pin > 0 else 1))
         n_free = max(1, n_devices // reserved)
         best_shape, best_key = None, None
         for shape in mesh_shape_candidates(n_free):

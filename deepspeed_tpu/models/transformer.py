@@ -71,6 +71,11 @@ class TransformerConfig:
     type_vocab_size: int = 0  # token-type-embedding vocab (BERT; 0 = off)
     embed_norm: bool = False  # LayerNorm over summed embeddings (BERT, BLOOM)
     lm_head_bias: bool = False  # untied lm head carries a bias (GPT-J)
+    attn_scale: Optional[float] = None  # None => 1/sqrt(head_dim); GPT-Neo uses 1.0
+    # per-layer local-attention windows (GPT-Neo global/local alternation:
+    # 0 = global, W = attend only the last W positions). Tuple of
+    # num_layers ints; None = all-global.
+    local_attn_windows: Optional[tuple] = None
     # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -151,6 +156,7 @@ class TransformerConfig:
 PRESETS = {
     "gpt2-125m": dict(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12, max_seq_len=1024),
     "gpt2-350m": dict(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16, max_seq_len=1024),
+    "gpt2-760m": dict(vocab_size=50257, hidden_size=1280, num_layers=36, num_heads=20, max_seq_len=1024),
     "gpt2-1.5b": dict(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25, max_seq_len=1024),
     "llama2-7b": dict(
         vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32, num_kv_heads=32,
@@ -345,6 +351,10 @@ def logical_specs(params, cfg: TransformerConfig):
             return ("seq", "embed") if last == "pos" else (None, "embed")
         if "lm_head" in names:
             return ("embed", "vocab") if last == "w" else ("vocab",)
+        if "mlm_head" in names:
+            table = {"w": ("embed", None), "b": (None,), "ln_scale": ("norm",),
+                     "ln_bias": ("norm",), "proj_bias": ("vocab",)}
+            return table[last]
         return tuple(None for _ in leaf.shape)
 
     return jax.tree_util.tree_map_with_path(annotate, params)
@@ -368,32 +378,15 @@ def _norm(x, scale, bias, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
-def _rope(x, positions, theta: float, rot_dim: Optional[int] = None, interleaved: bool = False):
-    """Rotary embedding (reference analogue:
-    csrc/transformer/inference apply_rotary_pos_emb.cu).
-
-    ``rot_dim`` rotates only the first rot_dim dims of each head (GPT-J /
-    GPT-NeoX partial rotary); ``interleaved`` pairs even/odd dims (GPT-J)
-    instead of first/second half (llama / NeoX)."""
-    B, S, H, hd = x.shape
-    rd = hd if rot_dim is None else rot_dim
-    rot, rest = x[..., :rd], x[..., rd:]
-    half = rd // 2
-    freqs = jnp.exp(-math.log(theta) * jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # B,S,half
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
-    if interleaved:
-        x1, x2 = rot[..., 0::2], rot[..., 1::2]
-        r1 = x1 * cos - x2 * sin
-        r2 = x2 * cos + x1 * sin
-        out = jnp.stack([r1, r2], axis=-1).reshape(rot.shape)
-    else:
-        x1, x2 = rot[..., :half], rot[..., half:]
-        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    if rd < hd:
-        out = jnp.concatenate([out, rest.astype(out.dtype)], axis=-1)
-    return out.astype(x.dtype)
+# rotary embedding: the op-registry surface IS the implementation
+# (ops/transformer/inference_ops.apply_rotary_pos_emb; reference analogue
+# csrc/transformer/inference apply_rotary_pos_emb.cu)
+from deepspeed_tpu.ops.transformer.fused_ops import fused_softmax  # noqa: E402
+from deepspeed_tpu.ops.transformer.inference_ops import (  # noqa: E402
+    apply_rotary_pos_emb as _rope,
+    softmax_context,
+    update_kv_cache,
+)
 
 
 def _alibi_slopes(n_heads: int) -> jnp.ndarray:
@@ -436,11 +429,13 @@ def _sparse_layout(sparse_attention: tuple, num_heads: int, seq_len: int):
     return config.make_layout(seq_len), config.block
 
 
-def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
+def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     """Causal multi-head / grouped-query attention.
 
     xla impl: einsum softmax einsum (fp32 logits). pallas impl: flash kernel
-    (ops/pallas/flash_attention.py) once available.
+    (ops/pallas/flash_attention.py) once available. ``window`` (traced i32
+    scalar; 0 = unlimited) restricts each query to the last ``window``
+    positions — the GPT-Neo local-attention layers.
     """
     B, S, nh, hd = q.shape
     nkv = k.shape[2]
@@ -455,7 +450,7 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
             return sequence_parallel_attention(
                 q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh, attn_impl=cfg.attn_impl
             )
-    if cfg.attn_impl == "block_sparse":
+    if window is None and cfg.attn_impl == "block_sparse":
         # layout-aware Pallas kernel: long-sequence training/prefill path
         # (reference SparseSelfAttention; decode stays dense — the KV-cache
         # loop attends a single query row)
@@ -469,23 +464,30 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions):
         layout, block = _sparse_layout(cfg.sparse_attention or (("mode", "fixed"),), nh, S)
         # kernel convention matches the model: (B, S, H, hd)
         return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block)
-    if cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
+    if window is None and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=cfg.causal)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
-    scale = 1.0 / math.sqrt(hd)
+    scale = cfg.attn_scale if cfg.attn_scale is not None else 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if cfg.pos_embedding == "alibi":
         pos = jnp.arange(S, dtype=jnp.float32)
         rel = pos[None, :] - pos[:, None]  # (q, k): negative into the past
         logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
+    mask = None
     if cfg.causal:
-        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        logits = jnp.where(causal[None, None, :, :], logits, jnp.float32(-1e30))
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    if window is not None:
+        qp = jnp.arange(S, dtype=jnp.int32)[:, None]
+        kp = jnp.arange(S, dtype=jnp.int32)[None, :]
+        local_ok = (qp - kp < window) | (window <= 0)
+        mask = local_ok if mask is None else mask & local_ok
+    if mask is not None:
+        logits = jnp.where(mask[None, None, :, :], logits, jnp.float32(-1e30))
+    probs = fused_softmax(logits).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -590,7 +592,8 @@ def _qkv(h, attn_p, cfg: TransformerConfig, positions):
     return q, k, v
 
 
-def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng):
+def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng,
+                window=None):
     """One decoder layer; shapes: x (B,S,D), layer_params leaves unstacked.
 
     Residual topologies: pre-LN (GPT-2/llama), post-LN (BERT / OPT-350m
@@ -612,7 +615,7 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng)
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
     h = maybe_quant(h)
     q, k, v = _qkv(h, attn_p, cfg, positions)
-    attn_out = _attention(q, k, v, cfg, positions).reshape(B, S, nh * hd)
+    attn_out = _attention(q, k, v, cfg, positions, window=window).reshape(B, S, nh * hd)
     attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
@@ -672,7 +675,7 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     )
     pld_on = cfg.pld_enabled and pld_theta is not None and dropout_rng is not None
 
-    def layer_with_routing(x_in, layer_p, rng, layer_frac):
+    def layer_with_routing(x_in, layer_p, rng, layer_frac, window=None):
         """One layer + data-efficiency wrappers (LTD token subset, PLD skip)."""
         r_drop = r_ltd = r_pld = None
         if rng is not None:
@@ -687,10 +690,12 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
             idx = random_keep_indices(r_ltd, B, S, int(ltd_keep_len))
             x_k = gather_tokens(x_in, idx)
             pos_k = jnp.take_along_axis(positions, idx, axis=1)
-            new_k, aux = _layer_body(x_k, layer_p, cfg=cfg, positions=pos_k, dropout_rng=r_drop)
+            new_k, aux = _layer_body(x_k, layer_p, cfg=cfg, positions=pos_k,
+                                     dropout_rng=r_drop, window=window)
             new_x = scatter_tokens(x_in, new_k, idx)
         else:
-            new_x, aux = _layer_body(x_in, layer_p, cfg=cfg, positions=positions, dropout_rng=r_drop)
+            new_x, aux = _layer_body(x_in, layer_p, cfg=cfg, positions=positions,
+                                     dropout_rng=r_drop, window=window)
         if pld_on:
             p_keep = 1.0 - layer_frac * (1.0 - jnp.float32(pld_theta))
             keep = jax.random.bernoulli(r_pld, p_keep)
@@ -714,34 +719,58 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
         else:
             layer_rngs = jnp.zeros((L, 2), jnp.uint32)
 
+        windows = (
+            jnp.asarray(cfg.local_attn_windows, jnp.int32)
+            if cfg.local_attn_windows is not None else jnp.zeros((L,), jnp.int32)
+        )
+
         def scan_step(carry, inp):
-            layer_p, rng, frac = inp
+            layer_p, rng, frac, win = inp
             rng = rng if needs_rng else None
-            new_x, aux = layer_fn(carry, layer_p, rng, frac)
+            win = win if cfg.local_attn_windows is not None else None
+            new_x, aux = layer_fn(carry, layer_p, rng, frac, win)
             return new_x, aux
 
-        x, auxs = jax.lax.scan(scan_step, x, (layers, layer_rngs, layer_fracs))
+        x, auxs = jax.lax.scan(scan_step, x, (layers, layer_rngs, layer_fracs, windows))
         aux_total = jnp.sum(auxs)
     else:
         aux_total = jnp.float32(0.0)
         for i in range(L):
             layer_p = jax.tree.map(lambda p: p[i], layers)
             rng = jax.random.fold_in(dropout_rng, i) if needs_rng else None
-            x, aux = layer_fn(x, layer_p, rng, layer_fracs[i])
+            win = (jnp.int32(cfg.local_attn_windows[i])
+                   if cfg.local_attn_windows is not None else None)
+            x, aux = layer_fn(x, layer_p, rng, layer_fracs[i], win)
             aux_total = aux_total + aux
 
     if cfg.norm_position == "pre":  # post-LN stacks end normalized already
         x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
     if return_hidden:
         return x, aux_total
+    return _vocab_head(x, params, cfg, dtype), aux_total
+
+
+def _vocab_head(x, params, cfg: TransformerConfig, dtype):
+    """Hidden states -> vocab logits.
+
+    An optional ``mlm_head`` in params (BERT ``cls.predictions.transform``
+    / DistilBERT ``vocab_transform``+``vocab_layer_norm``: dense + act +
+    LayerNorm, then a decoder bias) runs before the tied or untied
+    projection — MLM checkpoints deviate from HF numerics without it."""
+    mh = params.get("mlm_head")
+    if mh is not None:
+        x = _dense_act(cfg)(x @ mh["w"].astype(dtype) + mh["b"].astype(dtype))
+        x = _norm(x, mh["ln_scale"], mh.get("ln_bias"), cfg)
     if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
+        logits = jnp.einsum("...sd,vd->...sv", x, params["embed"]["tok"].astype(dtype))
     else:
         w = params["lm_head"]["w"]
         logits = _linear(x, w if isinstance(w, dict) else w.astype(dtype))
         if "b" in params.get("lm_head", {}):
             logits = logits + params["lm_head"]["b"].astype(dtype)
-    return logits, aux_total
+    if mh is not None and "proj_bias" in mh:
+        logits = logits + mh["proj_bias"].astype(dtype)
+    return logits
 
 
 def apply(params, cfg: TransformerConfig, tokens, dropout_rng=None, token_types=None):
@@ -780,10 +809,14 @@ def embed_fwd(params, cfg: TransformerConfig, tokens):
     return x
 
 
-def layer_slice_fwd(layers_slice, cfg: TransformerConfig, x):
+def layer_slice_fwd(layers_slice, cfg: TransformerConfig, x, windows=None):
     """Run a contiguous group of decoder layers (stacked leaves, leading dim
     = group size). Returns (x', moe_aux_sum). No dropout in the streaming
-    path (offload training runs at scales where dropout is off)."""
+    path (offload training runs at scales where dropout is off).
+
+    ``windows`` — (group_size,) i32 per-layer local-attention windows for
+    models with cfg.local_attn_windows (GPT-Neo); the caller slices the
+    global tuple to this group's [lo:hi) rows. None = all-global."""
     B, S, D = x.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     layer_fn = partial(_layer_body, cfg=cfg, positions=positions, dropout_rng=None)
@@ -792,11 +825,21 @@ def layer_slice_fwd(layers_slice, cfg: TransformerConfig, x):
     dtype = cfg.jnp_dtype
     layers = _cast_layers(layers_slice, dtype)
 
-    def scan_step(carry, layer_p):
-        new_x, aux = layer_fn(carry, layer_p)
+    n = jax.tree.leaves(layers_slice)[0].shape[0]
+    if windows is None and cfg.local_attn_windows is not None:
+        raise ValueError(
+            "cfg.local_attn_windows is set: layer_slice_fwd needs this "
+            "group's per-layer windows (pass windows=cfg.local_attn_windows[lo:hi])"
+        )
+    wins = windows if windows is not None else jnp.zeros((n,), jnp.int32)
+
+    def scan_step(carry, inp):
+        layer_p, win = inp
+        win = win if windows is not None else None
+        new_x, aux = layer_fn(carry, layer_p, window=win)
         return new_x, aux
 
-    x, auxs = jax.lax.scan(scan_step, x, layers)
+    x, auxs = jax.lax.scan(scan_step, x, (layers, wins))
     return x, jnp.sum(auxs)
 
 
@@ -833,10 +876,7 @@ def head_loss_fwd(params, cfg: TransformerConfig, x, batch, denom=None):
     dtype = cfg.jnp_dtype
     if cfg.norm_position == "pre":
         x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("...sd,vd->...sv", x, params["embed"]["tok"].astype(dtype))
-    else:
-        logits = jnp.einsum("...sd,dv->...sv", x, params["lm_head"]["w"].astype(dtype))
+    logits = _vocab_head(x, params, cfg, dtype)
     return _ce_from_logits(logits, batch, batch["input_ids"], denom=denom)
 
 
@@ -855,7 +895,8 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] =
     }
 
 
-def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos):
+def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos,
+                       window=None):
     """One decoder layer over a segment of S new tokens with KV cache.
 
     x: (B, S, D); k_cache/v_cache: (B, T, nkv, hd) for THIS layer; pos: the
@@ -867,8 +908,7 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
     ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
     B, S, D = x.shape
-    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-    T = k_cache.shape[1]
+    nh, hd = cfg.num_heads, cfg.head_dim
 
     pre_ln = cfg.norm_position == "pre"
     h = _norm(x, ln1["scale"], ln1.get("bias"), cfg) if pre_ln else x
@@ -881,6 +921,7 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     # (reference: the inference softmax_context kernel family)
     use_flash_prefill = (
         isinstance(pos, int) and pos == 0 and S > 1
+        and window is None
         and cfg.attn_impl == "pallas" and cfg.causal
         and cfg.pos_embedding != "alibi"
         # the kernel tiles the q/k sequence by min(128, S): any S under 128
@@ -889,17 +930,7 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
         and (S < 128 or S % 128 == 0)
     )
 
-    if jnp.ndim(pos) == 0:
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
-    else:
-        # per-row offsets: scatter each row's S new entries at its own pos
-        # (out-of-bounds writes past T are dropped, matching the clamped
-        # read mask below)
-        rows = jnp.arange(B, dtype=jnp.int32)[:, None]
-        cols = positions  # (B, S) absolute positions of the new tokens
-        k_cache = k_cache.at[rows, cols].set(k.astype(k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[rows, cols].set(v.astype(v_cache.dtype), mode="drop")
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos, positions)
 
     if use_flash_prefill:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
@@ -910,28 +941,11 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
             attn_out = attn_out + attn_p["bo"]
         return _finish_layer_cached(x, h, attn_out, layer_params, cfg, k_cache, v_cache)
 
-    kk, vv = k_cache, v_cache
-    if nkv != nh:
-        kk = jnp.repeat(kk, nh // nkv, axis=2)
-        vv = jnp.repeat(vv, nh // nkv, axis=2)
-    scale = 1.0 / math.sqrt(hd)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale  # (B,nh,S,T)
-    kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
-    if jnp.ndim(pos) == 0:
-        qpos = positions[0][:, None]  # (S, 1): absolute positions of new tokens
-        if cfg.pos_embedding == "alibi":
-            rel = kpos.astype(jnp.float32) - qpos.astype(jnp.float32)  # (S, T)
-            logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[None, None]
-        mask = (kpos <= qpos)[None, None]  # attend up to and incl. self
-    else:
-        qpos = positions[:, :, None]  # (B, S, 1) per-row positions
-        if cfg.pos_embedding == "alibi":
-            rel = kpos[None].astype(jnp.float32) - qpos.astype(jnp.float32)  # (B, S, T)
-            logits = logits + _alibi_slopes(nh)[None, :, None, None] * rel[:, None]
-        mask = (kpos[None] <= qpos)[:, None]  # (B, 1, S, T)
-    logits = jnp.where(mask, logits, jnp.float32(-1e30))
-    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-    attn_out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv).reshape(B, S, nh * hd)
+    slopes = _alibi_slopes(nh) if cfg.pos_embedding == "alibi" else None
+    attn_out = softmax_context(
+        q, k_cache, v_cache, pos, scale=cfg.attn_scale, positions=positions,
+        alibi_slopes=slopes, local_window=window,
+    ).reshape(B, S, nh * hd)
     attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
         attn_out = attn_out + attn_p["bo"]
@@ -992,23 +1006,23 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, posit
 
     layers = _cast_layers(params["layers"], dtype)
 
+    windows = (
+        jnp.asarray(cfg.local_attn_windows, jnp.int32)
+        if cfg.local_attn_windows is not None
+        else jnp.zeros((cfg.num_layers,), jnp.int32)
+    )
+
     def body(carry, inp):
         h = carry
-        layer_p, k_c, v_c = inp
-        h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos)
+        layer_p, k_c, v_c, win = inp
+        win = win if cfg.local_attn_windows is not None else None
+        h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos, window=win)
         return h, (k_c, v_c)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"], windows))
     if cfg.norm_position == "pre":
         x = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"), cfg)
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"]["tok"].astype(dtype))
-    else:
-        w = params["lm_head"]["w"]
-        logits = _linear(x, w if isinstance(w, dict) else w.astype(dtype))
-        if "b" in params.get("lm_head", {}):
-            logits = logits + params["lm_head"]["b"].astype(dtype)
-    return logits, {"k": new_k, "v": new_v}
+    return _vocab_head(x, params, cfg, dtype), {"k": new_k, "v": new_v}
 
 
 def loss_fn(params, cfg: TransformerConfig, batch, rng=None, ltd_keep_len=None, pld_theta=None):

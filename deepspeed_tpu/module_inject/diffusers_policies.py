@@ -1,0 +1,167 @@
+"""UNet / VAE (diffusers) injection policies.
+
+TPU-native counterpart of the reference's diffusers injection
+(``module_inject/replace_policy.py`` UNetPolicy/VAEPolicy +
+``model_implementations/diffusers/unet.py``/``vae.py``): the reference swaps
+fused CUDA attention kernels into every ``BasicTransformerBlock`` of an HF
+``UNet2DConditionModel`` and wraps the module in a CUDA-graph replayer. The
+TPU analogue maps the same state-dict weights onto the jitted functional
+blocks in ``ops/transformer/diffusers_attention.py`` (self-attn, cross-attn,
+GEGLU; VAE group-norm attention over the spatial op surface) — jit playback
+replaces CUDA-graph playback.
+
+Works from a raw ``state_dict`` (numpy/torch tensors) keyed with diffusers'
+names, so it does not require the ``diffusers`` package:
+
+  UNet blocks:  <path>.transformer_blocks.<i>.{attn1,attn2}.to_{q,k,v}.weight,
+                ....to_out.0.{weight,bias}, .norm{1,2,3}.{weight,bias},
+                .ff.net.0.proj.{weight,bias}, .ff.net.2.{weight,bias}
+  VAE mid attn: <path>.mid_block.attentions.0.{group_norm,to_q,to_k,to_v,
+                to_out.0}.{weight,bias}
+"""
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.ops.transformer.diffusers_attention import (
+    DiffusersAttentionConfig,
+    DiffusersBlockConfig,
+    apply_transformer_block,
+    apply_vae_attention,
+)
+
+
+def _np(t):
+    if isinstance(t, np.ndarray):
+        return np.asarray(t, np.float32)
+    return t.detach().cpu().numpy().astype(np.float32)  # torch tensor
+
+
+class UNetPolicy:
+    """Maps every ``transformer_blocks`` entry of a UNet2DConditionModel
+    state dict onto ``DiffusersBlockConfig`` params (reference:
+    replace_policy.py UNetPolicy / containers' attention surgery)."""
+
+    ARCHITECTURES = ("UNet2DConditionModel", "unet")
+
+    _BLOCK_RE = re.compile(r"^(.*transformer_blocks\.\d+)\.attn1\.to_q\.weight$")
+
+    @classmethod
+    def match(cls, name: str) -> bool:
+        return name in cls.ARCHITECTURES
+
+    @staticmethod
+    def block_paths(state: Dict) -> List[str]:
+        paths = [
+            m.group(1) for k in state if (m := UNetPolicy._BLOCK_RE.match(k)) is not None
+        ]
+        return sorted(paths)
+
+    @staticmethod
+    def convert_block(state: Dict, path: str, num_heads: int,
+                      dtype: str = "float32", attn_impl: str = "xla",
+                      ) -> Tuple[DiffusersBlockConfig, Dict]:
+        g = lambda name: _np(state[f"{path}.{name}"])
+        C = g("attn1.to_q.weight").shape[1]
+        ctx_dim = g("attn2.to_k.weight").shape[1]
+        ff2 = g("ff.net.2.weight")  # torch (C, F)
+        cfg = DiffusersBlockConfig(
+            channels=C, context_dim=ctx_dim, num_heads=num_heads,
+            ff_mult=ff2.shape[1] // C, dtype=dtype, attn_impl=attn_impl,
+        )
+
+        def attn(prefix):
+            return {
+                "wq": g(f"{prefix}.to_q.weight").T,
+                "wk": g(f"{prefix}.to_k.weight").T,
+                "wv": g(f"{prefix}.to_v.weight").T,
+                "wo": g(f"{prefix}.to_out.0.weight").T,
+                "bo": g(f"{prefix}.to_out.0.bias"),
+            }
+
+        ln = lambda n: {"scale": g(f"{n}.weight"), "bias": g(f"{n}.bias")}
+        params = {
+            "attn1": attn("attn1"),
+            "attn2": attn("attn2"),
+            "ln1": ln("norm1"),
+            "ln2": ln("norm2"),
+            "ln3": ln("norm3"),
+            "ff_in": {"w": g("ff.net.0.proj.weight").T, "b": g("ff.net.0.proj.bias")},
+            "ff_out": {"w": g("ff.net.2.weight").T, "b": g("ff.net.2.bias")},
+        }
+        return cfg, params
+
+    @staticmethod
+    def convert(state: Dict, num_heads: int, dtype: str = "float32",
+                attn_impl: str = "xla") -> Dict[str, Tuple[DiffusersBlockConfig, Dict]]:
+        """{block_path: (cfg, params)} for every transformer block found."""
+        return {
+            p: UNetPolicy.convert_block(state, p, num_heads, dtype, attn_impl)
+            for p in UNetPolicy.block_paths(state)
+        }
+
+
+class VAEPolicy:
+    """Maps the AutoencoderKL mid-block Attention (group-norm + biased
+    q/k/v) onto ``apply_vae_attention`` params (reference:
+    replace_policy.py VAEPolicy; csrc/spatial bias-add family)."""
+
+    ARCHITECTURES = ("AutoencoderKL", "vae")
+
+    @classmethod
+    def match(cls, name: str) -> bool:
+        return name in cls.ARCHITECTURES
+
+    @staticmethod
+    def attention_paths(state: Dict) -> List[str]:
+        suffix = ".group_norm.weight"
+        return sorted(
+            k[: -len(suffix)] for k in state
+            if k.endswith(suffix) and ".attentions." in k
+        )
+
+    @staticmethod
+    def convert_attention(state: Dict, path: str, num_heads: int = 1,
+                          dtype: str = "float32",
+                          ) -> Tuple[DiffusersAttentionConfig, Dict]:
+        g = lambda name: _np(state[f"{path}.{name}"])
+        C = g("to_q.weight").shape[1]
+        cfg = DiffusersAttentionConfig(channels=C, context_dim=None,
+                                       num_heads=num_heads, dtype=dtype)
+        params = {
+            "gn_scale": g("group_norm.weight"),
+            "gn_bias": g("group_norm.bias"),
+            "wq": g("to_q.weight").T, "bq": g("to_q.bias"),
+            "wk": g("to_k.weight").T, "bk": g("to_k.bias"),
+            "wv": g("to_v.weight").T, "bv": g("to_v.bias"),
+            "wo": g("to_out.0.weight").T, "bo": g("to_out.0.bias"),
+        }
+        return cfg, params
+
+
+class InjectedDiffusersBlocks:
+    """Jit-compiled playback of a converted UNet's transformer blocks —
+    the TPU stand-in for the reference's DSUNet CUDA-graph replay
+    (model_implementations/diffusers/unet.py:15): each distinct block
+    config compiles once; calls replay the cached executable."""
+
+    def __init__(self, converted: Dict[str, Tuple[DiffusersBlockConfig, Dict]]):
+        import jax.numpy as jnp
+
+        self.blocks = {
+            path: (cfg, jax.tree.map(jnp.asarray, params))
+            for path, (cfg, params) in converted.items()
+        }
+        self._fns: Dict[DiffusersBlockConfig, object] = {}
+
+    def __call__(self, path: str, hidden, context):
+        cfg, params = self.blocks[path]
+        fn = self._fns.get(cfg)
+        if fn is None:
+            fn = self._fns[cfg] = jax.jit(
+                lambda p, x, c: apply_transformer_block(p, cfg, x, c)
+            )
+        return fn(params, hidden, context)

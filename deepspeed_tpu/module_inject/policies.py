@@ -106,6 +106,77 @@ class GPT2Policy(HFPolicy):
         return params
 
 
+class GPTNeoPolicy(HFPolicy):
+    """reference: HFGPTNEOLayerPolicy (module_inject/containers/gptneo.py)
+    — GPT-2-shaped stack with separate (bias-free) q/k/v Linears, UNSCALED
+    attention logits, and global/local attention alternation (local layers
+    attend only the last ``window_size`` positions; the per-layer window
+    rides the model's ``local_attn_windows``)."""
+
+    ARCHITECTURES = ("GPTNeoForCausalLM", "GPTNeoModel", "gpt_neo")
+
+    def config(self, hf_config) -> TransformerConfig:
+        window = getattr(hf_config, "window_size", 256)
+        layers = getattr(hf_config, "attention_layers", None)
+        if layers is None:
+            layers = ["global"] * hf_config.num_layers
+        windows = tuple(window if kind == "local" else 0 for kind in layers)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_layers,
+            num_heads=hf_config.num_heads,
+            ffn_hidden_size=getattr(hf_config, "intermediate_size", None) or 4 * hf_config.hidden_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            pos_embedding="learned",
+            norm_type="layernorm",
+            activation="gelu",  # gelu_new == tanh approximation (our default)
+            tie_embeddings=True,
+            use_bias=True,
+            norm_eps=hf_config.layer_norm_epsilon,
+            attn_scale=1.0,  # GPT-Neo does not scale q@k^T
+            local_attn_windows=windows if any(windows) else None,
+        )
+
+    def params(self, state, cfg) -> Dict:
+        D, L = cfg.hidden_size, cfg.num_layers
+        pre = "transformer." if any(k.startswith("transformer.") for k in state) else ""
+
+        def g(name):
+            return _np(state[pre + name])
+
+        def stackT(fmt):
+            return np.stack([g(fmt.format(i)).T for i in range(L)])
+
+        def stackB(fmt):
+            return np.stack([g(fmt.format(i)) for i in range(L)])
+
+        zeros_b = np.zeros((L, D), np.float32)  # q/k/v Linears carry no bias
+        params = {
+            "embed": {"tok": g("wte.weight"), "pos": g("wpe.weight")},
+            "layers": {
+                "attn": {
+                    "wq": stackT("h.{}.attn.attention.q_proj.weight"),
+                    "wk": stackT("h.{}.attn.attention.k_proj.weight"),
+                    "wv": stackT("h.{}.attn.attention.v_proj.weight"),
+                    "wo": stackT("h.{}.attn.attention.out_proj.weight"),
+                    "bq": zeros_b, "bk": zeros_b.copy(), "bv": zeros_b.copy(),
+                    "bo": stackB("h.{}.attn.attention.out_proj.bias"),
+                },
+                "mlp": {
+                    "wi": stackT("h.{}.mlp.c_fc.weight"),
+                    "wo": stackT("h.{}.mlp.c_proj.weight"),
+                    "bi": stackB("h.{}.mlp.c_fc.bias"),
+                    "bo": stackB("h.{}.mlp.c_proj.bias"),
+                },
+                "ln1": {"scale": stackB("h.{}.ln_1.weight"), "bias": stackB("h.{}.ln_1.bias")},
+                "ln2": {"scale": stackB("h.{}.ln_2.weight"), "bias": stackB("h.{}.ln_2.bias")},
+            },
+            "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        }
+        return params
+
+
 class LlamaPolicy(HFPolicy):
     """reference: the Megatron/LLaMA-family container lineage (v0.9.1
     predates llama support; mapping follows the same policy pattern)."""
@@ -564,6 +635,17 @@ class BertPolicy(HFPolicy):
             # unused at post-LN (forward skips final norm); identity for shape
             "final_norm": {"scale": np.ones(D, np.float32), "bias": np.zeros(D, np.float32)},
         }
+        # BertForMaskedLM head: cls.predictions.transform (dense+gelu+LN)
+        # + the decoder bias, applied by models/transformer._vocab_head —
+        # without it MLM logits deviate from the HF checkpoint
+        if "cls.predictions.transform.dense.weight" in state:
+            params["mlm_head"] = {
+                "w": _np(state["cls.predictions.transform.dense.weight"]).T,
+                "b": _np(state["cls.predictions.transform.dense.bias"]),
+                "ln_scale": _np(state["cls.predictions.transform.LayerNorm.weight"]),
+                "ln_bias": _np(state["cls.predictions.transform.LayerNorm.bias"]),
+                "proj_bias": _np(state["cls.predictions.bias"]),
+            }
         return params
 
 
@@ -608,7 +690,7 @@ class DistilBertPolicy(HFPolicy):
         def stackB(fmt):
             return np.stack([g(fmt.format(i)) for i in range(L)])
 
-        return {
+        params = {
             "embed": {
                 "tok": g("embeddings.word_embeddings.weight"),
                 "pos": g("embeddings.position_embeddings.weight"),
@@ -646,6 +728,18 @@ class DistilBertPolicy(HFPolicy):
             },
             "final_norm": {"scale": np.ones(D, np.float32), "bias": np.zeros(D, np.float32)},
         }
+        # DistilBertForMaskedLM head: vocab_transform (dense+gelu) +
+        # vocab_layer_norm + the vocab_projector bias (the projector weight
+        # is tied to the embedding); see models/transformer._vocab_head
+        if "vocab_transform.weight" in state:
+            params["mlm_head"] = {
+                "w": _np(state["vocab_transform.weight"]).T,
+                "b": _np(state["vocab_transform.bias"]),
+                "ln_scale": _np(state["vocab_layer_norm.weight"]),
+                "ln_bias": _np(state["vocab_layer_norm.bias"]),
+                "proj_bias": _np(state["vocab_projector.bias"]),
+            }
+        return params
 
 
 class MegatronGPTPolicy(HFPolicy):
@@ -844,7 +938,7 @@ class CLIPTextPolicy(HFPolicy):
 
 
 POLICIES = [GPT2Policy, LlamaPolicy, OPTPolicy, BloomPolicy, GPTNeoXPolicy, GPTJPolicy,
-            BertPolicy, DistilBertPolicy, MegatronGPTPolicy, CLIPTextPolicy]
+            GPTNeoPolicy, BertPolicy, DistilBertPolicy, MegatronGPTPolicy, CLIPTextPolicy]
 
 
 def policy_for(hf_config) -> HFPolicy:

@@ -36,6 +36,7 @@ def _native():
                 ctypes.c_longlong,
                 ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
                 ctypes.c_float, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+                ctypes.c_float,
             ]
             _lib.ds_adam_step.restype = None
     return _lib
@@ -48,8 +49,13 @@ def _fptr(a: np.ndarray):
 def adam_update(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
                 exp_avg_sq: np.ndarray, lr: float, betas=(0.9, 0.999), eps: float = 1e-8,
                 weight_decay: float = 0.0, step: int = 1, adamw_mode: bool = True,
-                bias_correction: bool = True):
-    """In-place Adam on flat float32 host buffers (native or numpy fallback)."""
+                bias_correction: bool = True, grad_scale: float = 1.0):
+    """In-place Adam on flat float32 host buffers (native or numpy fallback).
+
+    ``grad_scale`` multiplies each gradient element inside the kernel —
+    the accumulation/loss-scale divide and the clip factor fuse here so
+    the grad buffer is read once (reference: ds_adam_step's fused scaling
+    lineage in csrc/adam/cpu_adam.cpp)."""
     assert params.dtype == np.float32 and params.flags.c_contiguous
     assert params.flags.writeable, "params buffer is read-only (copy device_get results)"
     lib = _native()
@@ -57,11 +63,13 @@ def adam_update(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
         lib.ds_adam_step(
             _fptr(params), _fptr(np.ascontiguousarray(grads, np.float32)), _fptr(exp_avg),
             _fptr(exp_avg_sq), params.size, lr, betas[0], betas[1], eps,
-            weight_decay, step, int(adamw_mode), int(bias_correction),
+            weight_decay, step, int(adamw_mode), int(bias_correction), grad_scale,
         )
         return
     # numpy fallback (identical math)
     g = grads.astype(np.float32, copy=False)
+    if grad_scale != 1.0:
+        g = g * grad_scale
     b1, b2 = betas
     if not adamw_mode and weight_decay > 0.0:
         g = g + weight_decay * params
@@ -89,7 +97,8 @@ class DeepSpeedCPUAdam:
     bias_correction: bool = True
     _state: Dict[int, dict] = field(default_factory=dict, repr=False)
 
-    def step_buffer(self, key, params: np.ndarray, grads: np.ndarray, lr: Optional[float] = None):
+    def step_buffer(self, key, params: np.ndarray, grads: np.ndarray, lr: Optional[float] = None,
+                    grad_scale: float = 1.0):
         """Update one flat param buffer in place, keyed moment state."""
         st = self._state.get(key)
         if st is None:
@@ -101,7 +110,7 @@ class DeepSpeedCPUAdam:
         adam_update(
             params, grads, st["m"], st["v"], lr if lr is not None else self.lr,
             self.betas, self.eps, self.weight_decay, st["step"], self.adamw_mode,
-            self.bias_correction,
+            self.bias_correction, grad_scale,
         )
         return params
 

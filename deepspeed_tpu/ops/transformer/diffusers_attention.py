@@ -61,14 +61,23 @@ def init_attention(rng, cfg: DiffusersAttentionConfig):
 
 
 def apply_attention(params, cfg: DiffusersAttentionConfig, x, context=None):
-    """x (B, T, C); context (B, S, K) for cross-attention (None => x)."""
+    """x (B, T, C); context (B, S, K) for cross-attention (None => x).
+    Optional ``bq``/``bk``/``bv`` projection biases (the VAE's Attention
+    uses them; SD-UNet blocks do not)."""
     dt = cfg.jnp_dtype
     B, T, C = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
     ctx = x if context is None else context
-    q = (x @ params["wq"].astype(dt)).reshape(B, T, nh, hd)
-    k = (ctx @ params["wk"].astype(dt)).reshape(B, ctx.shape[1], nh, hd)
-    v = (ctx @ params["wv"].astype(dt)).reshape(B, ctx.shape[1], nh, hd)
+    q = x @ params["wq"].astype(dt)
+    k = ctx @ params["wk"].astype(dt)
+    v = ctx @ params["wv"].astype(dt)
+    if "bq" in params:
+        q = q + params["bq"].astype(dt)
+        k = k + params["bk"].astype(dt)
+        v = v + params["bv"].astype(dt)
+    q = q.reshape(B, T, nh, hd)
+    k = k.reshape(B, ctx.shape[1], nh, hd)
+    v = v.reshape(B, ctx.shape[1], nh, hd)
     if cfg.attn_impl == "pallas" and context is None:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -78,7 +87,9 @@ def apply_attention(params, cfg: DiffusersAttentionConfig, x, context=None):
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
         o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1).astype(dt), v)
     out = o.reshape(B, T, C) @ params["wo"].astype(dt)
-    return out + params["bo"].astype(dt)
+    if "bo" in params:  # VAE path applies the bias in its residual join
+        out = out + params["bo"].astype(dt)
+    return out
 
 
 @dataclass(frozen=True)
@@ -130,6 +141,37 @@ def _ln(x, p, eps):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]).astype(x.dtype)
 
 
+def group_norm_nhwc(x, scale, bias, num_groups: int = 32, eps: float = 1e-6):
+    """GroupNorm over the channel dim of NHWC activations (the VAE/UNet
+    resnet + attention pre-norm; torch GroupNorm semantics)."""
+    B, H, W, C = x.shape
+    g = num_groups
+    x32 = x.astype(jnp.float32).reshape(B, H * W, g, C // g)
+    mu = jnp.mean(x32, axis=(1, 3), keepdims=True)
+    var = jnp.var(x32, axis=(1, 3), keepdims=True)
+    x32 = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (x32.reshape(B, H, W, C) * scale + bias).astype(x.dtype)
+
+
+def apply_vae_attention(params, cfg: DiffusersAttentionConfig, x,
+                        num_groups: int = 32, eps: float = 1e-6):
+    """The VAE mid-block Attention over NHWC pixels (diffusers
+    ``AutoencoderKL`` ``Attention`` with ``group_norm`` + biased q/k/v):
+    group-norm, flatten H*W into tokens, self-attend, project, residual
+    (the residual join rides the spatial op surface, reference
+    csrc/spatial/csrc/pt_binding.cpp:109)."""
+    from deepspeed_tpu.ops.spatial import nhwc_bias_add_add
+
+    B, H, W, C = x.shape
+    h = group_norm_nhwc(x, params["gn_scale"], params["gn_bias"], num_groups, eps)
+    tokens = h.reshape(B, H * W, C)
+    # self-attention WITHOUT the output bias (dropped from the param subset):
+    # the residual join below applies it through the named spatial op
+    attn_params = {k: params[k] for k in ("wq", "wk", "wv", "wo", "bq", "bk", "bv")}
+    out = apply_attention(attn_params, cfg, tokens).reshape(B, H, W, C)
+    return nhwc_bias_add_add(out, params["bo"], x)
+
+
 def apply_transformer_block(params, cfg: DiffusersBlockConfig, x, context):
     """x (B, T, C) pixel tokens, context (B, S, context_dim) text tokens."""
     dt = cfg.jnp_dtype
@@ -140,5 +182,7 @@ def apply_transformer_block(params, cfg: DiffusersBlockConfig, x, context):
     h = _ln(x, params["ln3"], cfg.norm_eps)
     a = h @ params["ff_in"]["w"].astype(dt) + params["ff_in"]["b"].astype(dt)
     val, gate = jnp.split(a, 2, axis=-1)
-    h = val * jax.nn.gelu(gate)
+    # diffusers' GEGLU gates with EXACT (erf) gelu — the tanh approximation
+    # deviates ~1e-3 and breaks checkpoint parity
+    h = val * jax.nn.gelu(gate, approximate=False)
     return x + (h @ params["ff_out"]["w"].astype(dt) + params["ff_out"]["b"].astype(dt))

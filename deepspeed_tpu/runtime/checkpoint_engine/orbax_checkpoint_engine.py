@@ -154,6 +154,12 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
                             "(e.g. the 'latest' pointer) are dropped"
                         )
                 self._pending_meta = None
+            # Commit callbacks MUST be registered on rank 0 only (the engine
+            # gates on_commit with process_index()==0): rank 0 is the only
+            # rank that checks the marker dir, so its local verdict is the
+            # authoritative one wherever callbacks exist. A collective here
+            # would deadlock — ranks != 0 have no pending commits and fence
+            # at different times.
             if marker_written:
                 for cb in list(self._pending_commits):
                     cb()

@@ -199,6 +199,19 @@ class TpuEngine:
         self._host_master = None  # {dotted_name: np fp32} when offloaded
         self._host_optimizer = None
         self._nvme_swapper = None
+        self._grad_stats_fn = None  # device-side norm/finite reduction
+        self._wire_grads = None  # in-flight D2H tree (started in backward)
+        self._wire_cast_fn = None
+        wire = config.zero_config.offload_optimizer.wire_dtype
+        # fp16 wire is rejected: pre-divide grads (scaled by loss_scale*gas)
+        # routinely exceed fp16 max while finite in fp32, so the cast would
+        # mint infs AFTER the overflow check and poison the Adam state.
+        # bf16 shares fp32's exponent range and is safe.
+        if wire not in ("float32", "fp32", "bfloat16", "bf16"):
+            raise ValueError(
+                f"offload_optimizer.wire_dtype must be float32 or bfloat16, got {wire!r}"
+            )
+        self._offload_wire_dtype = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}.get(wire)
 
         # --- ZeRO-Infinity parameter offload: host/NVMe weights streamed
         # through HBM per layer-group (runtime/zero/param_offload.py)
@@ -465,36 +478,73 @@ class TpuEngine:
         self._host_master = {k: np.zeros((0,), np.float32) for k in self._host_master}
         return self._nvme_swapper
 
+    def _grad_stats(self):
+        """Device-side squared grad norm + finiteness over grad_acc — a
+        two-scalar transfer instead of the old host fp64 pass over every
+        gradient byte (the 6 GB scan was a real cost at GPT-2 1.5B scale)."""
+        if self._grad_stats_fn is None:
+            def stats(acc):
+                leaves = jax.tree.leaves(acc)
+                sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+                finite = jnp.all(
+                    jnp.stack([jnp.all(jnp.isfinite(l)) for l in leaves])
+                )
+                return sq, finite
+            self._grad_stats_fn = jax.jit(stats, out_shardings=(self.replicated, self.replicated))
+        return self._grad_stats_fn(self.grad_acc)
+
     def _host_offload_step(self, lr: float) -> StepMetrics:
-        """Optimizer step on the host tier: grads device->host, C++ Adam on
-        flat fp32 buffers, updated masters -> device params."""
+        """Optimizer step on the host tier: grads device->host (optionally
+        on a bf16 wire — half the D2H bytes, matching the reference's
+        half-precision grad transfers in stage_1_and_2.py), C++ Adam on
+        flat fp32 buffers with the accumulation/clip scaling fused into the
+        kernel, updated masters -> device params."""
         cfg = self.config
         denom = float(self.scale_state.scale) * (
             self.gradient_accumulation_steps if not cfg.prescale_gradients else 1.0
         )
         if self.coordinator is not None:
             grads = self.coordinator.consume_grads(denom)
+            part = self.coordinator.partition
+            sq = sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())
+            gnorm = float(np.sqrt(part.reduce_sum(sq)))  # partitioned: global norm
+            overflow = False
+            if self.fp16_enabled:
+                bad = any(not np.all(np.isfinite(g)) for g in grads.values())
+                overflow = part.reduce_sum(1.0 if bad else 0.0) > 0.0
+            scale_harvested = True  # coordinator grads arrive pre-divided
         else:
-            flat_grads, _ = jax.tree_util.tree_flatten(self.grad_acc)
-            paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(self.grad_acc)]
-            # start every D2H copy before blocking on any (reference overlaps
-            # the grad copy with backward, stage_1_and_2.py:1031; here the
-            # copies at least overlap each other and any in-flight compute)
+            # device-side stats run while the async D2H copies (kicked off in
+            # backward() at the accumulation boundary) stream in the background
+            sq, finite = self._grad_stats()
+            wire = self._wire_grads if self._wire_grads is not None else self.grad_acc
+            flat_grads, _ = jax.tree_util.tree_flatten(wire)
+            paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(wire)]
             for g in flat_grads:
                 if hasattr(g, "copy_to_host_async"):
-                    g.copy_to_host_async()
+                    g.copy_to_host_async()  # no-op if backward already started it
+            # RAW grads: the denom/clip scaling is fused into the Adam kernel
+            # below (grad_scale), so the host never re-writes the buffers
             grads = {
-                _leaf_key(p): np.asarray(jax.device_get(g), np.float32) / denom
+                _leaf_key(p): np.asarray(jax.device_get(g))
                 for p, g in zip(paths, flat_grads)
             }
-        overflow = any(not np.all(np.isfinite(g)) for g in grads.values()) if self.fp16_enabled else False
-        gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())))
+            # bf16 wire -> fp32 once (the Adam kernel wants fp32 buffers)
+            grads = {
+                k: (g if g.dtype == np.float32 else g.astype(np.float32))
+                for k, g in grads.items()
+            }
+            self._wire_grads = None
+            overflow = self.fp16_enabled and not bool(finite)
+            gnorm = float(np.sqrt(float(sq))) / denom
+            scale_harvested = False
         clip = cfg.gradient_clipping
         factor = min(1.0, clip / (gnorm + 1e-6)) if clip > 0.0 else 1.0
+        kernel_scale = factor if scale_harvested else factor / denom
 
         if not overflow:
             if self._nvme_swapper is not None:
-                updated = self._nvme_swapper.step(grads, lr=lr, grad_scale=factor)
+                updated = self._nvme_swapper.step(grads, lr=lr, grad_scale=kernel_scale)
                 if self.coordinator is not None:
                     self.coordinator.refresh_working(updated)
                     self.params = self.coordinator.working
@@ -503,8 +553,8 @@ class TpuEngine:
                     self._push_masters_to_device(updated)
             else:
                 for key, master in self._host_master.items():
-                    g = grads[key] * factor if factor != 1.0 else grads[key]
-                    self._host_optimizer.step_buffer(key, master, g, lr=lr)
+                    self._host_optimizer.step_buffer(key, master, grads[key], lr=lr,
+                                                     grad_scale=kernel_scale)
                 if self.coordinator is not None:
                     self.coordinator.refresh_working(self._host_master)
                     self.params = self.coordinator.working
@@ -814,7 +864,18 @@ class TpuEngine:
         ):
             # kick off grad D2H right behind the (async-dispatched) last
             # micro-step so transfers overlap the tail of backward compute
-            for g in jax.tree.leaves(self.grad_acc):
+            # (reference: grad-copy/backward overlap, stage_1_and_2.py:1031);
+            # with a bf16 wire a tiny cast program halves the bytes first
+            wire = self.grad_acc
+            if self._offload_wire_dtype is not None:
+                if self._wire_cast_fn is None:
+                    wd = self._offload_wire_dtype
+                    self._wire_cast_fn = jax.jit(
+                        lambda t: jax.tree.map(lambda g: g.astype(wd), t)
+                    )
+                wire = self._wire_cast_fn(self.grad_acc)
+            self._wire_grads = wire
+            for g in jax.tree.leaves(wire):
                 if hasattr(g, "copy_to_host_async"):
                     g.copy_to_host_async()
         self.timers(EngineTimers.BACKWARD).stop()

@@ -87,6 +87,10 @@ class PipelinedTransformer:
     def loss(self, params, batch, rng=None):
         cfg = self.cfg
         tokens = batch["input_ids"]  # (M, mb, S)
+        assert cfg.local_attn_windows is None, (
+            "per-layer local-attention windows (GPT-Neo) are not supported in "
+            "the pipeline engine; run data/tensor-parallel instead"
+        )
         assert tokens.ndim == 3, f"pipeline batch must be (microbatches, mb, seq), got {tokens.shape}"
         M, mb, S = tokens.shape
         dtype = cfg.jnp_dtype
@@ -152,6 +156,10 @@ class PipelinedTransformer:
 
         cfg = self.cfg
         tokens = batch["input_ids"]
+        assert cfg.local_attn_windows is None, (
+            "per-layer local-attention windows (GPT-Neo) are not supported in "
+            "the pipeline engine; run data/tensor-parallel instead"
+        )
         assert tokens.ndim == 3, f"pipeline batch must be (microbatches, mb, seq), got {tokens.shape}"
         M, mb, S = tokens.shape
         dtype = cfg.jnp_dtype

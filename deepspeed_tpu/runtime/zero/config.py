@@ -38,6 +38,10 @@ class OffloadOptimizerConfig:
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0
+    # gradient D2H wire format: "float32" (exact) or "bfloat16" (halves the
+    # transfer bytes; the reference's ZeRO-Offload likewise moves grads to
+    # the host in half precision — stage_1_and_2.py's fp16 grad buffers)
+    wire_dtype: str = "float32"
 
 
 @dataclass

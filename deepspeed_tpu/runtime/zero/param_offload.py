@@ -25,10 +25,16 @@ The model contract is the streaming API of models/transformer.py:
 / ``head_loss_fwd``. Gradients flow D2H with ``copy_to_host_async`` so the
 transfer of group g overlaps the backward compute of group g-1.
 
-Single-host scope: each process keeps full host copies (the virtual-mesh
-test path and the one-chip bench). Multi-host sharded host tiers would
-split the leading layer dim per process — the group slicing below is
-already expressed per-group, so that extension is localized to GroupStore.
+Multi-host host tier: the fp32 tier (masters + grad accumulators + the
+optimizer moments keyed off them) is PARTITIONED per process — each process
+owns a contiguous flat-element range of every buffer (``HostPartition``,
+matching the reference's per-rank fp32 partitions,
+partition_parameters.py:601 / stage_1_and_2.py single_partition_of_
+fp32_groups). After the local optimizer step the model-dtype cast of each
+local range is exchanged (process allgather) to rebuild the full working
+tier, which stays replicated per process for the per-group H2D streaming.
+Single-process runs (the virtual-mesh test path and the one-chip bench)
+keep the exact unpartitioned behavior.
 """
 
 import os
@@ -44,6 +50,65 @@ from deepspeed_tpu.utils.logging import log_dist
 
 def _leaf_key(path) -> str:
     return ".".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+class HostPartition:
+    """Per-process contiguous flat-element range of each host buffer
+    (reference: per-rank fp32 partitions, partition_parameters.py:601).
+
+    ``exchange`` is the cross-process allgather used to rebuild full
+    model-dtype buffers after the local optimizer step: it maps a local
+    1-D array to the concatenation of every process's local array, in
+    process order. The default uses jax multihost utils; tests inject a
+    loopback that stitches simulated processes together."""
+
+    def __init__(self, proc_idx: Optional[int] = None, proc_count: Optional[int] = None,
+                 exchange=None):
+        self.idx = jax.process_index() if proc_idx is None else proc_idx
+        self.count = jax.process_count() if proc_count is None else proc_count
+        self._exchange = exchange
+
+    @property
+    def active(self) -> bool:
+        return self.count > 1
+
+    def range_of(self, size: int):
+        """Balanced [lo, hi) flat range this process owns in a buffer."""
+        base, rem = divmod(size, self.count)
+        lo = self.idx * base + min(self.idx, rem)
+        return lo, lo + base + (1 if self.idx < rem else 0)
+
+    def local(self, full_flat: np.ndarray) -> np.ndarray:
+        lo, hi = self.range_of(full_flat.size)
+        return np.ascontiguousarray(full_flat.reshape(-1)[lo:hi])
+
+    def allgather(self, local: np.ndarray, full_size: int, tag: str = "") -> np.ndarray:
+        """Rebuild the full flat buffer from every process's local range.
+        ``tag`` names the buffer for injected exchanges (tests/simulation)."""
+        if self._exchange is not None:
+            return self._exchange(local, full_size, tag)
+        if not self.active:
+            return local
+        from jax.experimental import multihost_utils
+
+        # ranges differ by at most one element: pad to the max, gather, trim
+        base, rem = divmod(full_size, self.count)
+        width = base + (1 if rem else 0)
+        padded = np.zeros((width,), local.dtype)
+        padded[: local.size] = local
+        stacked = np.asarray(multihost_utils.process_allgather(padded))
+        parts = []
+        for p in range(self.count):
+            n = base + (1 if p < rem else 0)
+            parts.append(stacked[p, :n])
+        return np.concatenate(parts)
+
+    def reduce_sum(self, value: float) -> float:
+        """Sum a host scalar across processes (grad-norm / overflow votes)."""
+        if not self.active:
+            return float(value)
+        full = self.allgather(np.asarray([value], np.float64), self.count, tag="sum")
+        return float(full.sum())
 
 
 class GroupStore:
@@ -105,7 +170,8 @@ class ParamOffloadCoordinator:
         host optimizer step
     """
 
-    def __init__(self, model, mesh, policy, model_dtype, zero_cfg, batch_sharding, init_rng):
+    def __init__(self, model, mesh, policy, model_dtype, zero_cfg, batch_sharding, init_rng,
+                 partition: Optional[HostPartition] = None):
         from deepspeed_tpu.models import transformer as tf
 
         self._tf = tf
@@ -114,6 +180,8 @@ class ParamOffloadCoordinator:
         self.policy = policy
         self.dtype = model_dtype
         self.batch_sharding = batch_sharding
+        self.partition = partition if partition is not None else HostPartition()
+        self._full_shapes: Dict[str, tuple] = {}  # fp32-tier full shapes
 
         L = self.cfg.num_layers
         abstract_layer = jax.eval_shape(partial(tf.init_layer_slice, cfg=self.cfg, lo=0, hi=1), init_rng)
@@ -143,7 +211,7 @@ class ParamOffloadCoordinator:
         outer_f32 = jax.jit(partial(tf.init_outer, cfg=self.cfg))(r_outer)
         self.masters: Dict[str, np.ndarray] = {}
         for p, leaf in jax.tree_util.tree_leaves_with_path(outer_f32):
-            self.masters[_leaf_key(p)] = np.array(jax.device_get(leaf), np.float32)
+            self._set_master(_leaf_key(p), np.array(jax.device_get(leaf), np.float32))
         self.working = jax.tree.map(
             lambda a: np.array(jax.device_get(a.astype(model_dtype))), outer_f32
         )
@@ -169,11 +237,19 @@ class ParamOffloadCoordinator:
             self.store.put_group(g, flat)
             del slice_f32
         for key, parts in full_layer_masters.items():
-            self.masters[f"layers.{key}"] = np.concatenate(parts, axis=0)
+            self._set_master(f"layers.{key}", np.concatenate(parts, axis=0))
 
         # engine.params surface must be a full nested tree: cpu tier exposes
         # the real backing arrays (zero-copy slices); nvme reads back once
         self.working["layers"] = self._assemble_layers()
+
+        # per-group local-attention window slices (GPT-Neo; zeros when off)
+        self._group_windows = [
+            np.asarray(
+                (self.cfg.local_attn_windows or (0,) * L)[lo:hi], np.int32
+            )
+            for lo, hi in self.group_bounds
+        ]
 
         # host-side fp32 grad accumulators, zeroed lazily
         self.host_grads: Dict[str, np.ndarray] = {}
@@ -187,6 +263,16 @@ class ParamOffloadCoordinator:
         )
 
     # -- host <-> device plumbing ---------------------------------------
+    def _set_master(self, key: str, full: np.ndarray):
+        """Record a master buffer, keeping only this process's partition
+        when running multi-process (1/P of the fp32 host bytes; moments in
+        the host optimizer key off these, so they partition for free)."""
+        self._full_shapes[key] = full.shape
+        if self.partition.active:
+            self.masters[key] = self.partition.local(full)
+        else:
+            self.masters[key] = full
+
     def _assemble_layers(self):
         """Full stacked working tree (for engine.params / checkpointing)."""
         parts = [self.store.fetch(g, self._layer_keys) for g in range(self.n_groups)]
@@ -216,17 +302,32 @@ class ParamOffloadCoordinator:
 
     def _accumulate(self, prefix: str, tree, lo: Optional[int] = None, hi: Optional[int] = None):
         """Add device grads into the host fp32 accumulators ([lo:hi) rows of
-        the stacked buffers for layer slices)."""
+        the stacked buffers for layer slices). Partitioned runs keep only
+        the local flat range of each accumulator."""
         for p, leaf in jax.tree_util.tree_leaves_with_path(tree):
             key = f"{prefix}{_leaf_key(p)}"
             host = np.asarray(jax.device_get(leaf), np.float32)
+            if not self.partition.active:
+                if key not in self.host_grads:
+                    self.host_grads[key] = np.zeros(self._full_shapes[key], np.float32)
+                if lo is None:
+                    self.host_grads[key] += host
+                else:
+                    self.host_grads[key][lo:hi] += host
+                continue
+            # local accumulator: intersect the incoming chunk's flat range
+            # [c_lo, c_hi) with this process's owned range [p_lo, p_hi)
+            full_shape = self._full_shapes[key]
+            full_size = int(np.prod(full_shape))
+            p_lo, p_hi = self.partition.range_of(full_size)
             if key not in self.host_grads:
-                full_shape = self.masters[key].shape
-                self.host_grads[key] = np.zeros(full_shape, np.float32)
-            if lo is None:
-                self.host_grads[key] += host
-            else:
-                self.host_grads[key][lo:hi] += host
+                self.host_grads[key] = np.zeros((p_hi - p_lo,), np.float32)
+            row = full_size // full_shape[0] if lo is not None else 0
+            c_lo = lo * row if lo is not None else 0
+            c_hi = c_lo + host.size
+            a, b = max(c_lo, p_lo), min(c_hi, p_hi)
+            if a < b:
+                self.host_grads[key][a - p_lo : b - p_lo] += host.reshape(-1)[a - c_lo : b - c_lo]
 
     # -- compiled programs ----------------------------------------------
     def _compile(self):
@@ -237,8 +338,8 @@ class ParamOffloadCoordinator:
             partial(tf.embed_fwd, cfg=cfg), out_shardings=out_x
         )
 
-        def group_fwd(sl, x):
-            return tf.layer_slice_fwd(sl, cfg, x)
+        def group_fwd(sl, x, windows):
+            return tf.layer_slice_fwd(sl, cfg, x, windows=windows if cfg.local_attn_windows else None)
 
         self._group_fwd = jax.jit(group_fwd, out_shardings=(out_x, None))
 
@@ -249,8 +350,13 @@ class ParamOffloadCoordinator:
         # loss-only head for eval (no backward through the B*S*V projection)
         self._head_loss = jax.jit(lambda outer, x, batch: tf.head_loss_fwd(outer, cfg, x, batch))
 
-        def group_bwd(sl, x_in, dx_out, aux_cot):
-            _, vjp = jax.vjp(lambda s, x: tf.layer_slice_fwd(s, cfg, x), sl, x_in)
+        def group_bwd(sl, x_in, dx_out, aux_cot, windows):
+            _, vjp = jax.vjp(
+                lambda s, x: tf.layer_slice_fwd(
+                    s, cfg, x, windows=windows if cfg.local_attn_windows else None
+                ),
+                sl, x_in,
+            )
             dsl, dx_in = vjp((dx_out, aux_cot))
             return dx_in, dsl
 
@@ -289,7 +395,7 @@ class ParamOffloadCoordinator:
         # never blocks on the host between groups
         for g in range(self.n_groups):
             sl = self._put_group(g, prefetch_next=g + 1 if g + 1 < self.n_groups else None)
-            x, aux = self._group_fwd(sl, x)
+            x, aux = self._group_fwd(sl, x, self._group_windows[g])
             ckpts.append(x)
             auxs.append(aux)
             del sl
@@ -301,7 +407,7 @@ class ParamOffloadCoordinator:
         for g in range(self.n_groups - 1, -1, -1):
             lo, hi = self.group_bounds[g]
             sl = self._put_group(g, prefetch_next=g - 1 if g > 0 else None)
-            dx, dlayers = self._group_bwd(sl, ckpts[g], dx, aux_cot)
+            dx, dlayers = self._group_bwd(sl, ckpts[g], dx, aux_cot, self._group_windows[g])
             jax.tree.map(lambda a: a.copy_to_host_async(), dlayers)
             if pending is not None:
                 self._accumulate("layers.", pending[2], pending[0], pending[1])
@@ -327,7 +433,7 @@ class ParamOffloadCoordinator:
         auxs = []
         for g in range(self.n_groups):
             sl = self._put_group(g, prefetch_next=g + 1 if g + 1 < self.n_groups else None)
-            x, aux = self._group_fwd(sl, x)
+            x, aux = self._group_fwd(sl, x, self._group_windows[g])
             auxs.append(aux)
             del sl
         loss = self._head_loss(outer_dev, x, batch)
@@ -347,12 +453,24 @@ class ParamOffloadCoordinator:
 
     def refresh_working(self, masters: Dict[str, np.ndarray]):
         """Cast updated fp32 masters into the model-dtype working tier
-        (host RAM and/or NVMe)."""
+        (host RAM and/or NVMe). Partitioned runs cast only the local range
+        and allgather the model-dtype slices to rebuild full buffers —
+        fp32 never re-materializes in full on any process."""
         for k, v in masters.items():
             self.masters[k] = v
 
         def cast(a):
             return np.array(jax.device_get(jnp.asarray(a, self.dtype)))
+
+        if self.partition.active:
+            full = {
+                mkey: self.partition.allgather(
+                    cast(masters[mkey]), int(np.prod(self._full_shapes[mkey])), tag=mkey
+                ).reshape(self._full_shapes[mkey])
+                for mkey in masters
+            }
+        else:
+            full = None
 
         for key in list(self.working.keys()):
             if key == "layers":
@@ -360,13 +478,14 @@ class ParamOffloadCoordinator:
             for p, leaf in jax.tree_util.tree_leaves_with_path(self.working[key]):
                 mkey = f"{key}.{_leaf_key(p)}"
                 if mkey in masters:
-                    leaf[...] = cast(masters[mkey])
+                    leaf[...] = full[mkey] if full is not None else cast(masters[mkey])
         for g, (lo, hi) in enumerate(self.group_bounds):
             flat = {}
             for key in self._layer_keys:
                 mkey = f"layers.{key}"
                 if mkey in masters:
-                    flat[key] = cast(masters[mkey][lo:hi])
+                    src = full[mkey][lo:hi] if full is not None else cast(masters[mkey][lo:hi])
+                    flat[key] = src
             if flat:
                 self.store.put_group(g, flat)
         self.working["layers"] = self._assemble_layers()

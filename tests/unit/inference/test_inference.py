@@ -431,6 +431,114 @@ class TestNewPolicies:
         ours = np.asarray(encode(params, cfg, jnp.asarray(tokens, jnp.int32)))
         np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
+    def test_gptneo_logits_parity(self):
+        """GPT-Neo: separate bias-free q/k/v Linears, UNSCALED attention,
+        and global/local layer alternation — seq > window so the local mask
+        actually bites (reference: containers/gptneo.py)."""
+        import torch
+        from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+        torch.manual_seed(0)
+        hf = GPTNeoForCausalLM(GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64, intermediate_size=64,
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0,
+        )).eval()
+        from deepspeed_tpu.models.transformer import TransformerModel
+        from deepspeed_tpu.module_inject.policies import GPTNeoPolicy, convert_hf_model, policy_for
+
+        assert isinstance(policy_for(hf.config), GPTNeoPolicy)
+        cfg, params = convert_hf_model(hf)
+        assert cfg.attn_scale == 1.0
+        assert cfg.local_attn_windows == (0, 4)
+        model = TransformerModel(cfg)
+        tokens = np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_gptneo_greedy_decode_parity(self):
+        """The cached decode path must honor the per-layer local windows:
+        greedy generate matches HF token-for-token past the window size."""
+        import torch
+        from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+        torch.manual_seed(0)
+        hf = GPTNeoForCausalLM(GPTNeoConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64, intermediate_size=64,
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0,
+        )).eval()
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import TransformerModel
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+        cfg, params = convert_hf_model(hf)
+        engine = deepspeed_tpu.init_inference(
+            TransformerModel(cfg), config={"dtype": "float32"}, params=params
+        )
+        prompt = np.random.RandomState(1).randint(0, 128, (2, 7)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf.generate(
+                torch.from_numpy(prompt), max_new_tokens=8, do_sample=False,
+                pad_token_id=0,
+            ).numpy()
+        ours = np.asarray(engine.generate(prompt.astype(np.int32), max_new_tokens=8))
+        np.testing.assert_array_equal(ours, ref)
+
+    def test_distilbert_mlm_logits_parity(self):
+        """DistilBertForMaskedLM: vocab_transform + vocab_layer_norm +
+        projector bias must fold into the exported head (_vocab_head) —
+        tied-embedding-only projection deviates from HF numerics."""
+        import torch
+        from transformers import DistilBertConfig, DistilBertForMaskedLM
+
+        torch.manual_seed(0)
+        hf = DistilBertForMaskedLM(DistilBertConfig(
+            vocab_size=128, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+            max_position_embeddings=64, dropout=0.0, attention_dropout=0.0,
+        )).eval()
+        from deepspeed_tpu.models.transformer import TransformerModel
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+        cfg, params = convert_hf_model(hf)
+        assert "mlm_head" in params, "MLM head weights must be exported"
+        model = TransformerModel(cfg)
+        tokens = np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_bert_mlm_logits_parity(self):
+        """BertForMaskedLM: cls.predictions.transform + decoder bias parity."""
+        import torch
+        from transformers import BertConfig, BertForMaskedLM
+
+        torch.manual_seed(0)
+        hf = BertForMaskedLM(BertConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        )).eval()
+        from deepspeed_tpu.models.transformer import TransformerModel
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+
+        cfg, params = convert_hf_model(hf)
+        assert "mlm_head" in params
+        model = TransformerModel(cfg)
+        tokens = np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
     def test_megatron_fused_qkv_split(self):
         """Synthetic megatron-format state dict: the fused query_key_value
         splits must land in the right wq/wk/wv slots for BOTH row layouts

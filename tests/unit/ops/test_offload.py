@@ -220,6 +220,21 @@ class TestEngineOffload:
         )
         np.testing.assert_allclose(dev_losses, off_losses, rtol=0.05)
 
+    def test_bf16_wire_tracks_fp32_wire(self):
+        """The bf16 grad wire (half D2H bytes; engine._offload_wire_dtype)
+        must track the exact fp32-wire trajectory within bf16 rounding."""
+        _, fp32_losses = self._train(
+            {"zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}}
+        )
+        engine, bf16_losses = self._train(
+            {"zero_optimization": {"stage": 2,
+                                   "offload_optimizer": {"device": "cpu",
+                                                         "wire_dtype": "bfloat16"}}}
+        )
+        assert engine._offload_wire_dtype is not None
+        np.testing.assert_allclose(fp32_losses, bf16_losses, rtol=0.1)
+        assert bf16_losses[-1] < 0.5 * bf16_losses[0], bf16_losses
+
     def test_nvme_offload_trains(self, tmp_path):
         engine, losses = self._train({
             "zero_optimization": {
