@@ -1,0 +1,650 @@
+"""Bench CHILD-side implementation: the actual measurements.
+
+This module is only ever imported inside a bench child process
+(``python bench.py --child <phase>``). The parent orchestrator in
+``bench.py`` is stdlib-only and never touches jax — every device
+contact (including the first ``jax.devices()``) happens here, inside a
+subprocess the parent can SIGKILL on timeout. That is the round-4 fix
+for the r2/r3 ``rc=124`` failures: the TPU relay hang sits inside a
+blocked C call, which ``signal.alarm`` demonstrably cannot interrupt.
+
+Phases (BASELINE.json tracked-config classes that fit one chip):
+
+  probe           — tiny matmul; proves the relay is alive (<=150 s cap).
+  primary         — headline GPT-2 125M causal-LM training (self-tuning).
+  primary_fallback— pinned xla+remat config, always-a-number path.
+  zero3_offload   — ZeRO-3 + optimizer host offload (max-params story).
+  moe_ep          — MoE GPT (8 experts, top-1 GShard gating) training.
+  decode          — KV-cache greedy decode tokens/s (+ int8 A/B).
+  hybrid_rlhf     — hybrid-engine rollout + train step, tokens/s.
+  bert_mlm        — BERT-large MLM samples/s + TFLOPS/chip (reference's
+                    headline bench: 64 TFLOPS/V100 @ seq 128).
+
+Each phase prints exactly one sentinel line ``DSTPU_RESULT {json}``; the
+parent relays it as a bare JSON line. vs_baseline for training configs is
+MFU / 0.45 (the north-star MFU from BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so the script still runs off-TPU
+}
+PEAK_HBM_BW = {
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6e": 1640e9,
+    "cpu": 100e9,
+}
+
+
+_SMOKE = os.environ.get("DSTPU_BENCH_SMOKE") == "1"
+
+
+def _smoke_model(seq=64, **overrides):
+    from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+    kw = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=seq, dtype="bfloat16")
+    kw.update(overrides)
+    return TransformerModel(TransformerConfig(**kw))
+
+
+def _device_kind() -> str:
+    return jax.devices()[0].device_kind.lower()
+
+
+def peak_flops() -> float:
+    kind = _device_kind()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def peak_bw() -> float:
+    kind = _device_kind()
+    for key, val in PEAK_HBM_BW.items():
+        if key in kind:
+            return val
+    return 819e9
+
+
+def _sync(engine, loss):
+    # a host transfer is the only reliable completion barrier on remote
+    # relays where block_until_ready acks early; loss(+params) close the
+    # dependency chain over every prior step
+    return float(loss) + float(jnp.sum(jax.tree.leaves(engine.params)[0]))
+
+
+def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None):
+    """Shared measurement protocol (warmup, host-transfer sync barrier,
+    timed loop) for every training bench; ``batch`` overrides the default
+    causal-LM batch (the MLM bench passes labels/loss_mask/token_types)."""
+    assert warmup_steps >= 1, "at least one warmup step (compile) is required"
+    import deepspeed_tpu
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rs = np.random.RandomState(0)
+    n_dev = jax.device_count()
+    if batch is None:
+        batch = {"input_ids": rs.randint(0, model.cfg.vocab_size, (micro_bs * n_dev, seq)).astype(np.int32)}
+
+    def step():
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    for _ in range(warmup_steps):
+        loss = step()
+    _sync(engine, loss)
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step()
+    _sync(engine, loss)
+    dt = (time.time() - t0) / iters
+    toks = micro_bs * n_dev * seq / dt
+    return toks / n_dev, dt, float(loss), engine
+
+
+def _transfer_bandwidth_probe(nbytes=1 << 27):
+    """Measured D2H + H2D bandwidth (bytes/s) through whatever link this
+    process has to the chip (direct PCIe/HBM or a remote relay). Used to
+    pre-size the offload bench instead of timing out (VERDICT r2 weak #3)."""
+    dev = jax.devices()[0]
+    x_host = np.zeros(nbytes // 4, np.float32)
+    x = jax.device_put(x_host, dev)
+    x.block_until_ready()
+    t0 = time.time()
+    _ = np.asarray(x)
+    d2h = nbytes / max(time.time() - t0, 1e-9)
+    t0 = time.time()
+    y = jax.device_put(x_host, dev)
+    y.block_until_ready()
+    h2d = nbytes / max(time.time() - t0, 1e-9)
+    return d2h, h2d
+
+
+def bench_zero3_offload(budget_s=240):
+    """ZeRO-3 + optimizer host offload (the max-params-per-chip story).
+
+    Re-sized per VERDICT r2 weak #3: GPT-2 ~760M (not 1.5B), 1 measured
+    iter, bf16 grad wire, and a bandwidth pre-probe that emits a
+    diagnostic skip line instead of burning the cap when the relay is too
+    slow for the transfer volume."""
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    seq, micro_bs = 1024, 1
+    if _SMOKE:
+        seq = 64
+        model = _smoke_model(seq, remat=True, remat_policy="nothing_saveable")
+    else:
+        model = TransformerModel.from_preset(
+            "gpt2-760m", dtype="bfloat16", remat=True, remat_policy="nothing_saveable", max_seq_len=seq
+        )
+        # pre-probe: per step the offload path moves ~2 bytes/param D2H
+        # (bf16 grad wire) + ~2 bytes/param H2D (bf16 params back)
+        d2h, h2d = _transfer_bandwidth_probe()
+        n_params = model.cfg.num_params()
+        est_step = 2 * n_params / d2h + 2 * n_params / h2d
+        n_steps = 3  # warmup + 2 measured
+        compile_margin = 120.0
+        if est_step * n_steps + compile_margin > budget_s:
+            return {
+                "metric": "gpt2_760m_zero3_offload_skipped",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "extra": {
+                    "reason": "transfer bandwidth too low for budget",
+                    "d2h_gbps": round(d2h / 1e9, 2),
+                    "h2d_gbps": round(h2d / 1e9, 2),
+                    "est_step_s": round(est_step, 1),
+                    "budget_s": budget_s,
+                },
+            }
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            # bf16 grad wire: half the D2H bytes per step (the transfer is
+            # the offload bottleneck through a remote relay)
+            "offload_optimizer": {"device": "cpu", "wire_dtype": "bfloat16"},
+        },
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    toks, dt, loss, engine = _train_bench(model, config, micro_bs, seq, iters=2)
+    n_params = model.cfg.num_params()
+    mfu = toks * model.flops_per_token(seq) / peak_flops()
+    return {
+        "metric": "gpt2_760m_zero3_offload_tokens_per_sec_per_chip",
+        "value": round(toks, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "params": n_params,
+            "params_per_chip": n_params,
+            "mfu": round(mfu, 4),
+            "step_ms": round(dt * 1e3, 1),
+            "offload": "cpu",
+            "loss": loss,
+        },
+    }
+
+
+def bench_moe_ep():
+    from deepspeed_tpu.models.transformer import TransformerModel, get_config
+
+    seq, micro_bs = (64, 2) if _SMOKE else (1024, 8)
+    cfg = get_config(
+        "gpt2-125m", dtype="bfloat16", remat=True, remat_policy="nothing_saveable",
+        max_seq_len=seq, moe_num_experts=8, moe_top_k=1,
+    )
+    if _SMOKE:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, hidden_size=64, num_layers=2, num_heads=4, vocab_size=512)
+    model = TransformerModel(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},  # expert axis folds to 1 on a single chip
+    }
+    toks, dt, loss, _ = _train_bench(model, config, micro_bs, seq, iters=8)
+    mfu = toks * cfg.flops_per_token(seq) / peak_flops()
+    return {
+        "metric": "moe_gpt_8e_train_tokens_per_sec_per_chip",
+        "value": round(toks, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "experts": 8,
+            "params": cfg.num_params(),
+            "mfu": round(mfu, 4),
+            "step_ms": round(dt * 1e3, 1),
+            "loss": loss,
+        },
+    }
+
+
+def _decode_window(engine, tokens, new_tokens):
+    """Steady-state decode seconds: total generate minus (prefill + one
+    decode step), both paths pre-compiled."""
+    out = engine.generate(tokens, max_new_tokens=new_tokens)  # compile + warmup
+    _ = np.asarray(out)
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))  # compile 1-token path
+    t0 = time.time()
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=1))
+    t_prefill = time.time() - t0
+    t0 = time.time()
+    _ = np.asarray(engine.generate(tokens, max_new_tokens=new_tokens))
+    return max(time.time() - t0 - t_prefill, 1e-9)
+
+
+def bench_decode():
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    B, prompt_len, new_tokens = (2, 8, 8) if _SMOKE else (8, 128, 128)
+    if _SMOKE:
+        model = _smoke_model(64)
+    else:
+        model = TransformerModel.from_preset("gpt2-350m", dtype="bfloat16", max_seq_len=1024)
+    engine = deepspeed_tpu.init_inference(model, config={"dtype": "bfloat16"})
+    rs = np.random.RandomState(0)
+    tokens = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (B, prompt_len)), jnp.int32)
+    dt = _decode_window(engine, tokens, new_tokens)
+    decoded = new_tokens - 1
+    tok_s = B * decoded / dt
+    # bandwidth roofline: every decoded token reads all weights once
+    weight_bytes = model.cfg.num_params() * 2  # bf16
+    achieved_bw = (tok_s / B) * weight_bytes  # per-sequence steps are the bound
+
+    # A/B: REAL-int8 weight storage (W8A8 MXU path) — decode is bandwidth-
+    # bound, so int8 weights should push tokens/s toward 2x
+    extra_int8 = {}
+    try:
+        eng8 = deepspeed_tpu.init_inference(model, config={"dtype": "int8"})
+        dt8 = _decode_window(eng8, tokens, new_tokens)
+        extra_int8 = {
+            "int8_tokens_per_sec": round(B * decoded / dt8, 1),
+            "int8_speedup": round(dt / dt8, 3),
+        }
+    except Exception as e:
+        extra_int8 = {"int8_error": f"{type(e).__name__}: {e}"[:200]}
+
+    return {
+        "metric": "gpt2_350m_decode_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(achieved_bw / peak_bw(), 4),
+        "extra": {
+            "batch": B,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "ms_per_step": round(dt / max(new_tokens - 1, 1) * 1e3, 2),
+            "roofline_gbps": round(achieved_bw / 1e9, 1),
+            **extra_int8,
+        },
+    }
+
+
+def bench_hybrid_rlhf():
+    """RLHF hybrid-engine roundtrip: generate (rollout) + train step on the
+    same weights (BASELINE.json tracked config class; reference
+    DeepSpeed-Chat loop, hybrid_engine.py:168)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    seq, gen_tokens, micro_bs = (32, 8, 2) if _SMOKE else (256, 128, 4)
+    if _SMOKE:
+        model = _smoke_model(64)
+    else:
+        model = TransformerModel.from_preset(
+            "gpt2-125m", dtype="bfloat16", remat=True, remat_policy="dots_saveable", max_seq_len=1024
+        )
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-5}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "hybrid_engine": {"enabled": True},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    rs = np.random.RandomState(0)
+    n_dev = jax.device_count()
+    prompts = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (micro_bs * n_dev, seq)), jnp.int32)
+
+    def roundtrip():
+        rollout = engine.generate(prompts, max_new_tokens=gen_tokens)
+        batch = {"input_ids": np.asarray(rollout)}
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    loss = roundtrip()  # compile both programs
+    _sync(engine, loss)
+    iters = 2 if _SMOKE else 5
+    t0 = time.time()
+    for _ in range(iters):
+        loss = roundtrip()
+    _sync(engine, loss)
+    dt = (time.time() - t0) / iters
+    # end-to-end RLHF tokens/s: generated tokens pushed through rollout+train
+    tok_s = micro_bs * n_dev * gen_tokens / dt
+    return {
+        "metric": "rlhf_hybrid_rollout_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # reference reports wall-clock-to-train, not tok/s
+        "extra": {
+            "roundtrip_ms": round(dt * 1e3, 1),
+            "prompt_len": seq,
+            "gen_tokens": gen_tokens,
+            "micro_bs": micro_bs,
+            "loss": float(loss),
+        },
+    }
+
+
+def bench_bert_mlm():
+    """BERT-large MLM pretrain throughput — the reference's headline bench
+    (docs/_posts/2020-05-28-fastest-bert-training.md: 64 TFLOPS/V100 @ seq
+    128, 52% of peak per 2020-05-19-bert-record.md). Same task shape: seq
+    128, 15% tokens masked, samples/s + achieved TFLOPS per chip."""
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    seq, micro_bs = (64, 4) if _SMOKE else (128, int(os.environ.get("DSTPU_BENCH_BERT_BS", 64)))
+    if _SMOKE:
+        model = _smoke_model(seq, causal=False, norm_position="post", type_vocab_size=2,
+                             embed_norm=True)
+    else:
+        model = TransformerModel.from_preset("bert-large", dtype="bfloat16", max_seq_len=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    rs = np.random.RandomState(0)
+    n_dev = jax.device_count()
+    B = micro_bs * n_dev
+    ids = rs.randint(0, model.cfg.vocab_size, (B, seq)).astype(np.int32)
+    mask = (rs.rand(B, seq) < 0.15).astype(np.float32)
+    masked = np.where(mask > 0, 103, ids).astype(np.int32)  # [MASK] id
+    batch = {"input_ids": masked, "labels": ids, "loss_mask": mask,
+             "token_type_ids": np.zeros((B, seq), np.int32)}
+
+    toks, dt, loss, _ = _train_bench(model, config, micro_bs, seq,
+                                     iters=2 if _SMOKE else 20, batch=batch)
+    samples = toks / seq  # per chip
+    flops_per_sample = model.cfg.flops_per_token(seq) * seq
+    mfu = samples * flops_per_sample / peak_flops()
+    return {
+        "metric": "bert_large_mlm_samples_per_sec_per_chip",
+        "value": round(samples, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "tflops_per_chip": round(samples * flops_per_sample / 1e12, 1),
+            "seq_len": seq,
+            "micro_bs": micro_bs,
+            "step_ms": round(dt * 1e3, 2),
+            "loss": float(loss),
+            "reference": "64 TFLOPS/V100 (52% peak) seq128",
+        },
+    }
+
+
+def _gpt2_model(seq, attn, remat):
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    kw = dict(dtype="bfloat16", remat=remat, remat_policy="dots_saveable",
+              max_seq_len=seq, attn_impl=attn)
+    if _SMOKE:
+        return _smoke_model(seq, **{k: v for k, v in kw.items() if k != "max_seq_len"})
+    return TransformerModel.from_preset("gpt2-125m", **kw)
+
+
+def _gpt2_config(micro_bs):
+    return {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+
+
+_WINNER_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_winner.json")
+
+
+def _bench_digest():
+    """Cache-invalidation key: the probe winner is only valid for the code
+    that produced it — digest this file + the kernels/model the candidates
+    exercise, so any perf-relevant change re-probes."""
+    import hashlib
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for rel in ("_bench_impl.py", "deepspeed_tpu/ops/pallas/flash_attention.py",
+                "deepspeed_tpu/models/transformer.py", "deepspeed_tpu/runtime/engine.py"):
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())
+    return h.hexdigest()[:16]
+
+
+def _winner_key(device_kind):
+    # keyed by device kind AND count (ADVICE r3: a winner probed on a
+    # many-chip host — e.g. bs16 no-remat — can OOM replayed single-chip)
+    return f"{device_kind}/n{jax.device_count()}"
+
+
+def _cached_winner(device_kind):
+    try:
+        with open(_WINNER_CACHE) as f:
+            cache = json.load(f)
+        entry = cache.get(_winner_key(device_kind))
+        if entry and entry.get("digest") == _bench_digest():
+            return entry["attn"], entry["remat"], entry["bs"]
+    except Exception:
+        pass
+    return None
+
+
+def _save_winner(device_kind, attn, remat, bs):
+    try:
+        cache = {}
+        if os.path.exists(_WINNER_CACHE):
+            with open(_WINNER_CACHE) as f:
+                cache = json.load(f)
+        cache[_winner_key(device_kind)] = {"attn": attn, "remat": remat, "bs": bs,
+                                           "digest": _bench_digest()}
+        with open(_WINNER_CACHE, "w") as f:
+            json.dump(cache, f)
+    except Exception:
+        pass
+
+
+def bench_gpt2_train():
+    """Headline bench, SELF-TUNING: unless DSTPU_BENCH_ATTN pins a config,
+    briefly probe ≤3 candidate attention/remat/micro-batch configs (PERF.md
+    sweep: attention softmax HBM traffic + the dots_saveable remat stash are
+    the two dominant costs; the Pallas flash kernel removes both) and run
+    the full measurement on the winner. The winner is cached per device
+    kind in .bench_winner.json so later runs skip the probes entirely
+    (VERDICT r2 #1: bounded probe list). A failing candidate (e.g. OOM at
+    no-remat) is skipped, so the bench always reports a number."""
+    seq = 64 if _SMOKE else 1024
+    pinned_attn = os.environ.get("DSTPU_BENCH_ATTN")
+    pinned_remat = os.environ.get("DSTPU_BENCH_REMAT")
+    pinned_bs = os.environ.get("DSTPU_BENCH_BS")
+    default_bs = 2 if _SMOKE else 8
+    device_kind = jax.devices()[0].device_kind
+    cached = None if (pinned_attn or pinned_remat or pinned_bs or _SMOKE
+                      or os.environ.get("DSTPU_BENCH_NOCACHE") == "1") else _cached_winner(device_kind)
+    if pinned_attn or pinned_remat or _SMOKE:
+        # any explicit A/B pin disables self-tuning for that axis
+        attn = pinned_attn or "xla"
+        remat = (pinned_remat or "1") == "1"
+        candidates = [(attn, remat, int(pinned_bs or default_bs))]
+    elif cached is not None:
+        candidates = [cached]
+    else:
+        candidates = [
+            ("xla", True, 8),
+            ("pallas", False, 8),   # flash frees the logits stash: no-remat may fit
+            ("pallas", False, 16),
+        ]
+        if pinned_bs:
+            candidates = list(dict.fromkeys(
+                (a, r, int(pinned_bs)) for a, r, _ in candidates))
+
+    probes = {}
+    best = None
+    for attn, remat, bs in candidates:
+        try:
+            if len(candidates) == 1:
+                toks, dt, loss, _ = _train_bench(
+                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq,
+                    iters=2 if _SMOKE else 20)
+            else:
+                toks, dt, loss, _ = _train_bench(
+                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=5)
+            probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = round(toks, 1)
+            if best is None or toks > best[0]:
+                best = (toks, dt, loss, attn, remat, bs)
+        except Exception as e:
+            probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = f"{type(e).__name__}"[:40]
+    if best is None and cached is not None:
+        # the cached winner failed (e.g. OOM after a topology change that
+        # the key didn't capture): drop it and re-probe from scratch
+        for attn, remat, bs in [("xla", True, 8), ("pallas", False, 8), ("pallas", False, 16)]:
+            try:
+                toks, dt, loss, _ = _train_bench(
+                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=5)
+                probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = round(toks, 1)
+                if best is None or toks > best[0]:
+                    best = (toks, dt, loss, attn, remat, bs)
+            except Exception as e:
+                probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = f"{type(e).__name__}"[:40]
+        candidates = [None, None]  # >1 → triggers the full winner re-measurement below
+    assert best is not None, f"every bench candidate failed: {probes}"
+    toks, dt, loss, attn, remat, bs = best
+    if len(candidates) > 1:
+        # full measurement on the winning config
+        toks, dt, loss, _ = _train_bench(
+            _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=20)
+        _save_winner(device_kind, attn, remat, bs)
+
+    model = _gpt2_model(seq, attn, remat)
+    mfu = toks * model.cfg.flops_per_token(seq) / peak_flops()
+    return {
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(toks, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": loss,
+            "seq_len": seq,
+            "micro_bs": bs,
+            "attn_impl": attn,
+            "remat": remat,
+            "probes": probes,
+            "n_devices": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "step_ms": round(dt * 1e3, 2),
+        },
+    }
+
+
+def bench_probe():
+    """Relay health check: first device contact + a tiny matmul. Runs
+    before anything else, in its own child, so a dead relay costs the
+    suite <=150 s instead of the whole driver budget (r3: 25+ min hang)."""
+    t0 = time.time()
+    devs = jax.devices()
+    t_devices = time.time() - t0
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    val = float((x @ x).sum())
+    return {
+        "metric": "relay_probe_ok",
+        "value": round(time.time() - t0, 1),
+        "unit": "s",
+        "vs_baseline": None,
+        "extra": {
+            "device_kind": devs[0].device_kind,
+            "n_devices": len(devs),
+            "platform": devs[0].platform,
+            "devices_s": round(t_devices, 1),
+            "matmul_checksum": val,
+        },
+    }
+
+
+def bench_primary_fallback():
+    """Pinned single-config headline measurement — the always-a-number
+    path when the self-tuning primary child dies or times out."""
+    os.environ["DSTPU_BENCH_ATTN"] = os.environ.get("DSTPU_BENCH_ATTN", "xla")
+    os.environ["DSTPU_BENCH_REMAT"] = os.environ.get("DSTPU_BENCH_REMAT", "1")
+    return bench_gpt2_train()
+
+
+def _zero3_offload_with_parent_budget():
+    # the parent tells the child its actual kill deadline so the
+    # bandwidth pre-probe sizes against the real budget, not a constant
+    budget = int(os.environ.get("DSTPU_BENCH_PHASE_BUDGET", "240"))
+    return bench_zero3_offload(budget_s=budget)
+
+
+PHASES = {
+    "probe": bench_probe,
+    "primary": bench_gpt2_train,
+    "primary_fallback": bench_primary_fallback,
+    "decode": bench_decode,
+    "bert_mlm": bench_bert_mlm,
+    "moe_ep": bench_moe_ep,
+    "hybrid_rlhf": bench_hybrid_rlhf,
+    "zero3_offload": _zero3_offload_with_parent_budget,
+}
+
+RESULT_SENTINEL = "DSTPU_RESULT "
+
+
+def run_phase(name: str) -> int:
+    result = PHASES[name]()
+    print(RESULT_SENTINEL + json.dumps(result), flush=True)
+    return 0

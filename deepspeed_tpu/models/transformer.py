@@ -447,8 +447,13 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
         if mesh.shape.get("sequence", 1) > 1:
             if cfg.pos_embedding == "alibi":
                 raise NotImplementedError("ALiBi bias is not supported under sequence parallelism")
+            if window is not None:
+                raise NotImplementedError(
+                    "local attention windows are not supported under sequence parallelism"
+                )
             return sequence_parallel_attention(
-                q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh, attn_impl=cfg.attn_impl
+                q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh,
+                attn_impl=cfg.attn_impl, sm_scale=cfg.attn_scale,
             )
     if window is None and cfg.attn_impl == "block_sparse":
         # layout-aware Pallas kernel: long-sequence training/prefill path
@@ -463,11 +468,12 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
             v = jnp.repeat(v, nh // nkv, axis=2)
         layout, block = _sparse_layout(cfg.sparse_attention or (("mode", "fixed"),), nh, S)
         # kernel convention matches the model: (B, S, H, hd)
-        return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block)
+        return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block,
+                                      sm_scale=cfg.attn_scale)
     if window is None and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=cfg.causal)
+        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
@@ -642,6 +648,7 @@ def _layer_body(x, layer_params, cfg: TransformerConfig, positions, dropout_rng,
 
 # policy registry lives in runtime/activation_checkpointing (shared with the
 # engine's configure() surface; adds host-offload as policy name "offload")
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as _ckpt  # noqa: E402
 from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import resolve_policy as _resolve_remat_policy  # noqa: E402
 
 
@@ -706,6 +713,20 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     layer_fn = layer_with_routing
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy), static_argnums=())
+    if _ckpt.partition_activations_enabled():
+        # partition_activations (reference checkpointing.py:366): shard the
+        # layer-boundary residual over tensor(+sequence) so the saved stash
+        # is 1/TP and GSPMD swaps the layer allreduce for AG+RS
+        _inner_fn = layer_fn
+
+        def layer_fn(x_in, *rest):  # noqa: F811
+            return _inner_fn(_ckpt.partition_saved_activation(x_in), *rest)
+    if _ckpt.profile_enabled():
+        _profiled_fn = layer_fn
+
+        def layer_fn(x_in, *rest):  # noqa: F811
+            with jax.named_scope("checkpoint_layer"):
+                return _profiled_fn(x_in, *rest)
 
     layers = _cast_layers(params["layers"], dtype)
     needs_rng = (
@@ -935,7 +956,8 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     if use_flash_prefill:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        attn_out = flash_attention(q, k, v, causal=True).reshape(B, S, nh * hd)
+        attn_out = flash_attention(q, k, v, causal=True,
+                                   sm_scale=cfg.attn_scale).reshape(B, S, nh * hd)
         attn_out = _linear(attn_out, attn_p["wo"])
         if cfg.use_bias:
             attn_out = attn_out + attn_p["bo"]

@@ -23,7 +23,7 @@ from deepspeed_tpu.ops.transformer.fused_ops import fused_softmax
 
 
 def apply_rotary_pos_emb(x, positions, theta: float = 10000.0,
-                         rot_dim: Optional[int] = None, interleaved: bool = False):
+                         rot_dim: Optional[int] = None, interleaved: bool = True):
     """Rotary embedding over x (B, S, H, hd) at absolute ``positions`` (B, S).
 
     ``rot_dim`` rotates only the first rot_dim dims of each head (GPT-J /
@@ -31,10 +31,10 @@ def apply_rotary_pos_emb(x, positions, theta: float = 10000.0,
     instead of first/second half (llama / NeoX). Reference analogue:
     csrc/transformer/inference apply_rotary_pos_emb.cu.
 
-    NOTE (convention change): this surface previously always paired
-    even/odd dims; the unified implementation defaults to the half-split
-    convention (``interleaved=False``). Callers relying on the old
-    behavior must pass ``interleaved=True``.
+    The public default is ``interleaved=True`` — the even/odd pairing this
+    op surface has always had (ADVICE r3: changing it silently would break
+    external registry callers). Model code passes ``cfg.rope_interleaved``
+    explicitly, so half-split archs (llama / NeoX) are unaffected.
     """
     B, S, H, hd = x.shape
     rd = hd if rot_dim is None else rot_dim

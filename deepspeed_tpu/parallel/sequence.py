@@ -41,7 +41,8 @@ def _pcast_varying(tree, axis_name):
     return tree
 
 
-def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sequence"):
+def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sequence",
+                   sm_scale: Optional[float] = None):
     """Blockwise ring attention over ``axis_name`` (call inside shard_map).
 
     q: (B, S_local, H, hd); k/v: (B, S_local, Hkv, hd). Returns
@@ -55,7 +56,7 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sequence"):
         rep = H // nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scale = 1.0 / math.sqrt(hd)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
     q32 = q.astype(jnp.float32)
     qpos = my * Sq + jnp.arange(Sq)
 
@@ -88,7 +89,8 @@ def ring_attention(q, k, v, causal: bool = True, axis_name: str = "sequence"):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sequence", attn_fn=None):
+def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sequence", attn_fn=None,
+                      sm_scale: Optional[float] = None):
     """DeepSpeed-Ulysses-style all-to-all attention (call inside shard_map).
 
     Re-shards (B, S/n, H, hd) -> (B, S, H/n, hd), runs full-sequence
@@ -104,15 +106,15 @@ def ulysses_attention(q, k, v, causal: bool = True, axis_name: str = "sequence",
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True)
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
     if attn_fn is None:
-        attn_fn = _full_causal_attention if causal else partial(_full_causal_attention, causal=False)
+        attn_fn = partial(_full_causal_attention, causal=causal, sm_scale=sm_scale)
     out = attn_fn(qh, kh, vh)
     # scatter sequence, gather heads
     return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-def _full_causal_attention(q, k, v, causal: bool = True):
+def _full_causal_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
     B, S, H, hd = q.shape
-    scale = 1.0 / math.sqrt(hd)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
@@ -130,6 +132,7 @@ def sequence_parallel_attention(
     mesh=None,
     seq_axis: str = "sequence",
     attn_impl: str = "xla",
+    sm_scale: Optional[float] = None,
 ):
     """Top-level SPMD entry: q/k/v are (B, S, H, hd) global arrays; the
     attention runs sequence-parallel over ``seq_axis`` via partial-manual
@@ -143,7 +146,7 @@ def sequence_parallel_attention(
         mesh = comm.get_mesh()
     n = mesh.shape[seq_axis]
     if n <= 1:
-        return _full_causal_attention(q, k, v, causal=causal)
+        return _full_causal_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     S = q.shape[1]
     assert S % n == 0, f"seq len {S} must divide over {n} sequence shards"
     if impl == "ulysses":
@@ -152,10 +155,11 @@ def sequence_parallel_attention(
         if attn_impl == "pallas":
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            attn_fn = partial(flash_attention, causal=causal, vma=(seq_axis,))
-        local = partial(ulysses_attention, causal=causal, axis_name=seq_axis, attn_fn=attn_fn)
+            attn_fn = partial(flash_attention, causal=causal, sm_scale=sm_scale, vma=(seq_axis,))
+        local = partial(ulysses_attention, causal=causal, axis_name=seq_axis, attn_fn=attn_fn,
+                        sm_scale=sm_scale)
     elif impl == "ring":
-        local = partial(ring_attention, causal=causal, axis_name=seq_axis)
+        local = partial(ring_attention, causal=causal, axis_name=seq_axis, sm_scale=sm_scale)
     else:
         raise ValueError(f"unknown sequence-parallel impl '{impl}' (ring | ulysses)")
     spec = PartitionSpec(None, seq_axis, None, None)

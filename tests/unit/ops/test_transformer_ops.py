@@ -91,6 +91,29 @@ class TestInferenceOps:
         # position 0 is identity
         np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(x[:, 0]), rtol=1e-6)
 
+    def test_rotary_convention_pinned(self):
+        """The registry op's DEFAULT pairing is interleaved (even/odd, GPT-J
+        style) — pinned with exact expected values so a silent convention
+        change breaks loudly (ADVICE r3). Half-split must differ."""
+        from deepspeed_tpu.ops.transformer.inference_ops import apply_rotary_pos_emb
+
+        hd = 4
+        x = jnp.arange(1 * 1 * 1 * hd, dtype=jnp.float32).reshape(1, 1, 1, hd) + 1.0
+        pos = jnp.ones((1, 1), jnp.int32)  # position 1, theta default
+        out_default = np.asarray(apply_rotary_pos_emb(x, pos))[0, 0, 0]
+        # interleaved: pairs (x0,x1) rot by angle 1, (x2,x3) by angle 1/theta^(1/2)
+        c1, s1 = np.cos(1.0), np.sin(1.0)
+        th = 10000.0 ** (-1 / 2)
+        c2, s2 = np.cos(th), np.sin(th)
+        want_interleaved = np.array([1 * c1 - 2 * s1, 2 * c1 + 1 * s1,
+                                     3 * c2 - 4 * s2, 4 * c2 + 3 * s2], np.float32)
+        np.testing.assert_allclose(out_default, want_interleaved, rtol=1e-5)
+        # half-split pairs (x0,x2) and (x1,x3) — must be different
+        out_half = np.asarray(apply_rotary_pos_emb(x, pos, interleaved=False))[0, 0, 0]
+        want_half = np.array([1 * c1 - 3 * s1, 2 * c2 - 4 * s2,
+                              3 * c1 + 1 * s1, 4 * c2 + 2 * s2], np.float32)
+        np.testing.assert_allclose(out_half, want_half, rtol=1e-5)
+
     def test_kv_cache_update(self):
         from deepspeed_tpu.ops.transformer.inference_ops import update_kv_cache
 
