@@ -92,14 +92,21 @@ def _sync(engine, loss):
     return float(loss) + float(jnp.sum(jax.tree.leaves(engine.params)[0]))
 
 
-def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None):
+def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None,
+                 timings=None):
     """Shared measurement protocol (warmup, host-transfer sync barrier,
     timed loop) for every training bench; ``batch`` overrides the default
-    causal-LM batch (the MLM bench passes labels/loss_mask/token_types)."""
+    causal-LM batch (the MLM bench passes labels/loss_mask/token_types).
+    ``timings``: optional dict filled with the phase breakdown
+    (init_s / warmup_s / step_s) so a timed-out run tells us WHERE the
+    budget went (VERDICT r3 #3)."""
     assert warmup_steps >= 1, "at least one warmup step (compile) is required"
     import deepspeed_tpu
 
+    t_init0 = time.time()
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    jax.block_until_ready(engine.params)
+    t_init = time.time() - t_init0
     rs = np.random.RandomState(0)
     n_dev = jax.device_count()
     if batch is None:
@@ -111,14 +118,20 @@ def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None
         engine.step()
         return loss
 
+    t_warm0 = time.time()
     for _ in range(warmup_steps):
         loss = step()
     _sync(engine, loss)
+    t_warm = time.time() - t_warm0
     t0 = time.time()
     for _ in range(iters):
         loss = step()
     _sync(engine, loss)
     dt = (time.time() - t0) / iters
+    if timings is not None:
+        timings["init_s"] = round(t_init, 1)
+        timings["warmup_s"] = round(t_warm, 1)
+        timings["step_s"] = round(dt, 2)
     toks = micro_bs * n_dev * seq / dt
     return toks / n_dev, dt, float(loss), engine
 
@@ -192,7 +205,9 @@ def bench_zero3_offload(budget_s=240):
         "steps_per_print": 1000000,
         "mesh": {"data": -1},
     }
-    toks, dt, loss, engine = _train_bench(model, config, micro_bs, seq, iters=2)
+    timings = {}
+    toks, dt, loss, engine = _train_bench(model, config, micro_bs, seq, iters=2,
+                                          timings=timings)
     n_params = model.cfg.num_params()
     mfu = toks * model.flops_per_token(seq) / peak_flops()
     return {
@@ -207,6 +222,7 @@ def bench_zero3_offload(budget_s=240):
             "step_ms": round(dt * 1e3, 1),
             "offload": "cpu",
             "loss": loss,
+            **timings,
         },
     }
 
@@ -423,11 +439,11 @@ def bench_bert_mlm():
     }
 
 
-def _gpt2_model(seq, attn, remat):
+def _gpt2_model(seq, attn, remat, block=None):
     from deepspeed_tpu.models.transformer import TransformerModel
 
     kw = dict(dtype="bfloat16", remat=remat, remat_policy="dots_saveable",
-              max_seq_len=seq, attn_impl=attn)
+              max_seq_len=seq, attn_impl=attn, flash_block=block)
     if _SMOKE:
         return _smoke_model(seq, **{k: v for k, v in kw.items() if k != "max_seq_len"})
     return TransformerModel.from_preset("gpt2-125m", **kw)
@@ -478,20 +494,20 @@ def _cached_winner(device_kind):
             cache = json.load(f)
         entry = cache.get(_winner_key(device_kind))
         if entry and entry.get("digest") == _bench_digest():
-            return entry["attn"], entry["remat"], entry["bs"]
+            return entry["attn"], entry["remat"], entry["bs"], entry.get("block")
     except Exception:
         pass
     return None
 
 
-def _save_winner(device_kind, attn, remat, bs):
+def _save_winner(device_kind, attn, remat, bs, block=None):
     try:
         cache = {}
         if os.path.exists(_WINNER_CACHE):
             with open(_WINNER_CACHE) as f:
                 cache = json.load(f)
         cache[_winner_key(device_kind)] = {"attn": attn, "remat": remat, "bs": bs,
-                                           "digest": _bench_digest()}
+                                           "block": block, "digest": _bench_digest()}
         with open(_WINNER_CACHE, "w") as f:
             json.dump(cache, f)
     except Exception:
@@ -511,65 +527,65 @@ def bench_gpt2_train():
     pinned_attn = os.environ.get("DSTPU_BENCH_ATTN")
     pinned_remat = os.environ.get("DSTPU_BENCH_REMAT")
     pinned_bs = os.environ.get("DSTPU_BENCH_BS")
+    pinned_block = os.environ.get("DSTPU_BENCH_FLASH_BLOCK")
     default_bs = 2 if _SMOKE else 8
     device_kind = jax.devices()[0].device_kind
     cached = None if (pinned_attn or pinned_remat or pinned_bs or _SMOKE
                       or os.environ.get("DSTPU_BENCH_NOCACHE") == "1") else _cached_winner(device_kind)
+    # PERF.md sweep: flash kernel (no softmax HBM traffic, no 2.4 GB remat
+    # stash) at bs 8/16 and tile 128(default)/256
+    sweep = [
+        ("xla", True, 8, None),
+        ("pallas", False, 8, None),   # flash frees the logits stash: no-remat may fit
+        ("pallas", False, 8, 256),
+        ("pallas", False, 16, None),
+    ]
     if pinned_attn or pinned_remat or _SMOKE:
         # any explicit A/B pin disables self-tuning for that axis
         attn = pinned_attn or "xla"
         remat = (pinned_remat or "1") == "1"
-        candidates = [(attn, remat, int(pinned_bs or default_bs))]
+        candidates = [(attn, remat, int(pinned_bs or default_bs),
+                       int(pinned_block) if pinned_block else None)]
     elif cached is not None:
         candidates = [cached]
     else:
-        candidates = [
-            ("xla", True, 8),
-            ("pallas", False, 8),   # flash frees the logits stash: no-remat may fit
-            ("pallas", False, 16),
-        ]
+        candidates = sweep
         if pinned_bs:
             candidates = list(dict.fromkeys(
-                (a, r, int(pinned_bs)) for a, r, _ in candidates))
+                (a, r, int(pinned_bs), blk) for a, r, _, blk in candidates))
 
     probes = {}
     best = None
-    for attn, remat, bs in candidates:
-        try:
-            if len(candidates) == 1:
+
+    def _probe(cand_list, iters):
+        nonlocal best
+        for attn, remat, bs, blk in cand_list:
+            key = f"{attn}{'+remat' if remat else ''}{f'+blk{blk}' if blk else ''}@bs{bs}"
+            try:
                 toks, dt, loss, _ = _train_bench(
-                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq,
-                    iters=2 if _SMOKE else 20)
-            else:
-                toks, dt, loss, _ = _train_bench(
-                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=5)
-            probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = round(toks, 1)
-            if best is None or toks > best[0]:
-                best = (toks, dt, loss, attn, remat, bs)
-        except Exception as e:
-            probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = f"{type(e).__name__}"[:40]
+                    _gpt2_model(seq, attn, remat, blk), _gpt2_config(bs), bs, seq,
+                    iters=iters)
+                probes[key] = round(toks, 1)
+                if best is None or toks > best[0]:
+                    best = (toks, dt, loss, attn, remat, bs, blk)
+            except Exception as e:
+                probes[key] = f"{type(e).__name__}"[:40]
+
+    _probe(candidates, iters=(2 if _SMOKE else 20) if len(candidates) == 1 else 5)
     if best is None and cached is not None:
         # the cached winner failed (e.g. OOM after a topology change that
         # the key didn't capture): drop it and re-probe from scratch
-        for attn, remat, bs in [("xla", True, 8), ("pallas", False, 8), ("pallas", False, 16)]:
-            try:
-                toks, dt, loss, _ = _train_bench(
-                    _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=5)
-                probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = round(toks, 1)
-                if best is None or toks > best[0]:
-                    best = (toks, dt, loss, attn, remat, bs)
-            except Exception as e:
-                probes[f"{attn}{'+remat' if remat else ''}@bs{bs}"] = f"{type(e).__name__}"[:40]
+        _probe(sweep, iters=5)
         candidates = [None, None]  # >1 → triggers the full winner re-measurement below
     assert best is not None, f"every bench candidate failed: {probes}"
-    toks, dt, loss, attn, remat, bs = best
+    toks, dt, loss, attn, remat, bs, blk = best
     if len(candidates) > 1:
         # full measurement on the winning config
         toks, dt, loss, _ = _train_bench(
-            _gpt2_model(seq, attn, remat), _gpt2_config(bs), bs, seq, iters=20)
-        _save_winner(device_kind, attn, remat, bs)
+            _gpt2_model(seq, attn, remat, blk), _gpt2_config(bs), bs, seq, iters=20)
+        _save_winner(device_kind, attn, remat, bs, blk)
 
-    model = _gpt2_model(seq, attn, remat)
+    model = _gpt2_model(seq, attn, remat, blk)
     mfu = toks * model.cfg.flops_per_token(seq) / peak_flops()
     return {
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
@@ -583,6 +599,7 @@ def bench_gpt2_train():
             "micro_bs": bs,
             "attn_impl": attn,
             "remat": remat,
+            "flash_block": blk,
             "probes": probes,
             "n_devices": jax.device_count(),
             "device_kind": jax.devices()[0].device_kind,

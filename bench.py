@@ -174,11 +174,14 @@ def main():
                                      extra_env={"DSTPU_BENCH_PHASE_BUDGET": str(cap)})
             if result is not None:
                 print(json.dumps(result), flush=True)
-                suite[result["metric"]] = {"value": result["value"],
-                                           "vs_baseline": result.get("vs_baseline")}
-                if cacheable:
-                    cache["suite"] = suite
-                    _save_lastgood(cache)
+                # diagnostic lines (value None / *_skipped) are printed but
+                # never recorded as metrics
+                if result.get("value") is not None and not result["metric"].endswith("_skipped"):
+                    suite[result["metric"]] = {"value": result["value"],
+                                               "vs_baseline": result.get("vs_baseline")}
+                    if cacheable:
+                        cache["suite"] = suite
+                        _save_lastgood(cache)
             else:  # a broken secondary must not kill the headline metric
                 print(json.dumps({"metric": f"bench_{name}_error", "error": err}), flush=True)
 

@@ -1,0 +1,76 @@
+// Host-side vectorized + threaded Adagrad for ZeRO-Offload.
+//
+// TPU-native counterpart of the reference's csrc/adagrad/cpu_adagrad.cpp
+// (Adagrad_Optimizer::Step_1 AVX path, cpu_adagrad.cpp:24): the optimizer
+// hot loop for Adagrad states living in host RAM. Same design as
+// csrc/adam/cpu_adam.cpp: flat `#pragma omp simd` inner loops auto-
+// vectorized by g++ -O3 -march=native, std::thread outer tiling (no
+// libgomp dependency), per-element independence makes the threaded result
+// bit-identical to single-threaded.
+//
+// C ABI (loaded via ctypes from deepspeed_tpu/ops/adagrad/cpu_adagrad.py):
+//   ds_adagrad_step(params, grads, sum_sq, n, lr, eps, weight_decay,
+//                   grad_scale)
+// grad_scale multiplies each gradient element inline (fuses the host-side
+// accumulation divide + clip factor into the update, one read per grad).
+// All buffers are float32, updated in place (params included).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr long long kMinChunk = 1 << 18;  // 256K floats = 1MB per thread min
+
+int thread_count(long long n) {
+  const char* env = std::getenv("DSTPU_CPU_ADAM_THREADS");
+  long long want = env ? std::atoll(env) : (long long)std::thread::hardware_concurrency();
+  if (want < 1) want = 1;
+  long long by_size = (n + kMinChunk - 1) / kMinChunk;
+  return (int)std::min(want, std::max(1LL, by_size));
+}
+
+template <typename F>
+void parallel_for(long long n, F fn) {
+  int t = thread_count(n);
+  if (t <= 1) {
+    fn(0, n);
+    return;
+  }
+  long long chunk = (n + t - 1) / t;
+  std::vector<std::thread> pool;
+  pool.reserve(t - 1);
+  for (int i = 1; i < t; ++i) {
+    long long lo = i * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([=] { fn(lo, hi); });
+  }
+  fn(0, std::min(n, chunk));
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+void ds_adagrad_step(float* params, const float* grads, float* sum_sq,
+                     long long n, float lr, float eps, float weight_decay,
+                     float grad_scale) {
+  const float wd = weight_decay;
+  const float gs = grad_scale;
+  parallel_for(n, [=](long long lo, long long hi) {
+#pragma omp simd
+    for (long long i = lo; i < hi; ++i) {
+      float g = grads[i] * gs;
+      if (wd > 0.0f) g += wd * params[i];
+      float s = sum_sq[i] + g * g;
+      sum_sq[i] = s;
+      params[i] -= lr * g / (std::sqrt(s) + eps);
+    }
+  });
+}
+
+}  // extern "C"

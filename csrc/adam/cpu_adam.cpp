@@ -115,17 +115,4 @@ void ds_adam_step(float* params, const float* grads, float* exp_avg,
   }
 }
 
-// Adagrad variant (reference csrc/adagrad/cpu_adagrad.cpp)
-void ds_adagrad_step(float* params, const float* grads, float* sum_sq,
-                     long long n, float lr, float eps, float weight_decay) {
-#pragma omp simd
-  for (long long i = 0; i < n; ++i) {
-    float g = grads[i];
-    if (weight_decay > 0.0f) g += weight_decay * params[i];
-    float s = sum_sq[i] + g * g;
-    sum_sq[i] = s;
-    params[i] -= lr * g / (std::sqrt(s) + eps);
-  }
-}
-
 }  // extern "C"

@@ -1,0 +1,76 @@
+"""Per-rank shim for the MPI-family launchers.
+
+Reference: ``deepspeed/comm/comm.py:591`` ``mpi_discovery`` — under
+``mpirun`` each rank discovers its identity from the MPI environment
+instead of a per-node launcher. This shim translates the OpenMPI / MPICH /
+MVAPICH / PMI rank variables into the DSTPU rendezvous env
+(``DSTPU_COORDINATOR`` / ``DSTPU_NUM_PROCESSES`` / ``DSTPU_PROCESS_ID``
+plus the reference-compat ``RANK``/``WORLD_SIZE``/…), then execs the user
+command in place:
+
+    mpirun -n 8 -hostfile hf python -m deepspeed_tpu.launcher.mpi_shim \\
+        --coordinator host0:29500 train.py --args
+
+No mpi4py import: the launcher already knows the coordinator, and the MPI
+runtime already exported the rank — reading env beats initializing MPI in
+a process that only wants JAX collectives.
+"""
+
+import argparse
+import os
+import sys
+
+
+# (rank, size, local_rank) env candidates, checked in order:
+_RANK_VARS = ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "MV2_COMM_WORLD_RANK", "PMIX_RANK")
+_SIZE_VARS = ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "MV2_COMM_WORLD_SIZE")
+_LOCAL_VARS = ("OMPI_COMM_WORLD_LOCAL_RANK", "MPI_LOCALRANKID", "MV2_COMM_WORLD_LOCAL_RANK")
+
+
+def _first_env(names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return v
+    return default
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="dstpu MPI rank shim")
+    parser.add_argument("--coordinator", required=True, help="host:port of rank 0")
+    parser.add_argument("--no_python", action="store_true")
+    parser.add_argument("--module", action="store_true")
+    parser.add_argument("user_script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    rank = _first_env(_RANK_VARS)
+    size = _first_env(_SIZE_VARS)
+    if rank is None or size is None:
+        raise RuntimeError(
+            "no MPI rank environment found (expected one of "
+            f"{_RANK_VARS}/{_SIZE_VARS}); run under mpirun, or use --launcher ssh"
+        )
+    local = _first_env(_LOCAL_VARS, "0")
+    host, port = args.coordinator.rsplit(":", 1)
+    os.environ.update({
+        "DSTPU_COORDINATOR": args.coordinator,
+        "DSTPU_NUM_PROCESSES": size,
+        "DSTPU_PROCESS_ID": rank,
+        "RANK": rank,
+        "LOCAL_RANK": local,
+        "WORLD_SIZE": size,
+        "MASTER_ADDR": host,
+        "MASTER_PORT": port,
+    })
+    if args.no_python:
+        cmd = [args.user_script] + args.user_args
+    elif args.module:
+        cmd = [sys.executable, "-u", "-m", args.user_script] + args.user_args
+    else:
+        cmd = [sys.executable, "-u", args.user_script] + args.user_args
+    os.execvpe(cmd[0], cmd, os.environ)
+
+
+if __name__ == "__main__":
+    main()

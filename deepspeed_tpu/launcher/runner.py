@@ -54,7 +54,8 @@ def parse_args(args=None):
                         help="coordinator address (default: first host)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=("ssh", "pdsh", "slurm", "tpu-pod", "local"))
+                        choices=("ssh", "pdsh", "slurm", "tpu-pod", "local",
+                                 "openmpi", "mpich", "mvapich"))
     parser.add_argument("--tpu_name", type=str, default=os.environ.get("TPU_NAME", ""),
                         help="TPU pod slice name for --launcher tpu-pod")
     parser.add_argument("--zone", type=str, default="", help="GCP zone for tpu-pod")
@@ -217,6 +218,43 @@ def build_multinode_cmds(args, active: Dict[str, List[int]], master_addr: str) -
     return cmds
 
 
+MPI_LAUNCHERS = ("openmpi", "mpich", "mvapich")
+
+
+def build_mpi_cmd(args, active: Dict[str, List[int]], master_addr: str,
+                  hostfile_path: str) -> List[str]:
+    """Single mpirun command spanning every host (reference
+    multinode_runner.py:107 OpenMPIRunner / :160 MPICHRunner /
+    :208 MVAPICHRunner). Each rank goes through launcher/mpi_shim.py,
+    which maps the MPI rank env onto the DSTPU rendezvous env."""
+    total = sum(len(s) for s in active.values())
+    with open(hostfile_path, "w") as f:
+        for host, slots in active.items():
+            if args.launcher == "openmpi":
+                f.write(f"{host} slots={len(slots)}\n")
+            else:  # mpich / mvapich hostfile syntax
+                f.write(f"{host}:{len(slots)}\n")
+    exports = [k for k in EXPORT_ENVS if k in os.environ]
+    if args.launcher == "openmpi":
+        cmd = ["mpirun", "-n", str(total), "-hostfile", hostfile_path,
+               "--allow-run-as-root"]
+        for k in exports:
+            cmd += ["-x", k]
+    else:
+        cmd = ["mpirun", "-n", str(total), "-f", hostfile_path]
+        for k in exports:
+            cmd += ["-genv", k, os.environ[k]]
+        if args.launcher == "mvapich":
+            cmd += ["-genv", "MV2_SUPPORT_DL", "1"]
+    shim = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.mpi_shim",
+            f"--coordinator={master_addr}:{args.master_port}"]
+    if args.no_python:
+        shim.append("--no_python")
+    if args.module:
+        shim.append("--module")
+    return cmd + shim + [args.user_script] + args.user_args
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.elastic:
@@ -236,6 +274,14 @@ def main(argv=None):
     if not active:
         raise RuntimeError("no hosts left after filtering")
     master_addr = args.master_addr or list(active)[0]
+
+    if args.launcher in MPI_LAUNCHERS:
+        import tempfile
+
+        hf = os.path.join(tempfile.gettempdir(), f"dstpu_mpi_hostfile_{os.getpid()}")
+        cmd = build_mpi_cmd(args, active, master_addr, hf)
+        logger.info(f"dstpu {args.launcher} launch: {' '.join(cmd[:8])} ...")
+        sys.exit(subprocess.call(cmd))
 
     multi_node = args.force_multi or len(active) > 1 or args.launcher == "tpu-pod"
     if not multi_node:

@@ -76,6 +76,10 @@ class TransformerConfig:
     # 0 = global, W = attend only the last W positions). Tuple of
     # num_layers ints; None = all-global.
     local_attn_windows: Optional[tuple] = None
+    # flash-attention tile size (PERF.md block sweep; None = kernel default
+    # of 128). Larger tiles amortize the softmax running-max bookkeeping
+    # against HBM re-reads of K/V; the bench self-tune probes this.
+    flash_block: Optional[int] = None
     # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -84,6 +88,9 @@ class TransformerConfig:
     moe_aux_loss_coef: float = 0.01
     moe_drop_tokens: bool = True
     moe_use_rts: bool = False  # random token selection needs an rng at loss()
+    # PR-MoE residual mixing (reference moe/layer.py:28,45): dense MLP +
+    # expert mix with a learned per-token 2-way softmax coefficient
+    moe_use_residual: bool = False
     # --- sequence/context parallelism (parallel/sequence.py) ---
     seq_parallel: str = "none"  # none | ring | ulysses
     # --- QAT activation fake-quant bits, 0 = off (compression/ wiring) ---
@@ -130,10 +137,18 @@ class TransformerConfig:
         attn = D * D + 2 * D * kvd + D * D  # q,k,v,o
         mlp = (3 if self.activation == "silu_glu" else 2) * D * F
         if self.moe_num_experts > 0:
+            dense_mlp = mlp
             mlp = mlp * self.moe_num_experts + D * self.moe_num_experts  # experts + router
+            if self.moe_use_residual:
+                mlp += dense_mlp + 2 * D + 2  # residual MLP + coefficient
         per_layer = attn + mlp + 2 * D  # + ln scales
         if self.use_bias:
-            per_layer += (D + 2 * kvd + D) + (F + D) + 2 * D  # attn/mlp/ln biases
+            mlp_bias = F + D
+            if self.moe_num_experts > 0:
+                mlp_bias *= self.moe_num_experts  # per-expert bi/bo
+                if self.moe_use_residual:
+                    mlp_bias += F + D  # dense residual MLP biases
+            per_layer += (D + 2 * kvd + D) + mlp_bias + 2 * D  # attn/mlp/ln biases
         emb = V * D + (self.max_seq_len * D if self.pos_embedding == "learned" else 0)
         emb += self.type_vocab_size * D
         if self.embed_norm:
@@ -261,6 +276,15 @@ def _init_one_layer(key, cfg: TransformerConfig):
         }
         if cfg.activation == "silu_glu":
             mlp["wg"] = experts(lambda k: dense(k, (D, F), D))
+        if cfg.moe_use_residual:
+            # PR-MoE (reference moe/layer.py:28,45): dense residual MLP +
+            # per-token 2-way mixing coefficient
+            mlp["res_wi"] = dense(next(ks), (D, F), D)
+            mlp["res_wo"] = dense(next(ks), (F, D), F) / math.sqrt(2 * L)
+            if cfg.activation == "silu_glu":
+                mlp["res_wg"] = dense(next(ks), (D, F), D)
+            mlp["coef_w"] = jax.random.normal(next(ks), (D, 2), jnp.float32) * 0.02
+            mlp["coef_b"] = jnp.zeros((2,), jnp.float32)
     else:
         mlp = {
             "wi": dense(next(ks), (D, F), D),
@@ -288,6 +312,9 @@ def _init_one_layer(key, cfg: TransformerConfig):
         if E > 0:
             layer["mlp"]["bi"] = jnp.zeros((E, F), jnp.float32)
             layer["mlp"]["bo"] = jnp.zeros((E, D), jnp.float32)
+            if cfg.moe_use_residual:
+                layer["mlp"]["res_bi"] = jnp.zeros((F,), jnp.float32)
+                layer["mlp"]["res_bo"] = jnp.zeros((D,), jnp.float32)
         else:
             layer["mlp"]["bi"] = jnp.zeros((F,), jnp.float32)
             layer["mlp"]["bo"] = jnp.zeros((D,), jnp.float32)
@@ -333,12 +360,16 @@ def logical_specs(params, cfg: TransformerConfig):
             }
             return pre + table[last]
         if "mlp" in names:
-            if cfg.moe_num_experts > 0 and last != "gate":
+            if cfg.moe_num_experts > 0 and last in ("wi", "wg", "wo", "bi", "bo"):
                 table = {"wi": ("expert", "embed", "mlp"), "wg": ("expert", "embed", "mlp"),
                          "wo": ("expert", "mlp", "embed"), "bi": ("expert", "mlp"), "bo": ("expert", "embed")}
                 return pre + table[last]
             table = {"wi": ("embed", "mlp"), "wg": ("embed", "mlp"), "wo": ("mlp", "embed"),
-                     "bi": ("mlp",), "bo": ("embed",), "gate": ("embed", None)}
+                     "bi": ("mlp",), "bo": ("embed",), "gate": ("embed", None),
+                     # PR-MoE residual MLP + mixing coefficient (dense)
+                     "res_wi": ("embed", "mlp"), "res_wg": ("embed", "mlp"),
+                     "res_wo": ("mlp", "embed"), "res_bi": ("mlp",), "res_bo": ("embed",),
+                     "coef_w": ("embed", None), "coef_b": (None,)}
             return pre + table[last]
         if "ln1" in names or "ln2" in names:
             return pre + ("norm",)
@@ -473,7 +504,8 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     if window is None and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale)
+        blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
+        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale, **blk)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
@@ -524,7 +556,10 @@ def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False)
                 out = out + ep["bo"]
             return out
 
-        expert_params = {k: v for k, v in mlp_p.items() if k != "gate"}
+        _residual_keys = ("res_wi", "res_wg", "res_wo", "res_bi", "res_bo",
+                          "coef_w", "coef_b")
+        expert_params = {k: v for k, v in mlp_p.items()
+                         if k != "gate" and k not in _residual_keys}
         mlp_out, aux, _ = moe_forward(
             h,
             mlp_p["gate"],
@@ -537,6 +572,14 @@ def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False)
             use_rts=cfg.moe_use_rts and not decode,
             drop_tokens=cfg.moe_drop_tokens,
         )
+        if cfg.moe_use_residual:
+            # PR-MoE (reference moe/layer.py:28,45): every token also runs
+            # the dense residual MLP; a learned 2-way softmax mixes the two
+            res_p = {k[len("res_"):]: v for k, v in mlp_p.items()
+                     if k.startswith("res_")}
+            dense_out = expert_fn(res_p, h)
+            coef = jax.nn.softmax(h @ mlp_p["coef_w"] + mlp_p["coef_b"], axis=-1)
+            mlp_out = dense_out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
         return mlp_out, aux
     aux = jnp.float32(0.0)
     if cfg.activation == "silu_glu":

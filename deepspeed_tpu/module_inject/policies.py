@@ -957,10 +957,22 @@ def config_from_hf(hf_config) -> TransformerConfig:
 
 
 def convert_hf_model(hf_model) -> Tuple[TransformerConfig, Dict]:
-    """(reference: replace_transformer_layer) HF torch model -> (cfg, params)."""
-    policy = policy_for(hf_model.config)
-    cfg = policy.config(hf_model.config)
+    """(reference: replace_transformer_layer) HF torch model -> (cfg, params).
+
+    Architectures without an explicit policy fall back to the AutoTP
+    name/shape-heuristic policy (reference module_inject/auto_tp.py)."""
     state = dict(hf_model.state_dict())
+    try:
+        policy = policy_for(hf_model.config)
+    except ValueError:
+        from deepspeed_tpu.module_inject.auto_tp import auto_policy
+
+        policy = auto_policy(state)
+        logger.info(
+            f"no explicit policy for {getattr(hf_model.config, 'model_type', '?')}; "
+            "using the AutoTP fallback"
+        )
+    cfg = policy.config(hf_model.config)
     params = policy.params(state, cfg)
     logger.info(f"converted HF {hf_model.config.model_type} -> TransformerConfig({cfg.num_params():,} params)")
     return cfg, params

@@ -47,7 +47,13 @@ class MoE:
 
     Args mirror the reference MoE (moe/layer.py:16): num_experts, k (top-k),
     capacity_factor, eval_capacity_factor, min_capacity, drop_tokens, use_rts,
-    noisy_gate_policy. ``ep_size`` is implicit: the ``expert`` mesh axis.
+    noisy_gate_policy, use_residual. ``ep_size`` is implicit: the ``expert``
+    mesh axis.
+
+    ``use_residual=True`` is PR-MoE (reference moe/layer.py:28,45): a dense
+    MLP (same shape as one expert) runs every token, and a learned per-token
+    2-way softmax coefficient mixes it with the MoE output:
+    ``out = dense * coef[..., :1] + moe * coef[..., 1:2]``.
     """
 
     def __init__(
@@ -63,6 +69,7 @@ class MoE:
         use_rts: bool = True,
         noisy_gate_policy: Optional[str] = None,
         ffn_size: Optional[int] = None,
+        use_residual: bool = False,
     ):
         assert k in (1, 2), "only top-1 / top-2 gating supported (reference TopKGate :358)"
         self.hidden_size = hidden_size
@@ -75,12 +82,20 @@ class MoE:
         self.drop_tokens = drop_tokens
         self.use_rts = use_rts
         self.noisy_gate_policy = noisy_gate_policy
+        self.use_residual = use_residual
 
     def init(self, rng):
-        gate_rng, exp_rng = jax.random.split(rng)
+        gate_rng, exp_rng, res_rng, coef_rng = jax.random.split(rng, 4)
         expert_params = jax.vmap(self.expert.init)(jax.random.split(exp_rng, self.num_experts))
         gate_w = jax.random.normal(gate_rng, (self.hidden_size, self.num_experts), jnp.float32) * 0.02
-        return {"gate": {"w": gate_w}, "experts": expert_params}
+        params = {"gate": {"w": gate_w}, "experts": expert_params}
+        if self.use_residual:
+            params["residual_mlp"] = self.expert.init(res_rng)
+            params["coefficient"] = {
+                "w": jax.random.normal(coef_rng, (self.hidden_size, 2), jnp.float32) * 0.02,
+                "b": jnp.zeros((2,), jnp.float32),
+            }
+        return params
 
     def logical_specs(self):
         specs = {"gate": {"w": ("embed", None)}}
@@ -88,11 +103,21 @@ class MoE:
             specs["experts"] = self.expert.logical_specs()
         else:
             specs["experts"] = None
+        if self.use_residual:
+            # dense residual expert: expert specs minus the leading E axis
+            if specs["experts"] is not None:
+                specs["residual_mlp"] = {
+                    k: tuple(a for a in v if a != "expert")
+                    for k, v in specs["experts"].items()
+                }
+            else:
+                specs["residual_mlp"] = None
+            specs["coefficient"] = {"w": ("embed", None), "b": (None,)}
         return specs
 
     def apply(self, params, x, rng=None, training: bool = True):
         cf = self.capacity_factor if training else self.eval_capacity_factor
-        return moe_forward(
+        moe_out, l_aux, exp_counts = moe_forward(
             x,
             params["gate"]["w"],
             self.expert.apply,
@@ -105,5 +130,11 @@ class MoE:
             drop_tokens=self.drop_tokens,
             noisy_gate_policy=self.noisy_gate_policy,
         )
+        if self.use_residual:
+            dense_out = self.expert.apply(params["residual_mlp"], x)
+            coef_p = params["coefficient"]
+            coef = jax.nn.softmax(x @ coef_p["w"] + coef_p["b"], axis=-1)
+            moe_out = dense_out * coef[..., 0:1] + moe_out * coef[..., 1:2]
+        return moe_out, l_aux, exp_counts
 
     __call__ = apply

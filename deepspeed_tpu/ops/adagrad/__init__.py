@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad, adagrad_update
+
+__all__ = ["DeepSpeedCPUAdagrad", "adagrad_update"]

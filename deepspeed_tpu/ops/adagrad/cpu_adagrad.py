@@ -1,0 +1,106 @@
+"""CPU Adagrad for host-offloaded optimizer states.
+
+TPU-native counterpart of the reference's ``DeepSpeedCPUAdagrad``
+(ops/adagrad/cpu_adagrad.py over csrc/adagrad/cpu_adagrad.cpp:24): the
+ZeRO-Offload hot loop for Adagrad, running on the TPU-VM host CPU while
+HBM holds only params + activations. Same numpy in-place protocol as
+``DeepSpeedCPUAdam`` (ops/adam/cpu_adam.py) — the engine's host tier calls
+``step_buffer`` per flat fp32 master buffer with the accumulation/clip
+scaling fused into the kernel (``grad_scale``).
+"""
+
+import ctypes
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.native import build_and_load
+
+_lib = None
+_checked = False
+
+
+def _native():
+    global _lib, _checked
+    if not _checked:
+        _checked = True
+        _lib = build_and_load("cpu_adagrad", "adagrad/cpu_adagrad.cpp")
+        if _lib is not None:
+            _lib.ds_adagrad_step.argtypes = [
+                ctypes.POINTER(ctypes.c_float),  # params
+                ctypes.POINTER(ctypes.c_float),  # grads
+                ctypes.POINTER(ctypes.c_float),  # sum_sq
+                ctypes.c_longlong,
+                ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ]
+            _lib.ds_adagrad_step.restype = None
+    return _lib
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def adagrad_update(params: np.ndarray, grads: np.ndarray, sum_sq: np.ndarray,
+                   lr: float = 1e-2, eps: float = 1e-10,
+                   weight_decay: float = 0.0, grad_scale: float = 1.0):
+    """In-place Adagrad on flat float32 host buffers (native or numpy
+    fallback; torch.optim.Adagrad semantics: L2 decay folded into the
+    gradient, state_sum += g^2, p -= lr * g / (sqrt(sum) + eps))."""
+    assert params.dtype == np.float32 and params.flags.c_contiguous
+    assert params.flags.writeable, "params buffer is read-only (copy device_get results)"
+    lib = _native()
+    if lib is not None:
+        lib.ds_adagrad_step(
+            _fptr(params), _fptr(np.ascontiguousarray(grads, np.float32)),
+            _fptr(sum_sq), params.size, lr, eps, weight_decay, grad_scale,
+        )
+        return
+    # numpy fallback (identical math)
+    g = grads.astype(np.float32, copy=False)
+    if grad_scale != 1.0:
+        g = g * grad_scale
+    if weight_decay > 0.0:
+        g = g + weight_decay * params
+    sum_sq += g * g
+    params -= lr * g / (np.sqrt(sum_sq) + eps)
+
+
+@dataclass
+class DeepSpeedCPUAdagrad:
+    """Stateful per-buffer host Adagrad (reference class name kept)."""
+
+    lr: float = 1e-2
+    eps: float = 1e-10
+    weight_decay: float = 0.0
+    _state: Dict[int, dict] = field(default_factory=dict, repr=False)
+
+    def step_buffer(self, key, params: np.ndarray, grads: np.ndarray,
+                    lr: Optional[float] = None, grad_scale: float = 1.0):
+        """Update one flat param buffer in place, keyed sum-sq state."""
+        st = self._state.get(key)
+        if st is None:
+            st = {"step": 0, "sum_sq": np.zeros_like(params)}
+            st["sum_sq"].flags.writeable = True
+            self._state[key] = st
+        st["step"] += 1
+        adagrad_update(params, grads, st["sum_sq"],
+                       lr if lr is not None else self.lr,
+                       self.eps, self.weight_decay, grad_scale)
+        return params
+
+    def state_dict(self):
+        return {str(k): {"step": v["step"], "sum_sq": v["sum_sq"]}
+                for k, v in self._state.items()}
+
+    def load_state_dict(self, sd):
+        # np.array copies: restored leaves can be read-only views
+        self._state = {
+            k: {"step": int(v["step"]), "sum_sq": np.array(v["sum_sq"], np.float32)}
+            for k, v in sd.items()
+        }
+
+
+def is_native_available() -> bool:
+    return _native() is not None

@@ -186,8 +186,12 @@ def partition_saved_activation(x, mesh=None):
         return x  # unshardable seq length: keep replicated rather than fail
     from jax.sharding import NamedSharding, PartitionSpec
 
-    spec = PartitionSpec(None, seq_axes if len(seq_axes) > 1 else seq_axes[0],
-                         *([None] * (x.ndim - 2)))
+    # batch/trailing dims stay UNCONSTRAINED: a plain None would mean
+    # "replicated", forcing a batch all-gather across the data axes —
+    # the exact opposite of the memory the flag is buying
+    U = PartitionSpec.UNCONSTRAINED
+    spec = PartitionSpec(U, seq_axes if len(seq_axes) > 1 else seq_axes[0],
+                         *([U] * (x.ndim - 2)))
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 

@@ -443,10 +443,27 @@ class TpuEngine:
         opt_cfg = config.optimizer
         params = dict(opt_cfg.params) if opt_cfg is not None else {}
         name = opt_cfg.type.lower() if opt_cfg is not None else C.ADAM_OPTIMIZER
-        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
+        if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER, C.ADAGRAD_OPTIMIZER):
             raise ValueError(
-                f"offload_optimizer supports Adam/AdamW (reference: DeepSpeedCPUAdam), got {opt_cfg.type}"
+                "offload_optimizer supports Adam/AdamW (reference: DeepSpeedCPUAdam) "
+                f"and Adagrad (reference: DeepSpeedCPUAdagrad), got {opt_cfg.type}"
             )
+        if name == C.ADAGRAD_OPTIMIZER:
+            # reference: csrc/adagrad/cpu_adagrad.cpp:24 via ops/adagrad
+            if self.offload_device != "cpu":
+                raise ValueError(
+                    "offload_optimizer device=nvme supports Adam/AdamW only "
+                    "(the optimizer swapper stores Adam moment pairs); use "
+                    "device=cpu for Adagrad"
+                )
+            from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+
+            self._host_optimizer = DeepSpeedCPUAdagrad(
+                lr=params.get("lr", 1e-2),
+                eps=params.get("eps", 1e-10),
+                weight_decay=params.get("weight_decay", 0.0),
+            )
+            return self._host_optimizer
         kwargs = dict(
             lr=params.get("lr", 1e-3),
             betas=tuple(params.get("betas", (0.9, 0.999))),

@@ -1,13 +1,28 @@
 """Pipeline module: stage partitioning of a layer list.
 
 Reference: ``runtime/pipe/module.py`` (PipelineModule :85, LayerSpec :29,
-TiedLayerSpec :76). TPU design: a PipelineModule holds N layer-stage
-callables; the PipelineEngine maps stages onto the ``pipe`` mesh axis and
-runs a 1F1B schedule with collective-permutes between stages (see
-runtime/pipe/engine.py).
+TiedLayerSpec :76, ``partition_method`` handling :129 with ``parameters``
+default and ``type:regex`` profiling :283). TPU design: a PipelineModule
+holds N layer-stage callables; the PipelineEngine maps stages onto the
+``pipe`` mesh axis and runs a 1F1B schedule with collective-permutes
+between stages (see runtime/pipe/engine.py).
+
+Partition methods (reference ``_partition_layers``):
+
+  - ``uniform``       — equal layer counts per stage (TPU default: scanned
+                        equal-shape blocks are the common case).
+  - ``parameters``    — balance stages by per-layer parameter count
+                        (reference default). Counts come from
+                        ``jax.eval_shape`` over each spec's ``init_fn`` —
+                        abstract evaluation, nothing is allocated.
+  - ``type:<regex>``  — weight 1 for layers whose name matches the regex,
+                        0 otherwise, then balance (reference :283 — e.g.
+                        ``type:transformer`` splits only the block layers
+                        evenly, keeping embeddings off the count).
 """
 
-from typing import Callable, List, Optional
+import re
+from typing import Callable, List, Optional, Sequence
 
 
 class LayerSpec:
@@ -17,6 +32,13 @@ class LayerSpec:
         self.init_fn = init_fn
         self.apply_fn = apply_fn
         self.name = name or apply_fn.__name__
+
+    def param_count(self) -> int:
+        """Abstract (allocation-free) parameter count of this layer."""
+        import jax
+
+        shapes = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
+        return sum(int(l.size) for l in jax.tree.leaves(shapes))
 
 
 class TiedLayerSpec(LayerSpec):
@@ -28,25 +50,81 @@ class TiedLayerSpec(LayerSpec):
         self.key = key
 
 
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition of ``weights`` into ``num_parts`` non-empty
+    parts minimizing the max part weight; returns the s+1 bounds
+    (reference: deepspeed.runtime.utils.partition_balanced lineage).
+
+    O(n^2 * s) DP — layer lists are short, exactness beats cleverness."""
+    n, s = len(weights), num_parts
+    assert n >= s >= 1, f"{n} layers cannot fill {s} stages"
+    pre = [0.0]
+    for w in weights:
+        pre.append(pre[-1] + float(w))
+    INF = float("inf")
+    # dp[k][i]: min possible max-load splitting first i layers into k parts
+    dp = [[INF] * (n + 1) for _ in range(s + 1)]
+    cut = [[0] * (n + 1) for _ in range(s + 1)]
+    dp[0][0] = 0.0
+    for k in range(1, s + 1):
+        # part k must leave >= s-k layers for the remaining parts
+        for i in range(k, n - (s - k) + 1):
+            best, best_j = INF, k - 1
+            for j in range(k - 1, i):
+                cand = max(dp[k - 1][j], pre[i] - pre[j])
+                if cand < best:
+                    best, best_j = cand, j
+            dp[k][i] = best
+            cut[k][i] = best_j
+    bounds = [n]
+    i = n
+    for k in range(s, 0, -1):
+        i = cut[k][i]
+        bounds.append(i)
+    return bounds[::-1]
+
+
 class PipelineModule:
     """A sequence of LayerSpecs partitioned into pipeline stages."""
 
-    def __init__(self, layers: List[LayerSpec], num_stages: int = 1, loss_fn=None, partition_method: str = "uniform"):
+    def __init__(self, layers: List[LayerSpec], num_stages: int = 1, loss_fn=None,
+                 partition_method: str = "uniform"):
         self.layer_specs = list(layers)
         self.num_stages = num_stages
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.parts = self._partition_layers()
 
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        if method in ("uniform", "uniform_floor"):
+            return [1.0] * len(self.layer_specs)
+        if method == "parameters":
+            return [float(spec.param_count()) for spec in self.layer_specs]
+        if method.startswith("type:"):
+            pattern = self.partition_method[len("type:"):]
+            return [1.0 if re.search(pattern, spec.name, re.IGNORECASE) else 0.0
+                    for spec in self.layer_specs]
+        raise NotImplementedError(
+            f"partition_method '{self.partition_method}' not supported "
+            "(uniform | parameters | type:<regex>)"
+        )
+
     def _partition_layers(self):
         n, s = len(self.layer_specs), self.num_stages
         assert n >= s, f"{n} layers cannot fill {s} stages"
-        # uniform contiguous split (reference supports parameter-count and
-        # regex-profiled balancing; uniform is the TPU default because scanned
-        # equal-shape blocks are the common case)
-        bounds = [round(i * n / s) for i in range(s + 1)]
-        return bounds
+        method = self.partition_method.lower()
+        if method in ("uniform", "uniform_floor"):
+            return [round(i * n / s) for i in range(s + 1)]
+        return partition_balanced(self._layer_weights(), s)
 
     def stage_layers(self, stage_id: int):
         lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
         return self.layer_specs[lo:hi]
+
+    def stage_param_counts(self) -> List[int]:
+        """Per-stage parameter totals (for balance diagnostics/tests)."""
+        return [
+            sum(spec.param_count() for spec in self.stage_layers(s))
+            for s in range(self.num_stages)
+        ]

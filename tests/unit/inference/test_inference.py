@@ -744,3 +744,77 @@ class TestFlashPrefill:
         a = np.asarray(xla.generate(prompt, max_new_tokens=4))
         b = np.asarray(pallas.generate(prompt, max_new_tokens=4))
         np.testing.assert_array_equal(a, b)
+
+
+def _tiny_qwen2():
+    import torch
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(0)
+    cfg = Qwen2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=False, attention_dropout=0.0,
+    )
+    return Qwen2ForCausalLM(cfg).eval()
+
+
+def _tiny_stablelm():
+    import torch
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    torch.manual_seed(0)
+    cfg = StableLmConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, max_position_embeddings=64,
+        partial_rotary_factor=0.5, attention_dropout=0.0, hidden_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    return StableLmForCausalLM(cfg).eval()
+
+
+class TestAutoTPFallback:
+    """Generic AutoTP fallback policy (VERDICT r3 #9; reference
+    module_inject/auto_tp.py): archs with NO explicit policy entry convert
+    via name/shape heuristics. Qwen2 (GQA + qkv-bias + silu-glu + rms) and
+    StableLM (partial rotary + layernorm) are deliberately NOT in POLICIES."""
+
+    @pytest.mark.parametrize("maker", [_tiny_qwen2, _tiny_stablelm],
+                             ids=["qwen2", "stablelm"])
+    def test_logits_parity_unknown_arch(self, maker):
+        import torch
+
+        hf = maker()
+        from deepspeed_tpu.module_inject.policies import convert_hf_model, policy_for
+        from deepspeed_tpu.models.transformer import TransformerModel
+
+        with pytest.raises(ValueError):
+            policy_for(hf.config)  # really not in the explicit list
+        cfg, params = convert_hf_model(hf)
+        model = TransformerModel(cfg)
+        tokens = np.random.RandomState(0).randint(0, 128, (2, 16)).astype(np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(tokens)).logits.numpy()
+        params = jax.tree.map(jnp.asarray, params)
+        ours = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+    def test_auto_converted_model_runs_tp_inference(self):
+        """The fallback-converted model must drive the full inference
+        engine under TP=2 (the point of AutoTP: shard anything)."""
+        import deepspeed_tpu
+
+        comm.destroy()
+        hf = _tiny_qwen2()
+        from deepspeed_tpu.module_inject.policies import convert_hf_model
+        from deepspeed_tpu.models.transformer import TransformerModel
+
+        cfg, params = convert_hf_model(hf)
+        engine = deepspeed_tpu.init_inference(
+            TransformerModel(cfg), params=params,
+            config={"dtype": "float32", "tensor_parallel": {"tp_size": 2},
+                    "mesh": {"data": 4, "tensor": 2}},
+        )
+        prompts = np.random.RandomState(0).randint(0, 128, (2, 8)).astype(np.int32)
+        out = engine.generate(prompts, max_new_tokens=4)
+        assert np.asarray(out).shape == (2, 12)

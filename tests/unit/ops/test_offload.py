@@ -50,6 +50,67 @@ class TestCPUAdam:
         assert opt2._state["w"]["step"] == 1
 
 
+class TestCPUAdagrad:
+    """C++ CPU Adagrad tier (VERDICT r3 #6; reference
+    csrc/adagrad/cpu_adagrad.cpp:24 + ops/adagrad/cpu_adagrad.py)."""
+
+    def test_native_build(self):
+        from deepspeed_tpu.ops.adagrad.cpu_adagrad import is_native_available as ag_native
+
+        assert ag_native(), "C++ cpu_adagrad must build on this toolchain"
+
+    def test_matches_device_adagrad(self):
+        """Host C++ Adagrad must track the device Adagrad trajectory
+        (reference validates DeepSpeedCPUAdagrad against torch.optim.Adagrad)."""
+        from deepspeed_tpu.ops.adagrad.cpu_adagrad import adagrad_update
+        from deepspeed_tpu.ops.adam.basic_optimizers import Adagrad
+
+        rng = np.random.default_rng(0)
+        p_host = rng.normal(size=(257,)).astype(np.float32)  # odd size: tail lanes
+        p_dev = {"w": jnp.asarray(p_host.copy())}
+        ssq = np.zeros_like(p_host)
+        ref = Adagrad(lr=1e-2, eps=1e-10, weight_decay=0.01)
+        state = ref.init(p_dev)
+        for _ in range(8):
+            g = rng.normal(size=(257,)).astype(np.float32)
+            adagrad_update(p_host, g, ssq, lr=1e-2, eps=1e-10, weight_decay=0.01)
+            upd, state = ref.update({"w": jnp.asarray(g)}, state, p_dev)
+            p_dev = {"w": p_dev["w"] + upd["w"]}
+        np.testing.assert_allclose(p_host, np.asarray(p_dev["w"]), rtol=2e-5, atol=2e-6)
+
+    def test_native_matches_numpy_and_grad_scale(self):
+        """Kernel-vs-numpy parity, incl. the fused grad_scale path."""
+        from deepspeed_tpu.ops.adagrad import cpu_adagrad as cg
+
+        rng = np.random.default_rng(1)
+        p_nat = rng.normal(size=(100003,)).astype(np.float32)
+        p_np = p_nat.copy()
+        s_nat = np.zeros_like(p_nat)
+        s_np = np.zeros_like(p_np)
+        for step in range(3):
+            g = rng.normal(size=p_nat.shape).astype(np.float32)
+            cg.adagrad_update(p_nat, g, s_nat, lr=1e-2, weight_decay=0.01, grad_scale=0.5)
+            # numpy fallback: force lib away
+            saved = cg._lib
+            cg._lib = None
+            cg.adagrad_update(p_np, g, s_np, lr=1e-2, weight_decay=0.01, grad_scale=0.5)
+            cg._lib = saved
+        np.testing.assert_allclose(p_nat, p_np, rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(s_nat, s_np, rtol=1e-6, atol=1e-7)
+
+    def test_stateful_wrapper_roundtrip(self):
+        from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+
+        opt = DeepSpeedCPUAdagrad(lr=1e-2)
+        p = np.ones(16, np.float32)
+        opt.step_buffer("w", p, np.full(16, 0.5, np.float32))
+        sd = opt.state_dict()
+        opt2 = DeepSpeedCPUAdagrad(lr=1e-2)
+        opt2.load_state_dict(sd)
+        assert opt2._state["w"]["step"] == 1
+        np.testing.assert_array_equal(opt2._state["w"]["sum_sq"], opt._state["w"]["sum_sq"])
+
+
 class TestThreadedCPUAdam:
     """The std::thread tiling in csrc/adam/cpu_adam.cpp (reference:
     cpu_adam.cpp:303 OpenMP-threaded blocks — VERDICT r1 #7 host-offload
@@ -211,6 +272,40 @@ class TestEngineOffload:
         assert engine.offload_device == "cpu"
         assert engine._host_master is not None
         assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_cpu_offload_adagrad_trains(self):
+        """Adagrad host tier e2e (VERDICT r3 #6: _configure_offload_optimizer
+        previously hard-rejected non-Adam)."""
+        from deepspeed_tpu.ops.adagrad.cpu_adagrad import DeepSpeedCPUAdagrad
+
+        engine, losses = self._train({
+            "optimizer": {"type": "Adagrad", "params": {"lr": 0.3}},
+            "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+        })
+        assert isinstance(engine._host_optimizer, DeepSpeedCPUAdagrad)
+        assert losses[-1] < 0.5 * losses[0], losses
+
+    def test_cpu_offload_adagrad_matches_device_path(self):
+        """Offloaded Adagrad must track the on-device Adagrad trajectory."""
+        _, dev_losses = self._train({
+            "optimizer": {"type": "Adagrad", "params": {"lr": 0.3}},
+            "zero_optimization": {"stage": 2},
+        })
+        _, off_losses = self._train({
+            "optimizer": {"type": "Adagrad", "params": {"lr": 0.3}},
+            "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+        })
+        np.testing.assert_allclose(dev_losses, off_losses, rtol=0.05)
+
+    def test_nvme_adagrad_rejected(self):
+        with pytest.raises(ValueError, match="Adagrad"):
+            self._train({
+                "optimizer": {"type": "Adagrad", "params": {"lr": 0.3}},
+                "zero_optimization": {
+                    "stage": 2,
+                    "offload_optimizer": {"device": "nvme", "nvme_path": "/tmp/dstpu_nvme_ag"},
+                },
+            }, steps=1)
 
     def test_cpu_offload_matches_device_path(self):
         """Offloaded Adam must track the on-device FusedAdam trajectory."""

@@ -360,3 +360,106 @@ class TestTopology:
         assert topo.world_size() == 8
         coord = topo.get_coord(topo.get_rank(pipe=1, data=1, model=1))
         assert (coord.pipe, coord.data, coord.model) == (1, 1, 1)
+
+
+class TestPartitionMethods:
+    """partition_method='parameters' and 'type:regex' (VERDICT r3 #7;
+    reference runtime/pipe/module.py:129 parameters default, :283 regex)."""
+
+    @staticmethod
+    def _specs():
+        from deepspeed_tpu.runtime.pipe.module import LayerSpec
+
+        def make(name, shape):
+            def init_fn(rng, shape=shape):
+                return {"w": jnp.zeros(shape, jnp.float32)}
+
+            def apply_fn(p, x):
+                return x
+
+            return LayerSpec(init_fn, apply_fn, name=name)
+
+        # embedding-heavy stack: 1M-param embed + six 200k-param blocks + head
+        return [
+            make("embed", (1000, 1000)),
+            *[make(f"block_{i}", (400, 500)) for i in range(6)],
+            make("head", (100, 100)),
+        ]
+
+    def test_parameters_fixes_uniform_imbalance(self):
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+        specs = self._specs()
+        uni = PipelineModule(specs, num_stages=2, partition_method="uniform")
+        par = PipelineModule(specs, num_stages=2, partition_method="parameters")
+        u0, u1 = uni.stage_param_counts()
+        p0, p1 = par.stage_param_counts()
+        # uniform: stage0 = embed + 3 blocks (1.6M) vs 3 blocks + head (0.61M)
+        assert u0 / u1 > 2.5, (u0, u1)
+        # parameters: embed + 1 block (1.2M) vs 5 blocks + head (1.01M)
+        assert max(p0, p1) / min(p0, p1) < 1.3, (p0, p1)
+        assert max(p0, p1) < max(u0, u1)  # bottleneck strictly improves
+
+    def test_type_regex_balances_matched_layers(self):
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+        specs = self._specs()
+        mod = PipelineModule(specs, num_stages=2, partition_method="type:block")
+        # 6 matched blocks must split 3/3 regardless of embed/head weight
+        names = [[s.name for s in mod.stage_layers(i)] for i in range(2)]
+        n_blocks = [sum(1 for nm in st if nm.startswith("block")) for st in names]
+        assert n_blocks == [3, 3], names
+
+    def test_unknown_method_raises(self):
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+        with pytest.raises(NotImplementedError):
+            PipelineModule(self._specs(), num_stages=2, partition_method="profile")
+
+    def test_balanced_partition_exact(self):
+        from deepspeed_tpu.runtime.pipe.module import partition_balanced
+
+        assert partition_balanced([5, 1, 1, 1, 1, 1], 2) == [0, 1, 6]
+        assert partition_balanced([1, 1, 1, 1], 4) == [0, 1, 2, 3, 4]
+        assert partition_balanced([0, 0, 1, 0], 2)[1] in (1, 2)  # non-empty parts
+
+    def test_pipeline_module_trains_with_parameters_method(self):
+        """End-to-end: a parameters-partitioned PipelineModule trains on the
+        pipe mesh (engine consumes the balanced bounds)."""
+        import deepspeed_tpu
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+        comm.destroy()
+
+        def make_dense(d_in, d_out, name):
+            def init_fn(rng, shape=(d_in, d_out)):
+                return {"w": jax.random.normal(rng, shape) * 0.1}
+
+            def apply_fn(p, x):
+                return jnp.tanh(x @ p["w"])
+
+            return LayerSpec(init_fn, apply_fn, name=name)
+
+        specs = [make_dense(8, 32, "wide_in"), make_dense(32, 8, "wide_out"),
+                 make_dense(8, 8, "s0"), make_dense(8, 8, "s1")]
+        module = PipelineModule(
+            specs, num_stages=2, partition_method="parameters",
+            loss_fn=lambda out, labels: jnp.mean((out - labels) ** 2),
+        )
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "pipeline": {"stages": 2},
+            "mesh": {"pipe": 2, "data": -1},
+            "steps_per_print": 1000000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, config=config)
+        rs = np.random.RandomState(0)
+        losses = []
+        for _ in range(6):
+            micro = iter({"inputs": rs.normal(size=(8, 8)).astype(np.float32),
+                          "labels": np.zeros((8, 8), np.float32)} for _ in range(2))
+            losses.append(float(engine.train_batch(micro)))
+        assert losses[-1] < 0.7 * losses[0], losses
