@@ -133,10 +133,12 @@ def main():
         print(json.dumps(_stale_primary(cache, f"relay unreachable: {err}")), flush=True)
         return 0
     print(json.dumps(probe), flush=True)
-    # only real-TPU results may refresh the last-good cache: a CPU smoke
-    # run must not overwrite the on-chip headline the stale path falls
-    # back to when the relay is down
-    cacheable = "tpu" in probe["extra"]["device_kind"].lower()
+    # only full-size real-TPU results may refresh the last-good cache:
+    # neither a CPU run nor a smoke-model run (smoke is an independent env
+    # var that also applies on-chip) may overwrite the on-chip headline the
+    # stale path falls back to when the relay is down
+    cacheable = ("tpu" in probe["extra"]["device_kind"].lower()
+                 and os.environ.get("DSTPU_BENCH_SMOKE") != "1")
 
     # ---- 3. primary (self-tune -> pinned fallback -> stale) ---------------
     primary, err = _run_child("primary", primary_cap)

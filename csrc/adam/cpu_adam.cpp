@@ -22,46 +22,11 @@
 // the gradient buffer is read exactly once).
 // All buffers are float32, updated in place (params included).
 
-#include <algorithm>
 #include <cmath>
-#include <cstddef>
-#include <cstdlib>
-#include <thread>
-#include <vector>
 
-namespace {
+#include "../includes/threading.h"
 
-constexpr long long kMinChunk = 1 << 18;  // 256K floats = 1MB per thread min
-
-int thread_count(long long n) {
-  const char* env = std::getenv("DSTPU_CPU_ADAM_THREADS");
-  long long want = env ? std::atoll(env) : (long long)std::thread::hardware_concurrency();
-  if (want < 1) want = 1;
-  long long by_size = (n + kMinChunk - 1) / kMinChunk;
-  return (int)std::min(want, std::max(1LL, by_size));
-}
-
-// run fn(lo, hi) over [0, n) split across threads
-template <typename F>
-void parallel_for(long long n, F fn) {
-  int t = thread_count(n);
-  if (t <= 1) {
-    fn(0, n);
-    return;
-  }
-  long long chunk = (n + t - 1) / t;
-  std::vector<std::thread> pool;
-  pool.reserve(t - 1);
-  for (int i = 1; i < t; ++i) {
-    long long lo = i * chunk, hi = std::min(n, lo + chunk);
-    if (lo >= hi) break;
-    pool.emplace_back([=] { fn(lo, hi); });
-  }
-  fn(0, std::min(n, chunk));
-  for (auto& th : pool) th.join();
-}
-
-}  // namespace
+using dstpu::parallel_for;
 
 extern "C" {
 

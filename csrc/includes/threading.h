@@ -1,0 +1,49 @@
+// Shared host-kernel threading helpers for the csrc optimizer kernels
+// (cpu_adam / cpu_adagrad). Reference analogue: the shared headers under
+// csrc/includes/ (SURVEY §2.4 #13) — here the OpenMP-runtime-free
+// std::thread tiling both host optimizers use.
+//
+// Thread count: DSTPU_CPU_ADAM_THREADS env var, else hardware concurrency;
+// buffers below ~256K elements stay single-threaded (spawn cost dominates).
+// Per-element updates are independent, so threaded results are
+// bit-identical to single-threaded.
+#pragma once
+
+#include <algorithm>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace dstpu {
+
+constexpr long long kMinChunk = 1 << 18;  // 256K floats = 1MB per thread min
+
+inline int thread_count(long long n) {
+  const char* env = std::getenv("DSTPU_CPU_ADAM_THREADS");
+  long long want = env ? std::atoll(env) : (long long)std::thread::hardware_concurrency();
+  if (want < 1) want = 1;
+  long long by_size = (n + kMinChunk - 1) / kMinChunk;
+  return (int)std::min(want, std::max(1LL, by_size));
+}
+
+// run fn(lo, hi) over [0, n) split across threads
+template <typename F>
+void parallel_for(long long n, F fn) {
+  int t = thread_count(n);
+  if (t <= 1) {
+    fn(0, n);
+    return;
+  }
+  long long chunk = (n + t - 1) / t;
+  std::vector<std::thread> pool;
+  pool.reserve(t - 1);
+  for (int i = 1; i < t; ++i) {
+    long long lo = i * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([=] { fn(lo, hi); });
+  }
+  fn(0, std::min(n, chunk));
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace dstpu

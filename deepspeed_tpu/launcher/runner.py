@@ -278,10 +278,23 @@ def main(argv=None):
     if args.launcher in MPI_LAUNCHERS:
         import tempfile
 
-        hf = os.path.join(tempfile.gettempdir(), f"dstpu_mpi_hostfile_{os.getpid()}")
-        cmd = build_mpi_cmd(args, active, master_addr, hf)
-        logger.info(f"dstpu {args.launcher} launch: {' '.join(cmd[:8])} ...")
-        sys.exit(subprocess.call(cmd))
+        # NamedTemporaryFile: O_EXCL + unpredictable name (a predictable
+        # /tmp path is symlink-clobberable on shared hosts), removed after
+        # the launch
+        tf = tempfile.NamedTemporaryFile(
+            mode="w", prefix="dstpu_mpi_hostfile_", suffix=".txt", delete=False
+        )
+        tf.close()
+        try:
+            cmd = build_mpi_cmd(args, active, master_addr, tf.name)
+            logger.info(f"dstpu {args.launcher} launch: {' '.join(cmd[:8])} ...")
+            rc = subprocess.call(cmd)
+        finally:
+            try:
+                os.unlink(tf.name)
+            except OSError:
+                pass
+        sys.exit(rc)
 
     multi_node = args.force_multi or len(active) > 1 or args.launcher == "tpu-pod"
     if not multi_node:

@@ -213,6 +213,16 @@ class AutoTPPolicy:
             raise ValueError(
                 f"AutoTP could not identify the layer structure: found {sorted(have)}"
             )
+        if "m_wg" in have and ("m_bg" in have or "m_bi" in have):
+            # the unified model's GLU branch has no gate/up bias terms —
+            # silently dropping them would diverge from HF, so fail loudly
+            # (this module's contract: structural mismatch errors at
+            # conversion, never silent wrongness)
+            raise ValueError(
+                "AutoTP: GLU MLP with gate/up-projection biases is not "
+                "representable by the unified model; this architecture "
+                "needs an explicit policy"
+            )
 
         def lk(suffix, i):
             return f"{self._layer_prefix}{i}.{suffix}"

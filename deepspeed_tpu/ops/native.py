@@ -9,6 +9,7 @@ and loaded through ctypes (no torch, no pybind11 — the ABI is plain C).
 import ctypes
 import hashlib
 import os
+import re
 import subprocess
 from typing import Optional
 
@@ -38,7 +39,18 @@ def build_and_load(name: str, source_rel: str, extra_flags=()) -> Optional[ctype
     src = csrc_path(source_rel)
     try:
         with open(src, "rb") as fh:
-            digest = hashlib.sha256(fh.read() + " ".join(CXX_FLAGS).encode()).hexdigest()[:16]
+            body = fh.read()
+        h = hashlib.sha256(body + " ".join(CXX_FLAGS).encode())
+        # local headers participate in the cache key (quoted includes are
+        # resolved relative to the including file, mirroring g++)
+        for m in re.finditer(rb'#include\s+"([^"]+)"', body):
+            inc = os.path.normpath(os.path.join(os.path.dirname(src), m.group(1).decode()))
+            try:
+                with open(inc, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+        digest = h.hexdigest()[:16]
     except OSError as e:
         logger.warning(f"native op {name}: missing source {src} ({e})")
         _loaded[name] = None

@@ -123,6 +123,7 @@ def test_client_state_and_latest_tag(tmp_path):
     assert client["epoch"] == 7
 
 
+@pytest.mark.slow  # CLI wrapper over the python-API ds_to_universal flow, which stays in the fast run
 def test_ds_to_universal_cli(tmp_path):
     """The ds_to_universal CLI (reference checkpoint/ds_to_universal.py)
     converts a saved engine checkpoint via argv."""

@@ -651,7 +651,11 @@ class TestRaggedGenerate:
                                 max_seq_len=128, dtype="float32")
         return init_inference(TransformerModel(cfg), config={"dtype": "float32"})
 
-    @pytest.mark.parametrize("side", ["left", "right"])
+    @pytest.mark.parametrize("side", [
+        "left",
+        # right-padding probes the same masking math; left is the hard case
+        pytest.param("right", marks=pytest.mark.slow),
+    ])
     def test_padding_parity(self, side):
         eng = self._engine()
         rs = np.random.RandomState(0)

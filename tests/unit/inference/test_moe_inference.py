@@ -106,6 +106,7 @@ class TestMoEInference:
         assert np.asarray(out).shape == (2, 7)
         comm.destroy()
 
+    @pytest.mark.slow  # int8 x EP composition; int8 decode and EP decode are each covered fast
     def test_int8_weight_quant_moe(self):
         """int8 weight-only quantization composes with expert weights."""
         cfg = _moe_cfg(E=2, dtype="bfloat16")

@@ -96,6 +96,7 @@ class TestSpeculative:
         assert cfg.speculative.enabled and cfg.speculative.num_draft_tokens == 6
         assert InferenceConfig.parse({}).speculative.num_draft_tokens == 4
 
+    @pytest.mark.slow  # 20s; the draft/verify math is covered fast by greedy_matches_plain_decode + self_draft
     def test_config_driven_draft_engine(self):
         """speculative.enabled + draft_model= on init_inference: every
         generate() uses the attached draft without per-call plumbing."""

@@ -34,6 +34,7 @@ class TestHybridEngine:
         engine = _engine()
         assert isinstance(engine, TpuHybridEngine)
 
+    @pytest.mark.slow  # 18s; covered fast by test_generate_deterministic_greedy + dryrun_multichip hybrid phase
     def test_generate_then_train_then_generate(self):
         """The RLHF loop: generate -> train step -> generate, with the second
         generation reflecting the updated weights."""
@@ -124,6 +125,7 @@ class TestLoRA:
         )
 
 
+@pytest.mark.slow  # speculative parity covered fast by test_speculative greedy_matches_plain_decode
 def test_hybrid_generate_speculative_parity():
     """RLHF rollout with a draft engine: greedy speculative output from the
     hybrid engine must equal its plain greedy rollout (lossless), on the

@@ -300,6 +300,7 @@ class Test1F1B:
         np.testing.assert_allclose(loss_f, loss_g, rtol=1e-5)
         np.testing.assert_allclose(wq_f, wq_g, rtol=1e-3, atol=1e-5)
 
+    @pytest.mark.slow  # 22s; the unmasked 1F1B-vs-GPipe parity test stays in the fast run
     def test_engine_1f1b_matches_gpipe_masked_loss(self):
         """Unevenly masked microbatches: 1F1B must use the global mask
         normalizer, not per-microbatch means."""

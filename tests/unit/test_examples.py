@@ -12,7 +12,13 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
 
 
 @pytest.mark.parametrize(
-    "script", ["train_gpt2.py", "bert_mlm.py", "inference_speculative.py", "rlhf_hybrid.py"]
+    "script",
+    ["train_gpt2.py", "bert_mlm.py",
+     # speculative + hybrid example flows are unit-covered fast in
+     # test_speculative / test_hybrid_engine; the subprocess runs pay a
+     # full jax import + compile each on the 1-core host
+     pytest.param("inference_speculative.py", marks=pytest.mark.slow),
+     pytest.param("rlhf_hybrid.py", marks=pytest.mark.slow)],
 )
 def test_example_runs(script, tmp_path, monkeypatch):
     from deepspeed_tpu import comm

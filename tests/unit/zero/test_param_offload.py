@@ -189,6 +189,7 @@ class TestPartitionedHostTier:
         want[lo:hi] *= 2
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # multihost HostPartition parity; the partition math units + single-host parity stay fast
     def test_partitioned_step_matches_full(self, monkeypatch):
         """Simulated process 1-of-2: run the full engine one step, then a
         partitioned engine on the same batch with the remote half of every
