@@ -165,11 +165,21 @@ def main():
     suite = {m: {**v, "stale": True} for m, v in (cache.get("suite") or {}).items()}
     if which != "primary":
         for name in SECONDARIES:
+            def _reprint_headline():
+                # keep the headline as the LAST stdout line at every moment:
+                # if the driver's outer timeout kills this parent mid-suite,
+                # a last-line parser must still see the primary metric
+                interim = json.loads(json.dumps(primary))
+                if suite:
+                    interim.setdefault("extra", {})["suite"] = suite
+                print(json.dumps(interim), flush=True)
+
             remaining = total_budget - (time.time() - t_start)
             if remaining < 90:
                 print(json.dumps({"metric": f"bench_{name}_skipped",
                                   "reason": f"global budget exhausted ({int(remaining)}s left)"}),
                       flush=True)
+                _reprint_headline()
                 continue
             cap = min(per_config_s, int(remaining))
             result, err = _run_child(name, cap,
@@ -186,6 +196,7 @@ def main():
                         _save_lastgood(cache)
             else:  # a broken secondary must not kill the headline metric
                 print(json.dumps({"metric": f"bench_{name}_error", "error": err}), flush=True)
+            _reprint_headline()
 
     # ---- 5. headline re-printed last for last-line parsers ----------------
     if suite:

@@ -29,10 +29,13 @@ class OffloadParamConfig:
     # H2D weight-wire format for the streamed groups: "model" ships the
     # model-dtype working copy as-is; "int8" ships blockwise-quantized
     # weights + per-channel fp32 scales — ~2x fewer H2D wire bytes and ~2x
-    # less NVMe traffic (cpu-tier host RAM is NOT reduced: the params
-    # surface keeps a model-dtype copy). Compute dequantizes to model
-    # dtype inside the jitted group programs — the ZeRO++ qwZ idea applied
-    # to the host-streaming tier; beyond the v0.9.1 reference.
+    # less NVMe traffic. Two cpu-tier costs to know about: host RAM is NOT
+    # reduced (the params surface keeps a model-dtype copy so it always
+    # shows the values compute sees), and each optimizer step pays an
+    # O(model-bytes) host dequant pass to refresh that surface. Compute
+    # dequantizes to model dtype inside the jitted group programs — the
+    # ZeRO++ qwZ idea applied to the host-streaming tier; beyond the
+    # v0.9.1 reference.
     wire_dtype: str = "model"  # model | int8
 
 

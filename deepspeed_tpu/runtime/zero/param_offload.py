@@ -589,3 +589,8 @@ class ParamOffloadCoordinator:
             for p, leaf in jax.tree_util.tree_leaves_with_path(params["layers"]):
                 flat[_leaf_key(p)] = np.array(leaf[lo:hi])
             self._store_put(g, flat)
+        if self._quant_keys:
+            # params surface must show the values compute will see: under
+            # the int8 wire the restored arrays get quantized on the way
+            # into the store, so re-assemble from it
+            self.working["layers"] = self._assemble_layers()
