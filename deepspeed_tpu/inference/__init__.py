@@ -1,0 +1,4 @@
+from deepspeed_tpu.inference.continuous import ContinuousBatchingEngine
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+
+__all__ = ["ContinuousBatchingEngine", "InferenceEngine", "init_inference"]

@@ -1,0 +1,221 @@
+"""Continuous (in-flight) batching for the inference engine.
+
+Modern serving capability BEYOND the v0.9.1 reference (its inference
+engine generates one static batch at a time; continuous batching arrived
+in later serving stacks): a fixed pool of ``max_slots`` sequence slots
+shares one KV cache, new requests are admitted into free slots while
+other slots keep decoding, and finished sequences free their slot
+immediately — no head-of-line blocking on the longest sequence.
+
+TPU-shaped design: everything is static-shape. The decode tick is the
+existing per-row-position segment program (inference/decoding.py
+``compile_segment_fn`` — one jit, any slot occupancy); admission runs a
+B=1 ragged prefill into a small bucket-length cache and a compiled
+``dynamic_update_slice`` splices that row into the shared cache. Slot
+reuse needs no cache clearing: admission overwrites [0..len) and the
+causal position mask hides anything staler.
+
+    eng = ContinuousBatchingEngine(model, config={"dtype": "bfloat16"},
+                                   max_slots=8)
+    rid = eng.submit([12, 7, 99], max_new_tokens=32)
+    while eng.has_work():
+        eng.step()            # one decode tick for every active slot
+    out = eng.result(rid)     # prompt + generated tokens (np.int32)
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.decoding import (
+    compile_ragged_prefill_fn,
+    compile_segment_fn,
+    select_token,
+)
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray  # (len,) int32
+    max_new_tokens: int
+    generated: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    done: bool = False
+
+
+def _bucket(n: int, cap: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool serving loop over the shared-cache decode program."""
+
+    def __init__(self, model, config=None, params=None, mesh=None,
+                 max_slots: int = 4, cache_len: Optional[int] = None,
+                 eos_token_id: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+
+        self._eng = InferenceEngine(model, config=config, params=params,
+                                    mesh=mesh, seed=seed)
+        self.cfg = self._eng.cfg
+        self.mesh = self._eng.mesh
+        self.max_slots = max_slots
+        self.cache_len = min(cache_len or self.cfg.max_seq_len, self.cfg.max_seq_len)
+        self.eos_token_id = eos_token_id
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self._rng = jax.random.PRNGKey(seed)
+
+        from deepspeed_tpu.models import transformer as tf
+
+        shardings = self._eng.param_shardings
+        self._segment_fn, cache_sh, _ = compile_segment_fn(
+            self.mesh, self.cfg, shardings, max_slots, self.cache_len
+        )
+        self.cache = jax.device_put(
+            tf.init_cache(self.cfg, max_slots, self.cache_len), cache_sh
+        )
+        self._prefill_fns: Dict[int, object] = {}   # bucket -> B=1 ragged prefill
+        self._insert_fns: Dict[int, object] = {}    # bucket -> cache row splice
+        self._cache_sh = cache_sh
+
+        self._next_rid = 0
+        self._pending: List[_Request] = []
+        self._active: Dict[int, _Request] = {}      # slot -> request
+        self._results: Dict[int, np.ndarray] = {}
+        # per-slot decode state (host side)
+        self._pos = np.zeros(max_slots, np.int32)       # next write position
+        self._last_tok = np.zeros(max_slots, np.int32)  # last emitted token
+
+    # -- public API -----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        assert prompt.size > 0, "empty prompt"
+        assert prompt.size + max_new_tokens <= self.cache_len, (
+            f"prompt {prompt.size} + max_new_tokens {max_new_tokens} exceeds "
+            f"cache_len {self.cache_len}"
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending.append(_Request(rid, prompt, max_new_tokens))
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._active)
+
+    def result(self, rid: int) -> np.ndarray:
+        return self._results.pop(rid)
+
+    def finished(self) -> Dict[int, np.ndarray]:
+        out, self._results = self._results, {}
+        return out
+
+    def step(self) -> Dict[int, List[int]]:
+        """One scheduler tick: admit pending into free slots, then one
+        decode step for every active slot. Returns {rid: [tokens]} emitted
+        this tick — a just-admitted request emits TWO tokens (its prefill
+        token and the same-tick decode token), so the values are lists;
+        concatenating them across ticks reproduces the generated stream
+        exactly. Finished requests move to ``finished()``/``result()``."""
+        emitted: Dict[int, List[int]] = {}
+        free = [s for s in range(self.max_slots) if s not in self._active]
+        while self._pending and free:
+            slot = free.pop(0)
+            req = self._pending.pop(0)
+            emitted[req.rid] = [self._admit(req, slot)]
+        if not self._active:
+            return emitted
+
+        toks = jnp.asarray(self._last_tok[:, None])
+        pos = jnp.asarray(self._pos)
+        self._rng, sub = jax.random.split(self._rng)
+        logits, self.cache = self._segment_fn(self._eng.params, toks, self.cache, pos)
+        nxt = np.asarray(select_token(
+            logits[:, 0], self.temperature, self.top_k, sub, self.top_p
+        ))
+        for slot, req in list(self._active.items()):
+            tok = int(nxt[slot])
+            self._record(req, slot, tok)
+            emitted.setdefault(req.rid, []).append(tok)
+        self._pos[[s for s in self._active]] += 1
+        for slot in [s for s, r in self._active.items() if r.done]:
+            self._finish(slot)
+        return emitted
+
+    # -- internals ------------------------------------------------------
+    def _fns_for_bucket(self, bucket: int):
+        if bucket not in self._prefill_fns:
+            self._prefill_fns[bucket], small_sh, _ = compile_ragged_prefill_fn(
+                self.mesh, self.cfg, self._eng.param_shardings, 1, bucket
+            )
+
+            def insert(big, small, slot):
+                # splice the B=1 bucket cache into the shared cache row:
+                # positions [0..bucket) overwritten, staler junk beyond is
+                # causally masked until real writes reach it
+                return {
+                    k: jax.lax.dynamic_update_slice(
+                        big[k], small[k].astype(big[k].dtype), (0, slot, 0, 0, 0)
+                    )
+                    for k in ("k", "v")
+                }
+
+            self._insert_fns[bucket] = jax.jit(
+                insert,
+                in_shardings=(self._cache_sh, small_sh, None),
+                out_shardings=self._cache_sh,
+                donate_argnums=(0,),
+            )
+        return self._prefill_fns[bucket], self._insert_fns[bucket]
+
+    def _admit(self, req: _Request, slot: int) -> Optional[int]:
+        from deepspeed_tpu.models import transformer as tf
+
+        n = req.prompt.size
+        bucket = _bucket(n, self.cache_len)
+        prefill_fn, insert_fn = self._fns_for_bucket(bucket)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = req.prompt
+        # pads park at bucket (dropped writes), real tokens pack 0..n-1
+        positions = np.full((1, bucket), bucket, np.int32)
+        positions[0, :n] = np.arange(n, dtype=np.int32)
+        small = tf.init_cache(self.cfg, 1, bucket)
+        logits, small = prefill_fn(
+            self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
+        )
+        self.cache = insert_fn(self.cache, small, slot)
+        self._rng, sub = jax.random.split(self._rng)
+        first = int(np.asarray(select_token(
+            logits[:, n - 1], self.temperature, self.top_k, sub, self.top_p
+        ))[0])
+        self._active[slot] = req
+        req.slot = slot
+        # the first generated token's KV is written at position n by the
+        # NEXT decode tick (it feeds last_tok at pos, then pos advances) —
+        # same protocol as ragged_decode_loop
+        self._pos[slot] = n
+        self._record(req, slot, first)
+        if req.done:
+            self._finish(slot)
+        return first
+
+    def _record(self, req: _Request, slot: int, tok: int):
+        req.generated.append(tok)
+        self._last_tok[slot] = tok
+        hit_eos = self.eos_token_id is not None and tok == self.eos_token_id
+        total = req.prompt.size + len(req.generated)
+        if hit_eos or len(req.generated) >= req.max_new_tokens or total >= self.cache_len:
+            req.done = True
+
+    def _finish(self, slot: int):
+        req = self._active.pop(slot)
+        self._results[req.rid] = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)]
+        )

@@ -1,0 +1,118 @@
+"""Continuous (in-flight) batching (inference/continuous.py) — slot-pool
+serving beyond the v0.9.1 reference's static-batch generate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.inference.continuous import ContinuousBatchingEngine
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+
+@pytest.fixture(scope="module")
+def setup():
+    comm.destroy()
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=128, dtype="float32")
+    model = TransformerModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    plain = deepspeed_tpu.init_inference(model, params=params, config={"dtype": "float32"})
+    return model, params, plain
+
+
+def _prompts(ns, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 128, (n,)).astype(np.int32) for n in ns]
+
+
+class TestContinuousBatching:
+    def test_staggered_admission_matches_plain_generate(self, setup):
+        """4 requests through 3 slots, one admitted mid-flight: every
+        output must equal the plain engine's greedy generate."""
+        model, params, plain = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=3, cache_len=64)
+        prompts = _prompts((5, 9, 3, 7))
+        refs = [np.asarray(plain.generate(p[None, :], max_new_tokens=8))[0]
+                for p in prompts]
+        rids = [cb.submit(p, max_new_tokens=8) for p in prompts[:3]]
+        cb.step()
+        cb.step()
+        rids.append(cb.submit(prompts[3], max_new_tokens=8))  # slot reuse
+        while cb.has_work():
+            cb.step()
+        done = cb.finished()
+        for rid, want in zip(rids, refs):
+            np.testing.assert_array_equal(done[rid], want)
+
+    def test_eos_frees_slot_early(self, setup):
+        """A request hitting EOS releases its slot while others continue."""
+        model, params, plain = setup
+        # pick an EOS id we KNOW the greedy path emits: generate once and
+        # use the first generated token of prompt A as the eos id
+        prompts = _prompts((4, 6), seed=1)
+        probe = np.asarray(plain.generate(prompts[0][None, :], max_new_tokens=1))[0]
+        eos = int(probe[-1])
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64,
+                                      eos_token_id=eos)
+        ra = cb.submit(prompts[0], max_new_tokens=8)
+        rb = cb.submit(prompts[1], max_new_tokens=8)
+        cb.step()
+        done = cb.finished()
+        assert ra in done  # finished at its very first token
+        assert len(done[ra]) == len(prompts[0]) + 1 and done[ra][-1] == eos
+        while cb.has_work():
+            cb.step()
+            done.update(cb.finished())
+        out_b = done[rb]
+        assert len(out_b) >= len(prompts[1]) + 1
+
+    def test_queue_longer_than_slots_drains(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        rids = [cb.submit(p, max_new_tokens=4) for p in _prompts((3, 4, 5, 6, 7), seed=2)]
+        ticks = 0
+        while cb.has_work():
+            cb.step()
+            ticks += 1
+            assert ticks < 100, "scheduler did not drain"
+        done = cb.finished()
+        assert set(done) == set(rids)
+        for rid in rids:
+            assert len(done[rid]) >= 4
+
+    def test_oversized_request_rejected(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=32)
+        with pytest.raises(AssertionError, match="cache_len"):
+            cb.submit(np.arange(30, dtype=np.int32), max_new_tokens=8)
+
+    def test_step_stream_matches_results(self, setup):
+        """Concatenating step() returns per request reproduces the
+        generated stream exactly (review r4: the admission tick emits two
+        tokens and must return both)."""
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        prompts = _prompts((4, 6, 5), seed=3)
+        rids = [cb.submit(p, max_new_tokens=4) for p in prompts]
+        streams = {r: [] for r in rids}
+        while cb.has_work():
+            for rid, toks in cb.step().items():
+                streams[rid].extend(toks)
+        done = cb.finished()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                np.asarray(streams[rid], np.int32), done[rid][len(p):]
+            )

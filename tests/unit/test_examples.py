@@ -13,7 +13,7 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
 
 @pytest.mark.parametrize(
     "script",
-    ["train_gpt2.py", "bert_mlm.py",
+    ["train_gpt2.py", "bert_mlm.py", "serve_continuous.py",
      # speculative + hybrid example flows are unit-covered fast in
      # test_speculative / test_hybrid_engine; the subprocess runs pay a
      # full jax import + compile each on the 1-core host
