@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.decoding import (
+    cached_fn,
     compile_ragged_prefill_fn,
     compile_segment_fn,
     select_token,
@@ -40,11 +41,12 @@ from deepspeed_tpu.inference.decoding import (
 @dataclass
 class _Request:
     rid: int
-    prompt: np.ndarray  # (len,) int32
+    prompt: np.ndarray  # (len,) int32 — full prompt incl. any shared prefix
     max_new_tokens: int
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
+    prefix_id: Optional[int] = None  # registered shared-prefix id, if any
 
 
 def _bucket(n: int, cap: int, floor: int = 16) -> int:
@@ -82,11 +84,11 @@ class ContinuousBatchingEngine:
         self.cache = jax.device_put(
             tf.init_cache(self.cfg, max_slots, self.cache_len), cache_sh
         )
-        self._prefill_fns: Dict[int, object] = {}   # bucket -> B=1 ragged prefill
-        self._insert_fns: Dict[int, object] = {}    # bucket -> cache row splice
         self._cache_sh = cache_sh
 
         self._next_rid = 0
+        self._next_pid = 0
+        self._prefixes: Dict[int, dict] = {}  # prefix caching (register_prefix)
         self._pending: List[_Request] = []
         self._active: Dict[int, _Request] = {}      # slot -> request
         self._results: Dict[int, np.ndarray] = {}
@@ -98,6 +100,7 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         assert prompt.size > 0, "empty prompt"
+        assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
         assert prompt.size + max_new_tokens <= self.cache_len, (
             f"prompt {prompt.size} + max_new_tokens {max_new_tokens} exceeds "
             f"cache_len {self.cache_len}"
@@ -105,6 +108,59 @@ class ContinuousBatchingEngine:
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(_Request(rid, prompt, max_new_tokens))
+        return rid
+
+    def register_prefix(self, prefix_ids) -> int:
+        """Prefix (prompt) caching: prefill a shared prefix ONCE and reuse
+        its KV for every request submitted with ``prefix_id`` — the
+        system-prompt pattern, where admission then only pays prefill for
+        the per-request suffix. Returns a prefix id for submit_with_prefix.
+        """
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        assert prefix.size > 0, "empty prefix"
+        assert prefix.size < self.cache_len, "prefix does not fit the cache"
+        from deepspeed_tpu.models import transformer as tf
+
+        n = prefix.size
+        bucket = _bucket(n, self.cache_len)
+        prefill_fn, _ = self._fns_for_bucket(bucket)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prefix
+        positions = np.full((1, bucket), bucket, np.int32)
+        positions[0, :n] = np.arange(n, dtype=np.int32)
+        small = tf.init_cache(self.cfg, 1, bucket)
+        logits, small = prefill_fn(
+            self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
+        )
+        pid = self._next_pid  # counter, not len(): eviction must never recycle a live id
+        self._next_pid += 1
+        # keep the bucket cache on device; admission splices then prefills
+        # only the suffix at positions [n..)
+        self._prefixes[pid] = {"tokens": prefix, "cache": small, "bucket": bucket}
+        return pid
+
+    def unregister_prefix(self, prefix_id: int):
+        """Release a registered prefix's device-resident KV (a long-running
+        server must bound the pinned caches; in-flight requests that
+        already spliced it are unaffected)."""
+        self._prefixes.pop(prefix_id)
+
+    def submit_with_prefix(self, prefix_id: int, suffix_ids, max_new_tokens: int = 32) -> int:
+        """Queue a request whose prompt is (registered prefix + suffix);
+        the prefix KV is reused, only the suffix is prefilled."""
+        suffix = np.asarray(suffix_ids, np.int32).reshape(-1)
+        assert suffix.size > 0, "empty suffix (use submit for prefix-only prompts)"
+        pre = self._prefixes[prefix_id]
+        total = pre["tokens"].size + suffix.size
+        assert total + max_new_tokens <= self.cache_len, (
+            f"prefix {pre['tokens'].size} + suffix {suffix.size} + "
+            f"max_new_tokens {max_new_tokens} exceeds cache_len {self.cache_len}"
+        )
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid, np.concatenate([pre["tokens"], suffix]), max_new_tokens)
+        req.prefix_id = prefix_id
+        self._pending.append(req)
         return rid
 
     def has_work(self) -> bool:
@@ -151,8 +207,8 @@ class ContinuousBatchingEngine:
 
     # -- internals ------------------------------------------------------
     def _fns_for_bucket(self, bucket: int):
-        if bucket not in self._prefill_fns:
-            self._prefill_fns[bucket], small_sh, _ = compile_ragged_prefill_fn(
+        def build():
+            prefill_fn, small_sh, _ = compile_ragged_prefill_fn(
                 self.mesh, self.cfg, self._eng.param_shardings, 1, bucket
             )
 
@@ -167,33 +223,61 @@ class ContinuousBatchingEngine:
                     for k in ("k", "v")
                 }
 
-            self._insert_fns[bucket] = jax.jit(
+            insert_fn = jax.jit(
                 insert,
                 in_shardings=(self._cache_sh, small_sh, None),
                 out_shardings=self._cache_sh,
                 donate_argnums=(0,),
             )
-        return self._prefill_fns[bucket], self._insert_fns[bucket]
+            return prefill_fn, insert_fn
+
+        # shared bounded memoization (decoding.cached_fn); 8 slots cover
+        # every power-of-2 bucket up to 16 <= b <= 2048 without thrash
+        return cached_fn(self, "admit_bucket", bucket, build, slots=8)
 
     def _admit(self, req: _Request, slot: int) -> Optional[int]:
         from deepspeed_tpu.models import transformer as tf
 
         n = req.prompt.size
-        bucket = _bucket(n, self.cache_len)
-        prefill_fn, insert_fn = self._fns_for_bucket(bucket)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :n] = req.prompt
-        # pads park at bucket (dropped writes), real tokens pack 0..n-1
-        positions = np.full((1, bucket), bucket, np.int32)
-        positions[0, :n] = np.arange(n, dtype=np.int32)
-        small = tf.init_cache(self.cfg, 1, bucket)
-        logits, small = prefill_fn(
-            self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
-        )
-        self.cache = insert_fn(self.cache, small, slot)
+        if req.prefix_id is not None:
+            pre = self._prefixes[req.prefix_id]
+            n_pre = pre["tokens"].size
+            # 1) splice the cached prefix KV into the slot row (the prefix
+            #    bucket cache is NOT donated — it serves every request)
+            _, insert_fn = self._fns_for_bucket(pre["bucket"])
+            self.cache = insert_fn(self.cache, pre["cache"], slot)
+            # 2) prefill ONLY the suffix through the shared segment program:
+            #    other rows' positions park at cache_len so their KV writes
+            #    drop; suffix pad columns land at future positions of THIS
+            #    row, each overwritten by a real decode write before it is
+            #    ever attended (same argument as slot reuse)
+            suffix = req.prompt[n_pre:]
+            sb = _bucket(suffix.size, self.cache_len)
+            toks = np.zeros((self.max_slots, sb), np.int32)
+            toks[slot, :suffix.size] = suffix
+            pos = np.full(self.max_slots, self.cache_len, np.int32)
+            pos[slot] = n_pre
+            logits, self.cache = self._segment_fn(
+                self._eng.params, jnp.asarray(toks), self.cache, jnp.asarray(pos)
+            )
+            last_logits = logits[slot: slot + 1, suffix.size - 1]
+        else:
+            bucket = _bucket(n, self.cache_len)
+            prefill_fn, insert_fn = self._fns_for_bucket(bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = req.prompt
+            # pads park at bucket (dropped writes), real tokens pack 0..n-1
+            positions = np.full((1, bucket), bucket, np.int32)
+            positions[0, :n] = np.arange(n, dtype=np.int32)
+            small = tf.init_cache(self.cfg, 1, bucket)
+            logits, small = prefill_fn(
+                self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
+            )
+            self.cache = insert_fn(self.cache, small, slot)
+            last_logits = logits[:, n - 1]
         self._rng, sub = jax.random.split(self._rng)
         first = int(np.asarray(select_token(
-            logits[:, n - 1], self.temperature, self.top_k, sub, self.top_p
+            last_logits, self.temperature, self.top_k, sub, self.top_p
         ))[0])
         self._active[slot] = req
         req.slot = slot

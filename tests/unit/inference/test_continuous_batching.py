@@ -116,3 +116,66 @@ class TestContinuousBatching:
             np.testing.assert_array_equal(
                 np.asarray(streams[rid], np.int32), done[rid][len(p):]
             )
+
+    def test_prefix_caching_exact_parity(self, setup):
+        """register_prefix computes the shared-prefix KV once; requests
+        submitted with it must match full-prompt greedy generate EXACTLY,
+        even while another slot is mid-decode (no cross-slot corruption
+        from the suffix segment's parked rows)."""
+        model, params, plain = setup
+        rs = np.random.RandomState(5)
+        prefix = rs.randint(0, 128, (11,)).astype(np.int32)
+        sufs = [rs.randint(0, 128, (n,)).astype(np.int32) for n in (4, 7)]
+        other = rs.randint(0, 128, (6,)).astype(np.int32)
+
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        pid = cb.register_prefix(prefix)
+        r_other = cb.submit(other, max_new_tokens=10)
+        cb.step()
+        cb.step()
+        r0 = cb.submit_with_prefix(pid, sufs[0], max_new_tokens=6)
+        cb.step()
+        r1 = cb.submit_with_prefix(pid, sufs[1], max_new_tokens=6)
+        done = {}
+        while cb.has_work():
+            cb.step()
+            done.update(cb.finished())
+        for rid, full, mnt in [(r0, np.concatenate([prefix, sufs[0]]), 6),
+                               (r1, np.concatenate([prefix, sufs[1]]), 6),
+                               (r_other, other, 10)]:
+            want = np.asarray(plain.generate(full[None, :], max_new_tokens=mnt))[0]
+            np.testing.assert_array_equal(done[rid], want)
+
+    def test_prefix_capacity_checked(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=32)
+        pid = cb.register_prefix(np.arange(20, dtype=np.int32) % 128)
+        with pytest.raises(AssertionError, match="cache_len"):
+            cb.submit_with_prefix(pid, np.arange(8, dtype=np.int32), max_new_tokens=8)
+
+    def test_zero_max_new_tokens_rejected(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        with pytest.raises(AssertionError, match="max_new_tokens"):
+            cb.submit(np.arange(4, dtype=np.int32), max_new_tokens=0)
+
+    def test_unregister_prefix_releases(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        p1 = cb.register_prefix(np.arange(5, dtype=np.int32))
+        p2 = cb.register_prefix(np.arange(7, dtype=np.int32))
+        assert p1 != p2
+        cb.unregister_prefix(p1)
+        assert p1 not in cb._prefixes and p2 in cb._prefixes
+        p3 = cb.register_prefix(np.arange(3, dtype=np.int32))
+        assert p3 not in (p1, p2)  # counter-based ids are never recycled
+        with pytest.raises(KeyError):
+            cb.submit_with_prefix(p1, np.arange(2, dtype=np.int32))
