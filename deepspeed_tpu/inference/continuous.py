@@ -46,7 +46,9 @@ class _Request:
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     done: bool = False
-    prefix_id: Optional[int] = None  # registered shared-prefix id, if any
+    # snapshot of the registered prefix entry (tokens/cache/bucket), taken
+    # at submit time so unregister_prefix cannot strand a queued request
+    prefix: Optional[dict] = None
 
 
 def _bucket(n: int, cap: int, floor: int = 16) -> int:
@@ -150,6 +152,7 @@ class ContinuousBatchingEngine:
         the prefix KV is reused, only the suffix is prefilled."""
         suffix = np.asarray(suffix_ids, np.int32).reshape(-1)
         assert suffix.size > 0, "empty suffix (use submit for prefix-only prompts)"
+        assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
         pre = self._prefixes[prefix_id]
         total = pre["tokens"].size + suffix.size
         assert total + max_new_tokens <= self.cache_len, (
@@ -159,7 +162,7 @@ class ContinuousBatchingEngine:
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, np.concatenate([pre["tokens"], suffix]), max_new_tokens)
-        req.prefix_id = prefix_id
+        req.prefix = pre  # snapshot: queued requests survive unregister_prefix
         self._pending.append(req)
         return rid
 
@@ -239,8 +242,8 @@ class ContinuousBatchingEngine:
         from deepspeed_tpu.models import transformer as tf
 
         n = req.prompt.size
-        if req.prefix_id is not None:
-            pre = self._prefixes[req.prefix_id]
+        if req.prefix is not None:
+            pre = req.prefix
             n_pre = pre["tokens"].size
             # 1) splice the cached prefix KV into the slot row (the prefix
             #    bucket cache is NOT donated — it serves every request)

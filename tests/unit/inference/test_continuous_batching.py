@@ -179,3 +179,30 @@ class TestContinuousBatching:
         assert p3 not in (p1, p2)  # counter-based ids are never recycled
         with pytest.raises(KeyError):
             cb.submit_with_prefix(p1, np.arange(2, dtype=np.int32))
+
+    def test_unregister_does_not_strand_queued_request(self, setup):
+        """A submit_with_prefix request still in the queue must survive
+        unregister_prefix (the entry is snapshotted at submit time)."""
+        model, params, plain = setup
+        rs = np.random.RandomState(9)
+        prefix = rs.randint(0, 128, (6,)).astype(np.int32)
+        suffix = rs.randint(0, 128, (4,)).astype(np.int32)
+        blockers = [rs.randint(0, 128, (3,)).astype(np.int32) for _ in range(2)]
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=2, cache_len=64)
+        pid = cb.register_prefix(prefix)
+        for b in blockers:  # fill both slots so the prefix request queues
+            cb.submit(b, max_new_tokens=6)
+        cb.step()
+        rid = cb.submit_with_prefix(pid, suffix, max_new_tokens=4)
+        cb.unregister_prefix(pid)  # while rid is still pending
+        done = {}
+        while cb.has_work():
+            cb.step()
+            done.update(cb.finished())
+        full = np.concatenate([prefix, suffix])
+        want = np.asarray(plain.generate(full[None, :], max_new_tokens=4))[0]
+        np.testing.assert_array_equal(done[rid], want)
+        with pytest.raises(AssertionError, match="max_new_tokens"):
+            cb.submit_with_prefix(cb.register_prefix(prefix), suffix, max_new_tokens=0)

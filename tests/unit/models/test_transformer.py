@@ -270,6 +270,7 @@ class TestLocalAttentionWindows:
             local_attn_windows=(0, 3, 0, 3), **kw,
         )
 
+    @pytest.mark.slow  # 16s; the local-window masking math is covered fast at the op level (test_transformer_ops softmax_context local_window)
     def test_window_actually_masks(self):
         import jax
 

@@ -13,7 +13,10 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
 
 @pytest.mark.parametrize(
     "script",
-    ["train_gpt2.py", "bert_mlm.py", "serve_continuous.py",
+    ["train_gpt2.py", "bert_mlm.py",
+     # the serving loop is unit-covered fast (test_continuous_batching);
+     # the in-process example re-pays ~6 compiles cold
+     pytest.param("serve_continuous.py", marks=pytest.mark.slow),
      # speculative + hybrid example flows are unit-covered fast in
      # test_speculative / test_hybrid_engine; the subprocess runs pay a
      # full jax import + compile each on the 1-core host

@@ -302,15 +302,16 @@ class TestInt8Wire:
         fp_bytes = eng_fp.coordinator.stats["h2d_bytes"]
 
         eng_q = self._coordinator("int8")
-        losses = _train(eng_q, steps=4)
-        # compare per-step wire volume: int8 payload + fp32 scales ~ 0.52x bf16
-        q1 = self._coordinator("int8")
-        _train(q1, steps=1)
-        q1_bytes = q1.coordinator.stats["h2d_bytes"]
+        losses = _train(eng_q, steps=1)
+        # per-step wire volume after one step: int8 payload + fp32 scales
+        # ~ 0.52x bf16 (snapshot before training further)
+        q1_bytes = eng_q.coordinator.stats["h2d_bytes"]
         assert q1_bytes < 0.6 * fp_bytes, (q1_bytes, fp_bytes)
+        losses += _train(eng_q, steps=3, seed=1)
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow  # e2e 1%-loss bound; the per-element quant bound test stays fast
     def test_loss_close_to_model_wire(self):
         """First-step loss under the int8 wire must sit within ~1% of the
         exact bf16-wire loss (weight-only quantization at 8 bits)."""
