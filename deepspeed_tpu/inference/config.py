@@ -39,6 +39,11 @@ class SpeculativeConfig:
 @dataclass
 class InferenceConfig:
     dtype: str = "bfloat16"  # float32 | float16 | bfloat16 | int8 (weight quant)
+    # KV-cache storage format: "model" (cache in model dtype) or "int8"
+    # (per-token-per-head symmetric quantization — halves the cache-read
+    # bytes that bound decode at long context and doubles servable context;
+    # compute dequantizes at the attention read)
+    kv_cache_dtype: str = "model"
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     moe: MoEInferenceConfig = field(default_factory=MoEInferenceConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)

@@ -218,13 +218,14 @@ class ContinuousBatchingEngine:
             def insert(big, small, slot):
                 # splice the B=1 bucket cache into the shared cache row:
                 # positions [0..bucket) overwritten, staler junk beyond is
-                # causally masked until real writes reach it
-                return {
-                    k: jax.lax.dynamic_update_slice(
-                        big[k], small[k].astype(big[k].dtype), (0, slot, 0, 0, 0)
-                    )
-                    for k in ("k", "v")
-                }
+                # causally masked until real writes reach it (tree.map:
+                # also covers the int8 {"q8","s"} representation)
+                return jax.tree.map(
+                    lambda b, sm: jax.lax.dynamic_update_slice(
+                        b, sm.astype(b.dtype), (0, slot, 0, 0, 0)
+                    ),
+                    big, small,
+                )
 
             insert_fn = jax.jit(
                 insert,

@@ -67,13 +67,23 @@ class InferenceEngine:
             want_dtype = dtype_name
         elif self._weight_quant and cfg.dtype == "float32":
             want_dtype = "bfloat16"
+        if self.config.kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'model' or 'int8', got {self.config.kv_cache_dtype!r}"
+            )
+        overrides = {}
+        if self.config.kv_cache_dtype != cfg.kv_cache_dtype:
+            overrides["kv_cache_dtype"] = self.config.kv_cache_dtype
         if want_dtype is not None:
+            overrides["dtype"] = want_dtype
+        if overrides:
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, dtype=want_dtype)
+            cfg = dataclasses.replace(cfg, **overrides)
             if builtin:
                 self.model = tf.TransformerModel(cfg)
-            else:
+        if want_dtype is not None:
+            if not builtin:
                 # custom model object: keep it (its apply defines the network);
                 # cfg carries the override so caches/compute use the new dtype
                 logger.warning(
@@ -405,7 +415,12 @@ def init_inference(model, config=None, params=None, mesh=None, draft_model=None,
     engine = InferenceEngine(model, config=config, params=params, mesh=mesh, seed=seed)
     if draft_model is not None:
         engine._draft_engine = InferenceEngine(
-            draft_model, config={"dtype": engine.config.dtype},
+            draft_model,
+            # the draft shares the cache format: int8 KV's memory halving
+            # must cover both engines or long-context speculative serving
+            # silently loses it
+            config={"dtype": engine.config.dtype,
+                    "kv_cache_dtype": engine.config.kv_cache_dtype},
             params=draft_params, mesh=mesh, seed=seed,
         )
     return engine

@@ -80,6 +80,10 @@ class TransformerConfig:
     # of 128). Larger tiles amortize the softmax running-max bookkeeping
     # against HBM re-reads of K/V; the bench self-tune probes this.
     flash_block: Optional[int] = None
+    # KV-cache storage: "model" dtype or "int8" (per-token-per-head scales;
+    # decode reads half the cache bytes, context capacity doubles — the
+    # quantize/dequantize lives in ops/transformer/inference_ops)
+    kv_cache_dtype: str = "model"
     # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -950,9 +954,18 @@ def head_loss_fwd(params, cfg: TransformerConfig, x, batch, denom=None):
 # ---------------------------------------------------------------------------
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] = None):
-    """Per-layer KV cache: (L, B, T, kv_heads, head_dim) in model dtype."""
+    """Per-layer KV cache: (L, B, T, kv_heads, head_dim) in model dtype —
+    or, with ``kv_cache_dtype="int8"``, {"q8": int8, "s": f32 per-token-
+    per-head scales} per component (half the decode-read bytes; the
+    quantized write / dequantized read live in inference_ops)."""
     T = max_len or cfg.max_seq_len
     shape = (cfg.num_layers, batch_size, T, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_cache_dtype == "int8":
+        def q_component():
+            return {"q8": jnp.zeros(shape, jnp.int8),
+                    "s": jnp.zeros(shape[:-1] + (1,), jnp.float32)}
+
+        return {"k": q_component(), "v": q_component()}
     return {
         "k": jnp.zeros(shape, cfg.jnp_dtype),
         "v": jnp.zeros(shape, cfg.jnp_dtype),

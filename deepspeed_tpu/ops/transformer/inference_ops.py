@@ -57,9 +57,35 @@ def apply_rotary_pos_emb(x, positions, theta: float = 10000.0,
     return out.astype(x.dtype)
 
 
+def quantize_kv(x):
+    """Per-token-per-head symmetric int8 quantization of (B, S, H, hd)
+    keys/values (the int8 KV-cache write; scales keep the trailing dim)."""
+    a = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-8)
+    q = jnp.clip(jnp.round(a / s), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(cache_component, dtype):
+    """{"q8","s"} int8 cache component -> dense (B, T, H, hd) in dtype.
+    Under jit the convert+multiply fuses into the attention read, so HBM
+    traffic is the int8 payload + scales."""
+    return (cache_component["q8"].astype(jnp.float32) * cache_component["s"]).astype(dtype)
+
+
+def _write_component(cache, new, pos, positions):
+    if jnp.ndim(pos) == 0:
+        return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype), (0, pos, 0, 0))
+    rows = jnp.arange(new.shape[0], dtype=jnp.int32)[:, None]
+    cols = positions  # (B, S) absolute positions of the new tokens
+    return cache.at[rows, cols].set(new.astype(cache.dtype), mode="drop")
+
+
 def update_kv_cache(k_cache, v_cache, k_new, v_new, pos,
                     positions=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Write S new keys/values into (B, T, H, hd) caches.
+    """Write S new keys/values into (B, T, H, hd) caches (or int8
+    {"q8","s"} cache components — the write quantizes per token/head).
 
     ``pos`` scalar: contiguous write at offset pos (plain prefill/decode).
     ``pos`` (B,) vector with ``positions`` (B, S): per-row scatter — the
@@ -67,15 +93,14 @@ def update_kv_cache(k_cache, v_cache, k_new, v_new, pos,
     own depth; out-of-bounds columns (>= T) are dropped, matching the
     clamped read mask in :func:`softmax_context`.
     """
-    if jnp.ndim(pos) == 0:
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), (0, pos, 0, 0))
-    else:
-        rows = jnp.arange(k_new.shape[0], dtype=jnp.int32)[:, None]
-        cols = positions  # (B, S) absolute positions of the new tokens
-        k_cache = k_cache.at[rows, cols].set(k_new.astype(k_cache.dtype), mode="drop")
-        v_cache = v_cache.at[rows, cols].set(v_new.astype(v_cache.dtype), mode="drop")
-    return k_cache, v_cache
+    def write(cache, new):
+        if isinstance(cache, dict):
+            q, s = quantize_kv(new)
+            return {"q8": _write_component(cache["q8"], q, pos, positions),
+                    "s": _write_component(cache["s"], s, pos, positions)}
+        return _write_component(cache, new, pos, positions)
+
+    return write(k_cache, k_new), write(v_cache, v_new)
 
 
 def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
@@ -96,6 +121,9 @@ def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     query to the last ``local_window`` key positions (GPT-Neo local layers).
     """
     B, S, nh, hd = q.shape
+    if isinstance(k_cache, dict):  # int8 KV cache: dequant at the read
+        k_cache = dequantize_kv(k_cache, q.dtype)
+        v_cache = dequantize_kv(v_cache, q.dtype)
     nkv = k_cache.shape[2]
     kk, vv = k_cache, v_cache
     if nkv != nh:
