@@ -304,29 +304,49 @@ class TestInt8KVCache:
         got = softmax_context(q, {"q8": kq, "s": ks}, {"q8": vq, "s": vs}, pos=7)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=0.08, atol=0.05)
 
-    def test_engine_int8_cache_and_generate(self):
-        import deepspeed_tpu
-        from deepspeed_tpu import comm
-        from deepspeed_tpu.models import transformer as tf
+    @staticmethod
+    def _tiny_models():
         from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
 
-        comm.destroy()
         cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
                                 num_heads=4, num_kv_heads=2, max_seq_len=128,
                                 dtype="float32")
         model = TransformerModel(cfg)
-        params = model.init(jax.random.PRNGKey(0))
+        return model, model.init(jax.random.PRNGKey(0))
+
+    def test_engine_wires_int8_cache(self):
+        """FAST engine-plumbing check: kv_cache_dtype='int8' must reach
+        cfg/init_cache (a silent fallback to the fp cache would pass the
+        op-level tests); cache bytes < 0.45x fp32."""
+        import deepspeed_tpu
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.models import transformer as tf
+
+        comm.destroy()
+        model, params = self._tiny_models()
+        q8 = deepspeed_tpu.init_inference(model, params=params,
+                                          config={"dtype": "float32",
+                                                  "kv_cache_dtype": "int8"})
+        assert q8.cfg.kv_cache_dtype == "int8"
+        c_fp = tf.init_cache(model.cfg, 2, 64)
+        c_q8 = tf.init_cache(q8.cfg, 2, 64)
+        assert c_q8["k"]["q8"].dtype == jnp.int8
+        bytes_fp = sum(l.nbytes for l in jax.tree.leaves(c_fp))
+        bytes_q8 = sum(l.nbytes for l in jax.tree.leaves(c_q8))
+        assert bytes_q8 < 0.45 * bytes_fp, (bytes_q8, bytes_fp)  # fp32: 4B -> ~1.5B
+
+    @pytest.mark.slow  # generate-parity half; the engine plumbing + op-level tests stay fast
+    def test_engine_int8_generate_parity(self):
+        import deepspeed_tpu
+        from deepspeed_tpu import comm
+
+        comm.destroy()
+        model, params = self._tiny_models()
         fp = deepspeed_tpu.init_inference(model, params=params,
                                           config={"dtype": "float32"})
         q8 = deepspeed_tpu.init_inference(model, params=params,
                                           config={"dtype": "float32",
                                                   "kv_cache_dtype": "int8"})
-        # cache halves: int8 payload + 1/hd scales
-        c_fp = tf.init_cache(fp.cfg, 2, 64)
-        c_q8 = tf.init_cache(q8.cfg, 2, 64)
-        bytes_fp = sum(l.nbytes for l in jax.tree.leaves(c_fp))
-        bytes_q8 = sum(l.nbytes for l in jax.tree.leaves(c_q8))
-        assert bytes_q8 < 0.45 * bytes_fp, (bytes_q8, bytes_fp)  # fp32 model: 4B -> ~1.5B
         rs = np.random.RandomState(0)
         toks = rs.randint(0, 128, (2, 12)).astype(np.int32)
         a = np.asarray(fp.generate(toks, max_new_tokens=12))

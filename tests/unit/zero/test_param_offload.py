@@ -336,6 +336,24 @@ class TestInt8Wire:
         with pytest.raises(ValueError, match="wire_dtype"):
             deepspeed_tpu.initialize(model=_model(), config=cfg)
 
+    def test_set_working_reassembles_surface(self):
+        """FAST regression guard for the r4 set_working bug: under the int8
+        wire, set_working must re-assemble working['layers'] so the params
+        surface shows the (re)quantized values compute sees (set_working is
+        only reached from the restore path; no other fast test hits it with
+        wire_dtype=int8)."""
+        import jax as _jax
+
+        eng = self._coordinator("int8")
+        coord = eng.coordinator
+        before = _jax.tree.map(np.array, eng.params)
+        coord.set_working(before)
+        surf = _jax.tree.leaves(coord.working["layers"])
+        store = _jax.tree.leaves(coord._assemble_layers())
+        for a, b in zip(surf, store):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.slow  # two-coordinator save/load e2e; the fast set_working re-assembly guard above covers the regression
     def test_restore_surface_matches_compute(self, tmp_path):
         """After checkpoint restore under the int8 wire, engine.params must
         show the (re)quantized values compute will see, not the raw
