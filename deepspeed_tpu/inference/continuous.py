@@ -141,10 +141,19 @@ class ContinuousBatchingEngine:
         self._prefixes[pid] = {"tokens": prefix, "cache": small, "bucket": bucket}
         return pid
 
+    def _require_prefix(self, prefix_id: int) -> dict:
+        try:
+            return self._prefixes[prefix_id]
+        except KeyError:
+            raise KeyError(
+                f"unknown prefix id {prefix_id}: never registered or already "
+                f"unregistered (live ids: {sorted(self._prefixes)})") from None
+
     def unregister_prefix(self, prefix_id: int):
         """Release a registered prefix's device-resident KV (a long-running
         server must bound the pinned caches; in-flight requests that
         already spliced it are unaffected)."""
+        self._require_prefix(prefix_id)
         self._prefixes.pop(prefix_id)
 
     def submit_with_prefix(self, prefix_id: int, suffix_ids, max_new_tokens: int = 32) -> int:
@@ -153,7 +162,7 @@ class ContinuousBatchingEngine:
         suffix = np.asarray(suffix_ids, np.int32).reshape(-1)
         assert suffix.size > 0, "empty suffix (use submit for prefix-only prompts)"
         assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
-        pre = self._prefixes[prefix_id]
+        pre = self._require_prefix(prefix_id)
         total = pre["tokens"].size + suffix.size
         assert total + max_new_tokens <= self.cache_len, (
             f"prefix {pre['tokens'].size} + suffix {suffix.size} + "

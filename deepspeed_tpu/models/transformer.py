@@ -583,7 +583,9 @@ def _mlp_block(h, mlp_p, cfg: TransformerConfig, dropout_rng=None, decode=False)
                      if k.startswith("res_")}
             dense_out = expert_fn(res_p, h)
             coef = jax.nn.softmax(h @ mlp_p["coef_w"] + mlp_p["coef_b"], axis=-1)
-            mlp_out = dense_out * coef[..., 0:1] + mlp_out * coef[..., 1:2]
+            # channel 0 scales the expert branch, channel 1 the dense MLP
+            # (reference moe/layer.py:123 coefficient order)
+            mlp_out = mlp_out * coef[..., 0:1] + dense_out * coef[..., 1:2]
         return mlp_out, aux
     aux = jnp.float32(0.0)
     if cfg.activation == "silu_glu":

@@ -53,7 +53,8 @@ class MoE:
     ``use_residual=True`` is PR-MoE (reference moe/layer.py:28,45): a dense
     MLP (same shape as one expert) runs every token, and a learned per-token
     2-way softmax coefficient mixes it with the MoE output:
-    ``out = dense * coef[..., :1] + moe * coef[..., 1:2]``.
+    ``out = moe * coef[..., :1] + dense * coef[..., 1:2]`` (reference
+    moe/layer.py:123 channel order).
     """
 
     def __init__(
@@ -134,7 +135,9 @@ class MoE:
             dense_out = self.expert.apply(params["residual_mlp"], x)
             coef_p = params["coefficient"]
             coef = jax.nn.softmax(x @ coef_p["w"] + coef_p["b"], axis=-1)
-            moe_out = dense_out * coef[..., 0:1] + moe_out * coef[..., 1:2]
+            # channel order matches reference moe/layer.py:123:
+            # channel 0 scales the expert branch, channel 1 the dense MLP
+            moe_out = moe_out * coef[..., 0:1] + dense_out * coef[..., 1:2]
         return moe_out, l_aux, exp_counts
 
     __call__ = apply

@@ -309,7 +309,10 @@ class ParamOffloadCoordinator:
 
     def _store_put(self, g: int, flat: Dict[str, np.ndarray]):
         """Write one group's model-dtype leaves, quantizing the weight wire
-        when configured (also halves host RAM / NVMe traffic)."""
+        when configured. Halves the store copy and NVMe traffic only —
+        total host RAM is NOT reduced, because the assembled model-dtype
+        params surface plus the fp32 masters are kept alongside (see
+        zero/config.py wire_dtype doc)."""
         out = {}
         for k, arr in flat.items():
             if k in self._quant_keys:

@@ -179,6 +179,8 @@ class TestContinuousBatching:
         assert p3 not in (p1, p2)  # counter-based ids are never recycled
         with pytest.raises(KeyError):
             cb.submit_with_prefix(p1, np.arange(2, dtype=np.int32))
+        with pytest.raises(KeyError, match="unknown prefix id"):
+            cb.unregister_prefix(p1)  # double release fails loudly, names the id
 
     def test_unregister_does_not_strand_queued_request(self, setup):
         """A submit_with_prefix request still in the queue must survive

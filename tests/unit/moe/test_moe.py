@@ -168,28 +168,30 @@ class TestResidualMoE:
     dense MLP + expert mix with a learned per-token softmax coefficient."""
 
     def test_coef_zero_equals_dense(self):
-        """Coefficient pinned to (1, 0): output must equal the dense
-        residual MLP exactly (the MoE branch is gated out)."""
+        """Coefficient pinned to (0, 1): output must equal the dense
+        residual MLP exactly (the MoE branch is gated out). Channel order
+        matches reference moe/layer.py:123 — channel 1 scales the dense MLP."""
         D = 16
         moe = MoE(hidden_size=D, num_experts=4, k=1, capacity_factor=2.0,
                   ffn_size=32, use_residual=True)
         params = moe.init(jax.random.PRNGKey(0))
-        # softmax(+20, -20) == (1, 0) to fp32 precision
+        # softmax(-20, +20) == (0, 1) to fp32 precision
         params["coefficient"]["w"] = jnp.zeros_like(params["coefficient"]["w"])
-        params["coefficient"]["b"] = jnp.asarray([20.0, -20.0], jnp.float32)
+        params["coefficient"]["b"] = jnp.asarray([-20.0, 20.0], jnp.float32)
         x = jnp.asarray(np.random.RandomState(0).randn(2, 8, D).astype(np.float32))
         out, aux, _ = moe.apply(params, x)
         dense = moe.expert.apply(params["residual_mlp"], x.reshape(-1, D)).reshape(x.shape)
         np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=1e-5, atol=1e-6)
 
     def test_coef_one_equals_moe(self):
-        """Coefficient pinned to (0, 1): output must equal the plain MoE."""
+        """Coefficient pinned to (1, 0): output must equal the plain MoE
+        (channel 0 scales the expert branch, per reference moe/layer.py:123)."""
         D = 16
         kw = dict(hidden_size=D, num_experts=4, k=1, capacity_factor=2.0, ffn_size=32)
         res = MoE(**kw, use_residual=True)
         params = res.init(jax.random.PRNGKey(0))
         params["coefficient"]["w"] = jnp.zeros_like(params["coefficient"]["w"])
-        params["coefficient"]["b"] = jnp.asarray([-20.0, 20.0], jnp.float32)
+        params["coefficient"]["b"] = jnp.asarray([20.0, -20.0], jnp.float32)
         plain = MoE(**kw)
         plain_params = {"gate": params["gate"], "experts": params["experts"]}
         x = jnp.asarray(np.random.RandomState(1).randn(2, 8, D).astype(np.float32))
