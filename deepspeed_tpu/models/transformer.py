@@ -701,6 +701,62 @@ from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as _ckp
 from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import resolve_policy as _resolve_remat_policy  # noqa: E402
 
 
+def _constrain_tp(p, logical_names):
+    """Pin a parameter to its tensor-parallel compute sharding (the logical
+    spec WITHOUT the ZeRO fsdp dim) at its use site.
+
+    For the embedding tables this is what makes the gradient scatter-add
+    partition well: the constraint's transpose pins the table cotangent to
+    the same spec, so GSPMD scatters batch-sharded updates locally and
+    psums over the batch axes, instead of resharding the full (B, S, D)
+    hidden-state gradient from batch sharding to the fsdp grad-accumulator
+    spec — its only plan for that is a replicate-then-repartition of the
+    whole tensor ("[SPMD] Involuntary full rematerialization")."""
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.runtime.zero.sharding import logical_to_mesh_spec
+
+    # is_initialized guard: get_mesh() would auto-create a default all-data
+    # mesh, silently initializing global comm state from a bare forward()
+    if not comm.is_initialized():
+        return p
+    mesh = comm.get_mesh()
+    spec = logical_to_mesh_spec(logical_names)
+    return jax.lax.with_sharding_constraint(p, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _constrain_batch_sharding(x):
+    """Pin (B, S, ...) activations to batch sharding: dim0 over (data, fsdp),
+    dim1 over sequence, trailing dims unconstrained.
+
+    The constraint's transpose applies the same spec to the cotangent, so the
+    hidden-state gradient leaving the backward layer scan stays batch-sharded.
+    Without it, GSPMD propagates the (fsdp-sharded) embedding-grad-accumulator
+    spec backwards onto the full (B, S, D) gradient, and its only way from
+    batch-sharding to hidden-sharding there is a replicate-then-repartition of
+    the whole tensor — the "[SPMD] Involuntary full rematerialization" warning
+    (a full-tensor all-gather per step on the ZeRO-3 offload path)."""
+    from deepspeed_tpu import comm
+
+    if not comm.is_initialized() or x.ndim < 2:
+        return x
+    mesh = comm.get_mesh()
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if not batch_axes:
+        return x
+    dp = 1
+    for a in batch_axes:
+        dp *= mesh.shape[a]
+    if x.shape[0] % dp != 0:
+        return x  # unshardable batch (e.g. odd eval shapes): leave it alone
+    U = jax.sharding.PartitionSpec.UNCONSTRAINED
+    sub = mesh.shape.get("sequence", 1)
+    seq = "sequence" if sub > 1 and x.shape[1] % sub == 0 else U
+    spec = jax.sharding.PartitionSpec(
+        batch_axes if len(batch_axes) > 1 else batch_axes[0], seq, *([U] * (x.ndim - 2))
+    )
+    return jax.lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+
+
 def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
             ltd_keep_len=None, pld_theta=None, token_types=None, return_hidden=False):
     """tokens (B, S) int32 -> (logits (B, S, V), moe_aux_loss scalar).
@@ -714,16 +770,23 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     """
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
-    x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
+    x = jnp.take(_constrain_tp(params["embed"]["tok"], ("vocab", "embed")),
+                 tokens, axis=0).astype(dtype)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     if cfg.pos_embedding == "learned":
-        x = x + params["embed"]["pos"][:S].astype(dtype)
+        pos_t = _constrain_tp(params["embed"]["pos"], ("seq", "embed"))
+        # explicit broadcast: the implicit (1, S, D) rank-promotion leaves a
+        # keepdims reduce in the transpose whose unit dim drags the batch
+        # sharding along, and GSPMD can only reshard that to the fsdp grad
+        # spec by replicating ("[SPMD] Involuntary full rematerialization")
+        x = x + jnp.broadcast_to(pos_t[:S].astype(dtype), x.shape)
     if cfg.type_vocab_size > 0:
         tt = token_types if token_types is not None else jnp.zeros_like(tokens)
         x = x + jnp.take(params["embed"]["type"], tt, axis=0).astype(dtype)
     if cfg.embed_norm:
         en = params["embed_norm"]
         x = _norm(x, en["scale"], en.get("bias"), cfg)
+    x = _constrain_batch_sharding(x)
 
     ltd_on = (
         cfg.random_ltd and ltd_keep_len is not None and 0 < int(ltd_keep_len) < S

@@ -375,3 +375,64 @@ class TestInt8Wire:
         # refresh (no silent params/compute divergence window)
         l_restored = float(eng2.forward(_batch(seed=3)))
         assert np.isfinite(l_restored)
+
+
+class TestNoInvoluntaryRemat:
+    """VERDICT r4 #4: the zero3+cpu-offload step must compile without XLA's
+    "[SPMD] Involuntary full rematerialization" fallback.
+
+    Root cause (r5): not the H2D feed — the embedding-gradient scatter-add.
+    GSPMD propagated the fsdp-sharded grad-accumulator spec backwards onto
+    the full (B, S, D) hidden-state gradient, and its only plan from batch
+    sharding to hidden sharding is replicate-then-repartition (a full
+    all-gather of the activation-gradient tensor per step at scale). Fixed
+    by pinning the embedding tables to their TP compute sharding at the use
+    site (models/transformer.py:_constrain_tp): the constraint's transpose
+    pins the table cotangents, so the scatter stays batch-partitioned and
+    psums over the batch axes instead.
+
+    capfd captures OS-level stderr, which is where XLA's C++ logging goes.
+    On a warm persistent compile cache the check is vacuous (no SPMD pass
+    runs), but any model/engine code change invalidates the cache, so a
+    regression recompiles and is caught.
+    """
+
+    def test_zero3_offload_step_compiles_clean(self, capfd):
+        import jax
+
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.models.transformer import TransformerConfig
+
+        comm.destroy()
+        cfg = TransformerConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=32, dtype="bfloat16",
+        )
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 3,
+                "offload_optimizer": {"device": "cpu", "wire_dtype": "bfloat16"},
+            },
+            "mesh": {"data": 2, "fsdp": 4},
+            "steps_per_print": 1000000,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=TransformerModel(cfg), config=config
+        )
+        batch = {
+            "input_ids": np.random.RandomState(0)
+            .randint(0, 128, (8, 32))
+            .astype(np.int32)
+        }
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        jax.block_until_ready(engine.params)
+        err = capfd.readouterr().err
+        assert "Involuntary full rematerialization" not in err, (
+            "zero3+offload step hit GSPMD's replicate-then-repartition "
+            "fallback again:\n" + err[-2000:]
+        )
