@@ -52,6 +52,15 @@ def initialize(
 
         model = _FnModel(loss_fn, params)
 
+    # multi-controller rendezvous FIRST: every later step (config device
+    # count, autotuner memory model, engine mesh) queries the backend, and
+    # the first query pins it — joining the coordinator after that would
+    # leave each process seeing only its local devices (reference analogue:
+    # dist.init_process_group before any engine setup, engine.py:249)
+    from deepspeed_tpu.comm.comm import _maybe_init_multi_controller
+
+    _maybe_init_multi_controller()
+
     # elastic restart (dstpu --elastic, launcher/runner.py): resume from the
     # latest checkpoint at the current chip count before building a fresh
     # engine. elastic_resume re-enters initialize() with the guard env set.
@@ -95,7 +104,8 @@ def initialize(
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
         engine = PipelineEngine(
-            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh
+            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh,
+            collate_fn=collate_fn,
         )
     elif cfg.hybrid_engine.enabled:
         # RLHF engine: train step + compiled generate on shared weights
@@ -103,7 +113,8 @@ def initialize(
         from deepspeed_tpu.runtime.hybrid_engine import TpuHybridEngine
 
         engine = TpuHybridEngine(
-            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh
+            model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh,
+            collate_fn=collate_fn,
         )
     else:
         engine = TpuEngine(
@@ -113,6 +124,7 @@ def initialize(
             lr_scheduler=lr_scheduler,
             training_data=training_data,
             mesh=mesh,
+            collate_fn=collate_fn,
         )
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

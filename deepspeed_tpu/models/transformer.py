@@ -782,7 +782,10 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
         x = x + jnp.broadcast_to(pos_t[:S].astype(dtype), x.shape)
     if cfg.type_vocab_size > 0:
         tt = token_types if token_types is not None else jnp.zeros_like(tokens)
-        x = x + jnp.take(params["embed"]["type"], tt, axis=0).astype(dtype)
+        # same scatter-grad constraint as tok/pos (logical (None, "embed"),
+        # matching logical_specs for the type table)
+        type_t = _constrain_tp(params["embed"]["type"], (None, "embed"))
+        x = x + jnp.take(type_t, tt, axis=0).astype(dtype)
     if cfg.embed_norm:
         en = params["embed_norm"]
         x = _norm(x, en["scale"], en.get("bias"), cfg)

@@ -153,6 +153,7 @@ class TpuEngine:
         training_data=None,
         seed: Optional[int] = None,
         mesh=None,
+        collate_fn=None,
     ):
         self.config = config
         self.model = model
@@ -402,7 +403,7 @@ class TpuEngine:
         # --- dataloader
         self.training_dataloader = None
         if training_data is not None:
-            self.training_dataloader = self.deepspeed_io(training_data)
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
         # --- checkpoint engine (config checkpoint.async_save selects the
         # non-blocking engine — the reference's Nebula async service seam)
@@ -767,13 +768,58 @@ class TpuEngine:
 
     def _shard_batch(self, batch):
         spec = self._batch_pspec()
+        nprocs = jax.process_count()
+        expected_rows = self.train_micro_batch_size_per_gpu * comm.dp_world_size()
 
         def put(x):
-            x = jnp.asarray(x)
+            if nprocs == 1:
+                x = jnp.asarray(x)
+                if x.ndim == 0:
+                    return x
+                leaf_spec = PartitionSpec(*tuple(spec)[: x.ndim])
+                return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+            # multi-controller: assemble the global array from per-process
+            # data (device_put cannot place onto non-addressable devices).
+            # Along the batch dim (the first spec entry carrying data/fsdp —
+            # dim 0 here, dim 1 for the pipeline engine's (microbatch, batch,
+            # seq) layout) two feed shapes are accepted: the process-local
+            # slice the striding TpuDataLoader yields, or a full global copy
+            # (every process passing the SAME array) which is sliced down to
+            # this process's contiguous block, matching the mesh's process-
+            # major device order. A global feed whose batch dim happens to
+            # equal the local size is interpreted as local — when batch
+            # sizes collide, feed local slices (the reference's convention:
+            # each rank feeds its own rows).
+            x = np.asarray(x)
             if x.ndim == 0:
-                return x
+                return jnp.asarray(x)
             leaf_spec = PartitionSpec(*tuple(spec)[: x.ndim])
-            return jax.device_put(x, NamedSharding(self.mesh, leaf_spec))
+            sh = NamedSharding(self.mesh, leaf_spec)
+            bdim = None
+            for i, e in enumerate(tuple(leaf_spec)):
+                axes = (e,) if isinstance(e, str) else tuple(e or ())
+                if {"data", "fsdp"} & set(axes):
+                    bdim = i
+                    break
+            if bdim is None:  # replicated leaf: full copy on every process
+                return jax.make_array_from_process_local_data(sh, x)
+            rows = x.shape[bdim]
+            if expected_rows % nprocs == 0 and rows == expected_rows // nprocs:
+                pass  # striding-loader local slice
+            elif rows % nprocs == 0:
+                per = rows // nprocs
+                sl = [slice(None)] * x.ndim
+                sl[bdim] = slice(jax.process_index() * per,
+                                 (jax.process_index() + 1) * per)
+                x = x[tuple(sl)]
+            else:
+                raise ValueError(
+                    f"multi-controller batch leaf has {rows} rows on dim "
+                    f"{bdim}: expected the process-local "
+                    f"{expected_rows // max(nprocs, 1)} rows (striding "
+                    f"dataloader) or a global copy divisible by "
+                    f"process_count={nprocs}")
+            return jax.make_array_from_process_local_data(sh, x)
 
         return jax.tree.map(put, batch)
 

@@ -286,7 +286,7 @@ class PipelineModuleModel:
 
 
 class PipelineEngine(TpuEngine):
-    def __init__(self, model, config, optimizer=None, lr_scheduler=None, training_data=None, mesh=None, seed=None):
+    def __init__(self, model, config, optimizer=None, lr_scheduler=None, training_data=None, mesh=None, seed=None, collate_fn=None):
         mesh_sizes = config.mesh_axis_sizes()
         pipe_axis = mesh_sizes.get("pipe", 1)
         num_stages = config.pipeline.stages if config.pipeline.stages > 1 else pipe_axis
@@ -308,7 +308,8 @@ class PipelineEngine(TpuEngine):
         cfg2.gradient_accumulation_steps = 1
         self._full_batch_rows = None  # set below
         super().__init__(model, cfg2, optimizer=optimizer, lr_scheduler=lr_scheduler,
-                         training_data=training_data, mesh=mesh, seed=seed)
+                         training_data=training_data, mesh=mesh, seed=seed,
+                         collate_fn=collate_fn)
         self.gradient_accumulation_steps = 1
         mb_global = config.train_micro_batch_size_per_gpu * comm.dp_world_size()
         self._mb_global = mb_global
@@ -324,6 +325,8 @@ class PipelineEngine(TpuEngine):
         return PartitionSpec(None, ("data", "fsdp"), "sequence")
 
     def _shard_batch(self, batch):
+        nprocs = jax.process_count()
+
         def fix(x):
             x = np.asarray(x)
             if (
@@ -331,7 +334,25 @@ class PipelineEngine(TpuEngine):
                 and x.ndim >= 1
                 and x.shape[0] == self._full_batch_rows
             ):
+                # flat global rows -> (microbatch, global batch); the parent
+                # then slices the batch dim (dim 1 in our pspec) per process
                 x = x.reshape((self.micro_batches, self._mb_global) + x.shape[1:])
+            elif (
+                nprocs > 1
+                and self._full_batch_rows
+                and x.ndim >= 1
+                and x.shape[0] == self._full_batch_rows // nprocs
+            ):
+                # a flat PROCESS-LOCAL feed is ambiguous for the pipeline:
+                # contiguous rows would decompose into whole microbatches,
+                # not each microbatch's local slice. The striding dataloader
+                # path is fine (collect_microbatches stacks one loader pull
+                # per microbatch -> (M, local, ...)); anything else must
+                # feed the full global rows.
+                raise ValueError(
+                    f"pipeline multi-controller feed: got flat "
+                    f"{x.shape[0]} rows; pass the full global "
+                    f"{self._full_batch_rows} rows (or use the dataloader)")
             return x
 
         batch = jax.tree.map(fix, batch)
