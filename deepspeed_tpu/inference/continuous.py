@@ -2,10 +2,10 @@
 
 Modern serving capability BEYOND the v0.9.1 reference (its inference
 engine generates one static batch at a time; continuous batching arrived
-in later serving stacks): a fixed pool of ``max_slots`` sequence slots
-shares one KV cache, new requests are admitted into free slots while
-other slots keep decoding, and finished sequences free their slot
-immediately — no head-of-line blocking on the longest sequence.
+in later serving stacks): a fixed pool of sequence slots shares KV cache,
+new requests are admitted into free slots while other slots keep decoding,
+and finished sequences free their slot immediately — no head-of-line
+blocking on the longest sequence.
 
 TPU-shaped design: everything is static-shape. The decode tick is the
 existing per-row-position segment program (inference/decoding.py
@@ -15,11 +15,22 @@ B=1 ragged prefill into a small bucket-length cache and a compiled
 reuse needs no cache clearing: admission overwrites [0..len) and the
 causal position mask hides anything staler.
 
+Bucketed KV (VERDICT r4 #9): a single pool reserves ``cache_len`` for
+every slot — at long contexts most of that HBM idles under short requests.
+``cache_buckets=[(slots, len), ...]`` instead partitions the slots into
+pools with different cache lengths; admission places each request in the
+smallest-length pool it fits (prompt + max_new_tokens), falling back to
+longer pools when full. Each pool keeps its own static-shape segment
+program and cache, so this is the static-shape TPU analogue of paged KV:
+footprint sum(slots_i * len_i) instead of max_slots * max_len, no
+page-table gather in the attention kernel. ``kv_cache_bytes()`` reports
+the footprint for both layouts.
+
     eng = ContinuousBatchingEngine(model, config={"dtype": "bfloat16"},
-                                   max_slots=8)
+                                   cache_buckets=[(6, 256), (2, 2048)])
     rid = eng.submit([12, 7, 99], max_new_tokens=32)
     while eng.has_work():
-        eng.step()            # one decode tick for every active slot
+        eng.step()            # one decode tick per non-empty pool
     out = eng.result(rid)     # prompt + generated tokens (np.int32)
 """
 
@@ -45,6 +56,7 @@ class _Request:
     max_new_tokens: int
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None
+    pool: Optional[int] = None
     done: bool = False
     # snapshot of the registered prefix entry (tokens/cache/bucket), taken
     # at submit time so unregister_prefix cannot strand a queued request
@@ -58,11 +70,37 @@ def _bucket(n: int, cap: int, floor: int = 16) -> int:
     return min(b, cap)
 
 
+class _Pool:
+    """One static-shape slot pool: ``n_slots`` rows of ``length`` KV."""
+
+    def __init__(self, engine, n_slots: int, length: int):
+        from deepspeed_tpu.models import transformer as tf
+
+        self.n_slots = n_slots
+        self.length = length
+        self.segment_fn, self.cache_sh, _ = compile_segment_fn(
+            engine.mesh, engine.cfg, engine._eng.param_shardings, n_slots, length
+        )
+        self.cache = jax.device_put(
+            tf.init_cache(engine.cfg, n_slots, length), self.cache_sh
+        )
+        self.active: Dict[int, _Request] = {}       # slot -> request
+        self.pos = np.zeros(n_slots, np.int32)      # next write position
+        self.last_tok = np.zeros(n_slots, np.int32)
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.n_slots) if s not in self.active]
+
+    def kv_bytes(self) -> int:
+        return sum(l.nbytes for l in jax.tree.leaves(self.cache))
+
+
 class ContinuousBatchingEngine:
     """Slot-pool serving loop over the shared-cache decode program."""
 
     def __init__(self, model, config=None, params=None, mesh=None,
-                 max_slots: int = 4, cache_len: Optional[int] = None,
+                 max_slots: Optional[int] = None, cache_len: Optional[int] = None,
+                 cache_buckets: Optional[List] = None,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         from deepspeed_tpu.inference.engine import InferenceEngine
@@ -71,32 +109,60 @@ class ContinuousBatchingEngine:
                                     mesh=mesh, seed=seed)
         self.cfg = self._eng.cfg
         self.mesh = self._eng.mesh
-        self.max_slots = max_slots
-        self.cache_len = min(cache_len or self.cfg.max_seq_len, self.cfg.max_seq_len)
         self.eos_token_id = eos_token_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
         self._rng = jax.random.PRNGKey(seed)
 
-        from deepspeed_tpu.models import transformer as tf
-
-        shardings = self._eng.param_shardings
-        self._segment_fn, cache_sh, _ = compile_segment_fn(
-            self.mesh, self.cfg, shardings, max_slots, self.cache_len
-        )
-        self.cache = jax.device_put(
-            tf.init_cache(self.cfg, max_slots, self.cache_len), cache_sh
-        )
-        self._cache_sh = cache_sh
+        if cache_buckets is None:
+            cache_len = min(cache_len or self.cfg.max_seq_len, self.cfg.max_seq_len)
+            cache_buckets = [(max_slots if max_slots is not None else 4, cache_len)]
+        else:
+            assert cache_len is None, "pass cache_buckets OR cache_len, not both"
+            assert max_slots is None, (
+                "pass cache_buckets OR max_slots, not both (slot counts come "
+                "from the buckets)")
+            cache_buckets = sorted(
+                ((int(s), int(l)) for s, l in cache_buckets), key=lambda sl: sl[1]
+            )
+            for s, l in cache_buckets:
+                assert s >= 1 and 1 <= l <= self.cfg.max_seq_len, (s, l)
+        # pools sorted by length: admission scans for the smallest fit
+        self._pools = [_Pool(self, s, l) for s, l in cache_buckets]
+        self.max_slots = sum(p.n_slots for p in self._pools)
+        self.cache_len = max(p.length for p in self._pools)
 
         self._next_rid = 0
         self._next_pid = 0
         self._prefixes: Dict[int, dict] = {}  # prefix caching (register_prefix)
         self._pending: List[_Request] = []
-        self._active: Dict[int, _Request] = {}      # slot -> request
         self._results: Dict[int, np.ndarray] = {}
-        # per-slot decode state (host side)
-        self._pos = np.zeros(max_slots, np.int32)       # next write position
-        self._last_tok = np.zeros(max_slots, np.int32)  # last emitted token
+
+    # -- single-pool compatibility surface (tests, introspection) --------
+    @property
+    def cache(self):
+        assert len(self._pools) == 1, "cache is per-pool; use _pools[i].cache"
+        return self._pools[0].cache
+
+    @cache.setter
+    def cache(self, value):
+        assert len(self._pools) == 1
+        self._pools[0].cache = value
+
+    @property
+    def _active(self) -> Dict[int, _Request]:
+        """All active requests keyed by (pool-flattened) slot index."""
+        out = {}
+        base = 0
+        for p in self._pools:
+            for s, r in p.active.items():
+                out[base + s] = r
+            base += p.n_slots
+        return out
+
+    def kv_cache_bytes(self) -> int:
+        """Total device bytes held by the slot-pool KV caches (the number
+        the PERF.md bucketed-vs-fixed footprint table reports)."""
+        return sum(p.kv_bytes() for p in self._pools)
 
     # -- public API -----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
@@ -105,7 +171,7 @@ class ContinuousBatchingEngine:
         assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
         assert prompt.size + max_new_tokens <= self.cache_len, (
             f"prompt {prompt.size} + max_new_tokens {max_new_tokens} exceeds "
-            f"cache_len {self.cache_len}"
+            f"the largest pool cache_len {self.cache_len}"
         )
         rid = self._next_rid
         self._next_rid += 1
@@ -125,7 +191,7 @@ class ContinuousBatchingEngine:
 
         n = prefix.size
         bucket = _bucket(n, self.cache_len)
-        prefill_fn, _ = self._fns_for_bucket(bucket)
+        prefill_fn = self._prefill_for_bucket(bucket)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = prefix
         positions = np.full((1, bucket), bucket, np.int32)
@@ -176,7 +242,7 @@ class ContinuousBatchingEngine:
         return rid
 
     def has_work(self) -> bool:
-        return bool(self._pending or self._active)
+        return bool(self._pending) or any(p.active for p in self._pools)
 
     def result(self, rid: int) -> np.ndarray:
         return self._results.pop(rid)
@@ -185,47 +251,84 @@ class ContinuousBatchingEngine:
         out, self._results = self._results, {}
         return out
 
+    def _place(self, req: _Request) -> Optional[tuple]:
+        """(pool_index, slot) in the smallest-length pool that fits the
+        request's full extent and has a free slot; None if all full."""
+        need = req.prompt.size + req.max_new_tokens
+        if req.prefix is not None:
+            # the prefix KV splice writes a full bucket-length slice; the
+            # pool row must hold it (dynamic_update_slice cannot clip)
+            need = max(need, req.prefix["bucket"])
+        for i, pool in enumerate(self._pools):
+            if pool.length < need:
+                continue
+            free = pool.free_slots()
+            if free:
+                return i, free[0]
+        return None
+
     def step(self) -> Dict[int, List[int]]:
         """One scheduler tick: admit pending into free slots, then one
-        decode step for every active slot. Returns {rid: [tokens]} emitted
-        this tick — a just-admitted request emits TWO tokens (its prefill
-        token and the same-tick decode token), so the values are lists;
-        concatenating them across ticks reproduces the generated stream
-        exactly. Finished requests move to ``finished()``/``result()``."""
+        decode step for every pool with active slots. Returns
+        {rid: [tokens]} emitted this tick — a just-admitted request emits
+        TWO tokens (its prefill token and the same-tick decode token), so
+        the values are lists; concatenating them across ticks reproduces
+        the generated stream exactly. Finished requests move to
+        ``finished()``/``result()``."""
         emitted: Dict[int, List[int]] = {}
-        free = [s for s in range(self.max_slots) if s not in self._active]
-        while self._pending and free:
-            slot = free.pop(0)
-            req = self._pending.pop(0)
-            emitted[req.rid] = [self._admit(req, slot)]
-        if not self._active:
-            return emitted
+        # FIFO with skip: a request that only fits the (full) long pool
+        # must not block shorter requests behind it
+        still_pending = []
+        for req in self._pending:
+            placed = self._place(req)
+            if placed is None:
+                still_pending.append(req)
+                continue
+            pi, slot = placed
+            emitted[req.rid] = [self._admit(req, pi, slot)]
+        self._pending = still_pending
 
-        toks = jnp.asarray(self._last_tok[:, None])
-        pos = jnp.asarray(self._pos)
-        self._rng, sub = jax.random.split(self._rng)
-        logits, self.cache = self._segment_fn(self._eng.params, toks, self.cache, pos)
-        nxt = np.asarray(select_token(
-            logits[:, 0], self.temperature, self.top_k, sub, self.top_p
-        ))
-        for slot, req in list(self._active.items()):
-            tok = int(nxt[slot])
-            self._record(req, slot, tok)
-            emitted.setdefault(req.rid, []).append(tok)
-        self._pos[[s for s in self._active]] += 1
-        for slot in [s for s, r in self._active.items() if r.done]:
-            self._finish(slot)
+        for pi, pool in enumerate(self._pools):
+            if not pool.active:
+                continue
+            toks = jnp.asarray(pool.last_tok[:, None])
+            pos = jnp.asarray(pool.pos)
+            self._rng, sub = jax.random.split(self._rng)
+            logits, pool.cache = pool.segment_fn(
+                self._eng.params, toks, pool.cache, pos
+            )
+            nxt = np.asarray(select_token(
+                logits[:, 0], self.temperature, self.top_k, sub, self.top_p
+            ))
+            for slot, req in list(pool.active.items()):
+                tok = int(nxt[slot])
+                self._record(req, pool, slot, tok)
+                emitted.setdefault(req.rid, []).append(tok)
+            pool.pos[[s for s in pool.active]] += 1
+            for slot in [s for s, r in pool.active.items() if r.done]:
+                self._finish(pool, slot)
         return emitted
 
     # -- internals ------------------------------------------------------
-    def _fns_for_bucket(self, bucket: int):
+    def _prefill_for_bucket(self, bucket: int):
+        """B=1 ragged prefill into a bucket-length cache (pool-agnostic)."""
         def build():
-            prefill_fn, small_sh, _ = compile_ragged_prefill_fn(
+            return compile_ragged_prefill_fn(
                 self.mesh, self.cfg, self._eng.param_shardings, 1, bucket
-            )
+            )[0]
+
+        return cached_fn(self, "prefill_bucket", bucket, build, slots=8)
+
+    def _insert_for_bucket(self, bucket: int, pi: int):
+        """Splice a B=1 bucket cache into pool ``pi``'s shared cache row."""
+        pool = self._pools[pi]
+
+        def build():
+            from deepspeed_tpu.inference.decoding import _decode_shardings
+
+            _, small_sh = _decode_shardings(self.mesh, self.cfg, 1)
 
             def insert(big, small, slot):
-                # splice the B=1 bucket cache into the shared cache row:
                 # positions [0..bucket) overwritten, staler junk beyond is
                 # causally masked until real writes reach it (tree.map:
                 # also covers the int8 {"q8","s"} representation)
@@ -236,47 +339,49 @@ class ContinuousBatchingEngine:
                     big, small,
                 )
 
-            insert_fn = jax.jit(
+            return jax.jit(
                 insert,
-                in_shardings=(self._cache_sh, small_sh, None),
-                out_shardings=self._cache_sh,
+                in_shardings=(pool.cache_sh, small_sh, None),
+                out_shardings=pool.cache_sh,
                 donate_argnums=(0,),
             )
-            return prefill_fn, insert_fn
 
-        # shared bounded memoization (decoding.cached_fn); 8 slots cover
-        # every power-of-2 bucket up to 16 <= b <= 2048 without thrash
-        return cached_fn(self, "admit_bucket", bucket, build, slots=8)
+        # bounded memoization keyed (bucket, pool): 8 power-of-2 buckets
+        # (16 <= b <= 2048) per pool, so capacity scales with pool count
+        return cached_fn(self, "insert_bucket", (bucket, pi), build,
+                         slots=8 * len(self._pools))
 
-    def _admit(self, req: _Request, slot: int) -> Optional[int]:
+    def _admit(self, req: _Request, pi: int, slot: int) -> Optional[int]:
         from deepspeed_tpu.models import transformer as tf
 
+        pool = self._pools[pi]
         n = req.prompt.size
         if req.prefix is not None:
             pre = req.prefix
             n_pre = pre["tokens"].size
             # 1) splice the cached prefix KV into the slot row (the prefix
             #    bucket cache is NOT donated — it serves every request)
-            _, insert_fn = self._fns_for_bucket(pre["bucket"])
-            self.cache = insert_fn(self.cache, pre["cache"], slot)
+            insert_fn = self._insert_for_bucket(pre["bucket"], pi)
+            pool.cache = insert_fn(pool.cache, pre["cache"], slot)
             # 2) prefill ONLY the suffix through the shared segment program:
-            #    other rows' positions park at cache_len so their KV writes
-            #    drop; suffix pad columns land at future positions of THIS
-            #    row, each overwritten by a real decode write before it is
-            #    ever attended (same argument as slot reuse)
+            #    other rows' positions park at the pool length so their KV
+            #    writes drop; suffix pad columns land at future positions of
+            #    THIS row, each overwritten by a real decode write before it
+            #    is ever attended (same argument as slot reuse)
             suffix = req.prompt[n_pre:]
-            sb = _bucket(suffix.size, self.cache_len)
-            toks = np.zeros((self.max_slots, sb), np.int32)
+            sb = _bucket(suffix.size, pool.length)
+            toks = np.zeros((pool.n_slots, sb), np.int32)
             toks[slot, :suffix.size] = suffix
-            pos = np.full(self.max_slots, self.cache_len, np.int32)
+            pos = np.full(pool.n_slots, pool.length, np.int32)
             pos[slot] = n_pre
-            logits, self.cache = self._segment_fn(
-                self._eng.params, jnp.asarray(toks), self.cache, jnp.asarray(pos)
+            logits, pool.cache = pool.segment_fn(
+                self._eng.params, jnp.asarray(toks), pool.cache, jnp.asarray(pos)
             )
             last_logits = logits[slot: slot + 1, suffix.size - 1]
         else:
-            bucket = _bucket(n, self.cache_len)
-            prefill_fn, insert_fn = self._fns_for_bucket(bucket)
+            bucket = _bucket(n, pool.length)
+            prefill_fn = self._prefill_for_bucket(bucket)
+            insert_fn = self._insert_for_bucket(bucket, pi)
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :n] = req.prompt
             # pads park at bucket (dropped writes), real tokens pack 0..n-1
@@ -286,33 +391,34 @@ class ContinuousBatchingEngine:
             logits, small = prefill_fn(
                 self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
             )
-            self.cache = insert_fn(self.cache, small, slot)
+            pool.cache = insert_fn(pool.cache, small, slot)
             last_logits = logits[:, n - 1]
         self._rng, sub = jax.random.split(self._rng)
         first = int(np.asarray(select_token(
             last_logits, self.temperature, self.top_k, sub, self.top_p
         ))[0])
-        self._active[slot] = req
+        pool.active[slot] = req
         req.slot = slot
+        req.pool = pi
         # the first generated token's KV is written at position n by the
         # NEXT decode tick (it feeds last_tok at pos, then pos advances) —
         # same protocol as ragged_decode_loop
-        self._pos[slot] = n
-        self._record(req, slot, first)
+        pool.pos[slot] = n
+        self._record(req, pool, slot, first)
         if req.done:
-            self._finish(slot)
+            self._finish(pool, slot)
         return first
 
-    def _record(self, req: _Request, slot: int, tok: int):
+    def _record(self, req: _Request, pool: _Pool, slot: int, tok: int):
         req.generated.append(tok)
-        self._last_tok[slot] = tok
+        pool.last_tok[slot] = tok
         hit_eos = self.eos_token_id is not None and tok == self.eos_token_id
         total = req.prompt.size + len(req.generated)
-        if hit_eos or len(req.generated) >= req.max_new_tokens or total >= self.cache_len:
+        if hit_eos or len(req.generated) >= req.max_new_tokens or total >= pool.length:
             req.done = True
 
-    def _finish(self, slot: int):
-        req = self._active.pop(slot)
+    def _finish(self, pool: _Pool, slot: int):
+        req = pool.active.pop(slot)
         self._results[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)]
         )

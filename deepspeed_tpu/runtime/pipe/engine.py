@@ -342,6 +342,12 @@ class PipelineEngine(TpuEngine):
                 and self._full_batch_rows
                 and x.ndim >= 1
                 and x.shape[0] == self._full_batch_rows // nprocs
+                # an array already in (microbatch, batch, ...) layout is the
+                # valid stacked-dataloader feed, even when micro_batches
+                # happens to equal full_rows // nprocs
+                and not (x.ndim >= 2 and x.shape[0] == self.micro_batches
+                         and x.shape[1] in (self._mb_global,
+                                            self._mb_global // nprocs))
             ):
                 # a flat PROCESS-LOCAL feed is ambiguous for the pipeline:
                 # contiguous rows would decompose into whole microbatches,
