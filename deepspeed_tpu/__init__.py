@@ -51,6 +51,15 @@ def initialize(
         from deepspeed_tpu.runtime.engine import _FnModel
 
         model = _FnModel(loss_fn, params)
+    elif params is not None and not _is_pipeline_model(model):
+        # honor caller-provided params with a model object (the reference
+        # wraps an ALREADY-initialized module, deepspeed/__init__.py:54;
+        # silently re-initializing from the seed was a trap): init() returns
+        # the given tree as the fp32 masters. Pipeline models keep their own
+        # init (their ctor inspects the module class).
+        from deepspeed_tpu.runtime.engine import _PinnedParamsModel
+
+        model = _PinnedParamsModel(model, params)
 
     # multi-controller rendezvous FIRST: every later step (config device
     # count, autotuner memory model, engine mesh) queries the backend, and

@@ -69,6 +69,33 @@ class _FnModel:
         return None
 
 
+class _PinnedParamsModel:
+    """Wrap a model so ``init()`` returns caller-provided params
+    (``initialize(model=..., params=...)``) — cast to fp32 masters, the
+    dtype the engine's init path expects. Everything else (loss,
+    logical_specs, cfg, flops_per_token, ...) delegates to the model.
+
+    The ctor stores the tree UNTOUCHED (like _FnModel): converting leaves
+    here would pin the backend before the multi-controller rendezvous and
+    commit a full unsharded copy to the default device. The cast happens
+    inside ``init()``, which the engine runs under a jit with sharded
+    out_shardings, so leaves place directly into their shards."""
+
+    def __init__(self, model, params):
+        self._model = model
+        self._params = params
+
+    def init(self, rng):
+        return jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.float32)
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else jnp.asarray(x),
+            self._params,
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+
 class OptaxWrapper:
     """Adapt an optax GradientTransformation to the init/update(lr) protocol."""
 
@@ -231,6 +258,17 @@ class TpuEngine:
         # materialise on one device)
         fp32_shardings = self.opt_shardings if self.mixed_precision else self.param_shardings
         if self.param_offload:
+            if isinstance(model, _PinnedParamsModel):
+                # the streamed coordinator initializes masters group-by-group
+                # from the seed (model.init is only eval_shape'd for
+                # structure) — honoring an in-memory tree here would need a
+                # full host master seeding pass; refuse rather than silently
+                # train from random weights
+                raise NotImplementedError(
+                    "initialize(model=..., params=...) is not supported with "
+                    "zero_optimization.offload_param; initialize without "
+                    "params= and restore the weights with load_checkpoint()"
+                )
             # params never materialize in HBM: host-side group-by-group init,
             # masters live in the host optimizer tier
             from deepspeed_tpu.runtime.zero.param_offload import ParamOffloadCoordinator

@@ -1,0 +1,161 @@
+"""Model-level convergence parity vs an independent optax loop
+(VERDICT r4 #3 missing / #8: the reference keeps loss-parity model tests —
+tests/model/Megatron_GPT2 compares curves with/without DeepSpeed; SURVEY
+§4.5).
+
+A 200-step GPT-2-architecture training run through the full engine (ZeRO-2
+sharding, gradient accumulation, WarmupLR schedule, grad clipping) must
+produce the SAME loss curve as a hand-written optax loop implementing the
+identical math — same model.loss, same init, same data order, same
+schedule. Silent LR/scale/remat bugs bend a 200-step curve long before
+they break a 2-step grad-parity test.
+
+The model is the gpt2 architecture (learned positions, gelu, layernorm,
+tied-nothing) scaled down so 200 CPU steps stay in slow-suite budget; the
+machinery under test (engine loop, ZeRO shardings, GAS, schedule,
+clipping) is size-independent.
+
+Set DSTPU_CONVERGENCE_DUMP=<path> to write the two curves as JSON (the
+committed overlay artifact lives at docs/perf/convergence_r5.json).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+STEPS = 200
+GAS = 2
+MICRO_BS = 8
+SEQ = 64
+LR = 3e-3
+WARMUP = 20
+CLIP = 1.0
+
+
+def _model():
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,
+        max_seq_len=SEQ, dtype="float32", pos_embedding="learned",
+    )
+    return TransformerModel(cfg)
+
+
+def _data(step, micro):
+    rs = np.random.RandomState(1000 * step + micro)
+    # mixture of memorizable bigram patterns + noise: the loss actually
+    # moves over 200 steps, so a bent curve is detectable
+    base = rs.randint(0, 512, (MICRO_BS, SEQ // 8)).astype(np.int32)
+    return {"input_ids": np.tile(base, (1, 8))}
+
+
+def _lr_at(step):
+    # WarmupLR(warmup_type="linear", min_lr=0) read BEFORE scheduler.step()
+    # (engine.py step(): get_lr_value precedes lr_scheduler.step()), so
+    # optimizer step k uses lr_at(k) — the first update runs at lr 0
+    if step < WARMUP:
+        return LR * step / WARMUP
+    return LR
+
+
+@pytest.mark.slow  # 200 steps x (engine + optax) on the 1-core host
+class TestConvergenceParityVsOptax:
+    def test_200_step_curve_matches(self):
+        comm.destroy()
+        model = _model()
+        init_params = jax.jit(model.init)(jax.random.PRNGKey(7))
+        init_params = jax.tree.map(np.asarray, init_params)
+
+        # ---- engine run: ZeRO-2 + GAS + WarmupLR + clipping -------------
+        config = {
+            "train_micro_batch_size_per_gpu": MICRO_BS // 8,  # x8 devices
+            "gradient_accumulation_steps": GAS,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": LR, "betas": (0.9, 0.999),
+                                     "eps": 1e-8, "weight_decay": 0.0}},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0.0,
+                                     "warmup_max_lr": LR,
+                                     "warmup_num_steps": WARMUP,
+                                     "warmup_type": "linear"}},
+            "gradient_clipping": CLIP,
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 1000000,
+        }
+        engine, _, _, scheduler = deepspeed_tpu.initialize(
+            model=model, params=jax.tree.map(jnp.asarray, init_params),
+            config=config)
+        engine_losses = []
+        for step in range(STEPS):
+            micro_losses = []
+            for micro in range(GAS):
+                loss = engine.forward(_data(step, micro))
+                engine.backward(loss)
+                engine.step()
+                micro_losses.append(float(loss))
+            engine_losses.append(float(np.mean(micro_losses)))
+
+        # ---- independent optax loop: identical math ---------------------
+        import optax
+
+        tx = optax.chain(
+            optax.clip_by_global_norm(CLIP),
+            optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
+        )
+        params = jax.tree.map(jnp.asarray, init_params)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def grads_of(params, batch):
+            loss, g = jax.value_and_grad(
+                lambda p: model.loss(p, batch, None))(params)
+            return loss, g
+
+        @jax.jit
+        def apply(params, opt_state, grads, lr):
+            # scale_by_adam returns ascent directions; descend by -lr (the
+            # lr rides as an operand so the schedule never recompiles)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            updates = jax.tree.map(lambda u: -lr * u, updates)
+            return optax.apply_updates(params, updates), opt_state
+
+        optax_losses = []
+        for step in range(STEPS):
+            acc = None
+            micro_losses = []
+            for micro in range(GAS):
+                loss, g = grads_of(params, _data(step, micro))
+                micro_losses.append(float(loss))
+                acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+            grads = jax.tree.map(lambda a: a / GAS, acc)
+            params, opt_state = apply(params, opt_state, grads,
+                                      jnp.float32(_lr_at(step)))
+            optax_losses.append(float(np.mean(micro_losses)))
+
+        engine_arr = np.asarray(engine_losses)
+        optax_arr = np.asarray(optax_losses)
+        dump = os.environ.get("DSTPU_CONVERGENCE_DUMP")
+        if dump:
+            with open(dump, "w") as fh:
+                json.dump({"steps": STEPS, "engine": engine_losses,
+                           "optax": optax_losses}, fh)
+
+        # the curve must actually move (a flat curve proves nothing)
+        assert engine_arr[-10:].mean() < engine_arr[:10].mean() - 0.5, (
+            "loss did not drop enough to discriminate: "
+            f"{engine_arr[:10].mean():.3f} -> {engine_arr[-10:].mean():.3f}")
+        # identical math => identical curves up to reduction-order drift
+        max_delta = float(np.abs(engine_arr - optax_arr).max())
+        final_delta = float(abs(engine_arr[-10:].mean() - optax_arr[-10:].mean()))
+        assert final_delta < 5e-3, (
+            f"final-loss delta {final_delta:.4f} vs optax baseline "
+            f"(engine {engine_arr[-10:].mean():.4f}, optax {optax_arr[-10:].mean():.4f})")
+        # measured 2.2e-5 on the committed run (docs/perf/convergence_r5.json)
+        assert max_delta < 0.05, f"curve diverged: max |delta| {max_delta:.4f}"

@@ -173,3 +173,53 @@ def test_loss_fn_params_entrypoint():
         engine.backward(loss)
         engine.step()
     assert float(jnp.abs(engine.params["w"]).sum()) < 4.0
+
+
+class TestInitializeHonorsParams:
+    def test_params_argument_used_with_model(self):
+        """initialize(model=..., params=...) must start from the GIVEN tree
+        (the reference wraps an already-initialized module); it used to be
+        silently discarded and re-initialized from the seed."""
+        comm.destroy()
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                                num_heads=2, max_seq_len=16, dtype="float32")
+        model = TransformerModel(cfg)
+        params = model.init(jax.random.PRNGKey(123))
+        marker = np.asarray(jax.tree.leaves(params)[0])
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, params=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0},
+                    "steps_per_print": 1000000})
+        got = np.asarray(jax.tree.leaves(engine.params)[0])
+        np.testing.assert_allclose(got, marker, rtol=1e-6)
+        # and it still trains
+        batch = {"input_ids": np.zeros((8, 16), np.int32)}
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        assert np.isfinite(float(loss))
+
+    def test_params_refused_on_streamed_offload(self):
+        """offload_param seeds masters group-by-group from the RNG and
+        cannot honor an in-memory tree — must refuse loudly, never train
+        silently from random weights."""
+        comm.destroy()
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                                num_heads=2, max_seq_len=16, dtype="float32")
+        model = TransformerModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="offload_param"):
+            deepspeed_tpu.initialize(
+                model=model, params=params,
+                config={"train_micro_batch_size_per_gpu": 1,
+                        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                        "zero_optimization": {
+                            "stage": 3,
+                            "offload_param": {"device": "cpu"}},
+                        "steps_per_print": 1000000})
