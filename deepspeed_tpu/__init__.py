@@ -51,15 +51,7 @@ def initialize(
         from deepspeed_tpu.runtime.engine import _FnModel
 
         model = _FnModel(loss_fn, params)
-    elif params is not None and not _is_pipeline_model(model):
-        # honor caller-provided params with a model object (the reference
-        # wraps an ALREADY-initialized module, deepspeed/__init__.py:54;
-        # silently re-initializing from the seed was a trap): init() returns
-        # the given tree as the fp32 masters. Pipeline models keep their own
-        # init (their ctor inspects the module class).
-        from deepspeed_tpu.runtime.engine import _PinnedParamsModel
-
-        model = _PinnedParamsModel(model, params)
+        params = None  # consumed; below, a non-None params means model+params
 
     # multi-controller rendezvous FIRST: every later step (config device
     # count, autotuner memory model, engine mesh) queries the backend, and
@@ -110,6 +102,15 @@ def initialize(
 
     pipe_axis = cfg.mesh_axis_sizes().get("pipe", 1)
     if cfg.pipeline.stages > 1 or pipe_axis > 1 or _is_pipeline_model(model):
+        if params is not None:
+            # fail loudly: the pipeline engine re-builds per-stage weights
+            # from its module specs, so an in-memory tree cannot be pinned —
+            # silently training from a fresh init was the original trap
+            raise NotImplementedError(
+                "initialize(model=..., params=...) is not supported with the "
+                "pipeline engine; initialize without params= and restore the "
+                "weights with load_checkpoint()"
+            )
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
 
         engine = PipelineEngine(
@@ -121,11 +122,13 @@ def initialize(
         # (reference: deepspeed/__init__.py:141 hybrid-engine dispatch)
         from deepspeed_tpu.runtime.hybrid_engine import TpuHybridEngine
 
+        model = _maybe_pin_params(model, params)
         engine = TpuHybridEngine(
             model, cfg, optimizer=optimizer, lr_scheduler=lr_scheduler, training_data=training_data, mesh=mesh,
             collate_fn=collate_fn,
         )
     else:
+        model = _maybe_pin_params(model, params)
         engine = TpuEngine(
             model,
             cfg,
@@ -136,6 +139,18 @@ def initialize(
             collate_fn=collate_fn,
         )
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def _maybe_pin_params(model, params):
+    """Honor caller-provided params with a model object (the reference
+    wraps an ALREADY-initialized module, deepspeed/__init__.py:54; silently
+    re-initializing from the seed was a trap): init() returns the given
+    tree as the fp32 masters."""
+    if params is None:
+        return model
+    from deepspeed_tpu.runtime.engine import _PinnedParamsModel
+
+    return _PinnedParamsModel(model, params)
 
 
 def _is_pipeline_model(model) -> bool:

@@ -82,18 +82,38 @@ class _PinnedParamsModel:
     out_shardings, so leaves place directly into their shards."""
 
     def __init__(self, model, params):
-        self._model = model
-        self._params = params
+        object.__setattr__(self, "_model", model)
+        object.__setattr__(self, "_params", params)
+
+    @staticmethod
+    def _cast_host(x):
+        a = np.asarray(x)  # jax arrays device_get; numpy stays on host
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            return a.astype(np.float32)
+        return a
 
     def init(self, rng):
+        # HOST-side cast only: eval_shape traces this concretely, and a
+        # jnp op here would commit every full leaf to the default device
+        return jax.tree.map(self._cast_host, self._params)
+
+    def materialize(self, shardings):
+        """device_put each host-cast leaf straight into its shard — the
+        engine uses this instead of jitting init() (which would embed the
+        whole tree as program constants)."""
         return jax.tree.map(
-            lambda x: jnp.asarray(x, jnp.float32)
-            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else jnp.asarray(x),
-            self._params,
+            lambda x, s: jax.device_put(self._cast_host(x), s),
+            self._params, shardings,
         )
 
     def __getattr__(self, name):
         return getattr(self._model, name)
+
+    def __setattr__(self, name, value):
+        # engine-side mutations (e.g. the PLD/random-LTD cfg flip) must
+        # land on the wrapped model, whose bound methods read their own
+        # attributes — a plain setattr here would silently shadow them
+        setattr(self._model, name, value)
 
 
 class OptaxWrapper:
@@ -282,7 +302,10 @@ class TpuEngine:
             self.params = self.coordinator.working
             self.master_params = None
         else:
-            master = jax.jit(model.init, out_shardings=fp32_shardings)(init_rng)
+            if isinstance(model, _PinnedParamsModel):
+                master = model.materialize(fp32_shardings)
+            else:
+                master = jax.jit(model.init, out_shardings=fp32_shardings)(init_rng)
             if self.offload_device in ("cpu", "nvme"):
                 # master weights + moments leave HBM: host fp32 copies, device
                 # keeps only the model-dtype working params
