@@ -1064,15 +1064,16 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     # over the segment is exactly causal self-attention — the Pallas flash
     # kernel computes it without materializing the (B, H, S, T) logits
     # (reference: the inference softmax_context kernel family)
+    from deepspeed_tpu.ops.pallas.flash_attention import supports_seq_len
+
     use_flash_prefill = (
         isinstance(pos, int) and pos == 0 and S > 1
         and window is None
         and cfg.attn_impl == "pallas" and cfg.causal
         and cfg.pos_embedding != "alibi"
-        # the kernel tiles the q/k sequence by min(128, S): any S under 128
-        # works (one block), past that only multiples of 128 — everything
-        # else stays on the einsum path rather than asserting at trace time
-        and (S < 128 or S % 128 == 0)
+        # seq lens the auto-tiler can't cover stay on the einsum path
+        # rather than erroring at trace time
+        and supports_seq_len(S)
     )
 
     k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos, positions)

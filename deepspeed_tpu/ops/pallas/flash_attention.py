@@ -39,6 +39,41 @@ def _blk(size: int, cap: int) -> int:
     return min(cap, size)
 
 
+# Default tile cap, chosen on silicon (v5e, GPT-2 125M shapes, 2026-07-31
+# microbenchmark in PERF.md): fwd+bwd per layer is 11.2 ms at 128-tiles,
+# 8.1 ms for XLA attention, 5.5 ms at 512-tiles — small tiles lose to
+# per-invocation grid/DMA overhead, and 512x512 f32 logits (1 MB) sit
+# comfortably in VMEM.
+_DEFAULT_BLOCK = 512
+
+
+def supports_seq_len(size: int) -> bool:
+    """True when the auto-tiler can cover a sequence of this length —
+    callers that have a fallback attention path (e.g. the prefill gate in
+    models/transformer.py) use this instead of duplicating the tiling rule."""
+    return size <= _DEFAULT_BLOCK or size % 64 == 0
+
+
+def _auto_block(size: int, cap: Optional[int]) -> int:
+    """Auto tile size: ``size`` itself when it fits under the cap, else the
+    largest of 512/256/128/64 that divides ``size`` (grid tiles must cover
+    the sequence exactly). Longer sequences that tile by none of those get
+    a loud error instead of a degenerate grid."""
+    if cap is not None:
+        return _blk(size, cap)
+    cap = _DEFAULT_BLOCK
+    if size <= cap:
+        return size
+    b = cap
+    while b >= 64:
+        if size % b == 0:
+            return b
+        b //= 2
+    raise ValueError(
+        f"flash attention auto-tiling needs the sequence length ({size}) to be "
+        f"divisible by 64; pad the sequence or pass block_q/block_k explicitly")
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -100,7 +135,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma=None):
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     group = H // Hkv
-    bq, bk = _blk(Sq, block_q), _blk(Sk, block_k)
+    bq, bk = _auto_block(Sq, block_q), _auto_block(Sk, block_k)
     assert Sq % bq == 0 and Sk % bk == 0, f"seq lens ({Sq},{Sk}) must tile by ({bq},{bk})"
     nq, nk = Sq // bq, Sk // bk
     grid = (B, H, nq, nk)
@@ -229,7 +264,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     group = H // Hkv
-    bq, bk = _blk(Sq, block_q), _blk(Sk, block_k)
+    bq, bk = _auto_block(Sq, block_q), _auto_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,Sq,1)
@@ -319,17 +354,21 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     vma=None,
 ):
     """Flash attention on (B, S, H, head_dim) tensors (GQA via fewer KV heads).
 
     Differentiable (custom VJP with flash backward); runs compiled on TPU and
-    interpreted on CPU backends. ``vma``: varying mesh axes to stamp on the
-    kernel outputs when called inside a vma-checked ``shard_map`` (e.g.
-    ``("sequence",)`` for the Ulysses local attention).
+    interpreted on CPU backends. ``block_q``/``block_k`` default to the
+    sequence length itself when <= 512, else the largest of 512/256/128/64
+    dividing it (512 is the silicon-tuned cap — see ``_DEFAULT_BLOCK``);
+    pass explicit values to pin. ``vma``:
+    varying mesh axes to stamp on the kernel outputs when called inside a
+    vma-checked ``shard_map`` (e.g. ``("sequence",)`` for the Ulysses local
+    attention).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])

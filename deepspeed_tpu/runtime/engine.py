@@ -92,9 +92,31 @@ class _PinnedParamsModel:
             return a.astype(np.float32)
         return a
 
+    def abstract(self):
+        """fp32-master ShapeDtypeStructs for the pinned tree — the engine's
+        shape-inference pass uses this instead of eval_shape(init), which
+        would concretely fp32-copy (and device_get) every leaf."""
+
+        def _aval(x):
+            dt = np.result_type(x)
+            if jnp.issubdtype(dt, jnp.inexact):
+                dt = np.dtype(np.float32)
+            return jax.ShapeDtypeStruct(np.shape(x), dt)
+
+        return jax.tree.map(_aval, self._params)
+
     def init(self, rng):
-        # HOST-side cast only: eval_shape traces this concretely, and a
-        # jnp op here would commit every full leaf to the default device
+        if isinstance(rng, jax.core.Tracer):
+            # under jit/eval_shape the host cast below would either bake the
+            # full tree into the program as constants or (worse) trace into
+            # fabricated values — refuse loudly; callers want .abstract()
+            # for shapes or .materialize() for sharded placement
+            raise TypeError(
+                "_PinnedParamsModel.init cannot run under a trace; use "
+                ".abstract() for shape inference or .materialize(shardings) "
+                "for placement")
+        # HOST-side cast only: a jnp op here would commit every full leaf
+        # to the default device
         return jax.tree.map(self._cast_host, self._params)
 
     def materialize(self, shardings):
@@ -218,7 +240,10 @@ class TpuEngine:
         rng = jax.random.PRNGKey(seed)
         self._rng, init_rng = jax.random.split(rng)
 
-        abstract_params = jax.eval_shape(model.init, init_rng)
+        if isinstance(model, _PinnedParamsModel):
+            abstract_params = model.abstract()
+        else:
+            abstract_params = jax.eval_shape(model.init, init_rng)
         logical = None
         if hasattr(model, "logical_specs"):
             logical = model.logical_specs(abstract_params)

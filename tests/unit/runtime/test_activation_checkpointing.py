@@ -158,6 +158,14 @@ class TestPartitionActivations:
     residual is sharded over the ``tensor`` axis, so the remat stash is
     stored 1/TP instead of replicated."""
 
+    # the partition constraint in sdy text: UNCONSTRAINED batch, seq dim on
+    # the tensor (or sequence+tensor) axis; the always-on embedding/batch
+    # constraints (models/transformer.py _constrain_tp/
+    # _constrain_batch_sharding) never produce these shapes. One copy so a
+    # jax sdy pretty-printer change breaks every assert loudly, not just one.
+    PARTITION_SPEC = '[{?}, {"tensor"}, {?}]'
+    PARTITION_SPEC_SP = '[{?}, {"sequence", "tensor"}, {?}]'
+
     def _setup(self, tensor=4, hidden=128, layers=4, seq=256):
         from deepspeed_tpu import comm
         from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
@@ -198,19 +206,13 @@ class TestPartitionActivations:
         def lower(p, b):
             return jax.jit(jax.value_and_grad(loss)).lower(p, b)
 
-        # the partition constraint is the one with UNCONSTRAINED batch and
-        # the seq dim on the tensor axis — [{?}, {"tensor"}, {?}] in sdy
-        # text; the always-on embedding/batch constraints (models/
-        # transformer.py _constrain_tp/_constrain_batch_sharding) never
-        # produce that shape
-        PARTITION_SPEC = '[{?}, {"tensor"}, {?}]'
         low_off = lower(params, batch)
-        assert PARTITION_SPEC not in low_off.as_text()
+        assert self.PARTITION_SPEC not in low_off.as_text()
         off_bytes = low_off.compile().memory_analysis().temp_size_in_bytes
         ac.configure(deepspeed_config={"activation_checkpointing": {"partition_activations": True}})
         jax.clear_caches()
         low_on = lower(params, batch)
-        assert PARTITION_SPEC in low_on.as_text()
+        assert self.PARTITION_SPEC in low_on.as_text()
         on_bytes = low_on.compile().memory_analysis().temp_size_in_bytes
         assert on_bytes < 0.6 * off_bytes, (on_bytes, off_bytes)
 
@@ -222,5 +224,5 @@ class TestPartitionActivations:
         loss, params, batch = self._setup(tensor=1, hidden=32, layers=2, seq=64)
         ac.configure(deepspeed_config={"activation_checkpointing": {"partition_activations": True}})
         txt = jax.jit(jax.value_and_grad(loss)).lower(params, batch).as_text()
-        assert '[{?}, {"tensor"}, {?}]' not in txt
-        assert '[{?}, {"sequence", "tensor"}, {?}]' not in txt
+        assert self.PARTITION_SPEC not in txt
+        assert self.PARTITION_SPEC_SP not in txt
