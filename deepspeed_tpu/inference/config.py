@@ -50,6 +50,13 @@ class InferenceConfig:
     speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
+    # fuse the whole generation (prefill + lax.scan over decode steps) into
+    # ONE compiled program: a single dispatch per generate() call instead of
+    # one per token — per-token host dispatch dominates decode latency on
+    # remote-dispatch links and costs ~100us/token even locally. Retraces per
+    # distinct (batch, cache_len, max_new_tokens, sampling) combination;
+    # disable for workloads that sweep many generation lengths.
+    fused_generate: bool = True
     max_tokens: int = 1024  # alias accepted from reference configs
     replace_with_kernel_inject: bool = False  # TPU: kernels come from XLA/Pallas
     replace_method: str = "auto"

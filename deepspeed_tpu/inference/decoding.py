@@ -59,6 +59,59 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
     return prefill_fn, decode_fn, cache_sh, batch_sh
 
 
+def compile_generate_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int,
+                        max_new_tokens: int, temperature: float, top_k: int,
+                        top_p: float):
+    """Whole-generation jit: prefill + ``lax.scan`` over the decode steps in
+    ONE compiled program — one dispatch per ``generate()`` call instead of
+    one per token (the per-token host round trip dominates decode wall time
+    on remote-dispatch links: r5 measured 22.3 ms/token at 350M against a
+    ~1 ms roofline). Token stream is bitwise-identical to ``decode_loop``:
+    same rng split order, same select_token calls.
+
+    Returns ``(generate_fn, cache_sh, batch_sh)`` with
+    ``generate_fn(params, tokens, cache, rng) -> (B, S + max_new_tokens)``.
+    """
+    from deepspeed_tpu.models import transformer as tf
+
+    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+
+    def run(params, tokens, cache, rng):
+        S = tokens.shape[1]
+        logits, cache = tf.forward_with_cache(params, cfg, tokens, cache, 0)
+        first = select_token(logits[:, -1], temperature, top_k, rng, top_p)
+
+        def body(carry, _):
+            last, cache, rng, pos = carry
+            rng, sub = jax.random.split(rng)
+            step_logits, cache = tf.forward_with_cache(
+                params, cfg, last[:, None], cache, pos)
+            tok = select_token(step_logits[:, -1], temperature, top_k, sub, top_p)
+            return (tok, cache, rng, pos + 1), tok
+
+        (_, cache, _, _), rest = jax.lax.scan(
+            body, (first, cache, rng, jnp.int32(S)), None,
+            length=max_new_tokens - 1)
+        seq = jnp.concatenate(
+            [tokens, first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        # the final cache is returned (and dropped by the caller) so the
+        # donated input cache aliases an output instead of warning
+        return seq, cache
+
+    jitted = jax.jit(
+        run,
+        in_shardings=(param_shardings, batch_sh, cache_sh, None),
+        out_shardings=(batch_sh, cache_sh),
+        donate_argnums=(2,),
+    )
+
+    def fn(params, tokens, cache, rng):
+        seq, _ = jitted(params, tokens, cache, rng)
+        return seq
+
+    return fn, cache_sh, batch_sh
+
+
 def compile_ragged_prefill_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
     """Jit a prefill over LEFT- or RIGHT-padded prompts: explicit (B, S)
     positions (pads carry position >= cache_len so their KV writes drop;
@@ -378,6 +431,22 @@ def speculative_decode_loop(
     # (the caller's eos truncation overwrites everything past the first
     # eos with eos anyway, so plain-decode parity is preserved)
     return jnp.concatenate([tokens, jnp.asarray(out)], axis=1)
+
+
+def fused_generate_fn(holder, mesh, cfg, param_shardings, batch_size: int,
+                      cache_len: int, max_new_tokens: int, temperature: float,
+                      top_k: int, top_p: float):
+    """(generate_fn, cache_sharding) for the fused whole-generation program,
+    memoized on ``holder`` and keyed by every trace-shaping argument — ONE
+    wiring shared by the InferenceEngine and the RLHF hybrid engine so the
+    cache key and builder can never drift apart."""
+    return cached_fn(
+        holder, "fused_generate",
+        (batch_size, cache_len, max_new_tokens, temperature, top_k, top_p),
+        lambda: compile_generate_fn(mesh, cfg, param_shardings, batch_size,
+                                    cache_len, max_new_tokens, temperature,
+                                    top_k, top_p)[:2],
+    )
 
 
 def cached_fn(holder, kind: str, key, builder, slots: int = 4):

@@ -15,8 +15,11 @@ capture :500, generate wrapper :588). TPU redesign:
   - int8: weight-only groupwise quantization at load (ZeroQuant-style W8),
     dequantized in-register by XLA at matmul sites.
 
-Decode loop: ``generate`` runs prefill once then a ``lax.scan`` over steps,
-KV cache donated between iterations; greedy or temperature sampling.
+Decode loop: ``generate`` defaults to a FUSED whole-generation program —
+prefill + ``lax.scan`` over decode steps in one jit, one dispatch per call
+(``fused_generate`` in InferenceConfig; the pre-r5 per-token dispatch loop
+remains as the opt-out). Greedy or temperature/top-k/top-p sampling; KV
+cache donated into the program.
 """
 
 import time
@@ -255,6 +258,8 @@ class InferenceEngine:
         ``speculative.num_draft_tokens`` sets the default)."""
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = tokens.shape
+        if max_new_tokens <= 0:
+            return tokens
         # with a mask, capacity is governed by the longest REAL prompt, not
         # the padded width (padding='max_length' batches are legal even at
         # S == max_seq_len)
@@ -309,6 +314,20 @@ class InferenceEngine:
             return result
 
         max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
+        if self.config.fused_generate:
+            # one dispatch for the whole generation (prefill + scan over
+            # decode steps) — identical token stream to decode_loop
+            fused_fn, cache_sh = self._fused_generate_fn(
+                B, max_len, max_new_tokens, temperature, top_k, top_p)
+            cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), cache_sh)
+            t0 = time.time()
+            result = fused_fn(self.params, tokens, cache, rng)
+            if self.config.profile_model_time:
+                jax.block_until_ready(result)
+                self._model_times.append(time.time() - t0)
+            if eos_token_id is not None:
+                result = self._truncate_eos(result, S, eos_token_id)
+            return result
         self._ensure_compiled(B, max_len)
 
         cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), self._cache_sharding)
@@ -344,6 +363,17 @@ class InferenceEngine:
             lambda: compile_segment_fn(self.mesh, self.cfg, self.param_shardings,
                                        batch_size, max_len)[0],
         )
+
+    def _fused_generate_fn(self, batch_size: int, max_len: int,
+                           max_new_tokens: int, temperature: float,
+                           top_k: int, top_p: float):
+        """(generate_fn, cache_sharding) for the fused whole-generation
+        program — shared wiring in decoding.fused_generate_fn."""
+        from deepspeed_tpu.inference.decoding import fused_generate_fn
+
+        return fused_generate_fn(self, self.mesh, self.cfg, self.param_shardings,
+                                 batch_size, max_len, max_new_tokens,
+                                 temperature, top_k, top_p)
 
     def _ragged_fns_for(self, batch_size: int, max_len: int):
         """(ragged_prefill_fn, segment_fn, cache_sharding) for attention_mask

@@ -151,10 +151,12 @@ class TpuHybridEngine(TpuEngine):
         distribution exactly.
         """
         tf, cfg = self._model_tf()
-        from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
+        from deepspeed_tpu.inference.decoding import bounded_cache_len
 
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, S = tokens.shape
+        if max_new_tokens <= 0:
+            return tokens
         total = S + max_new_tokens
         assert total <= cfg.max_seq_len, f"{total} > max_seq_len {cfg.max_seq_len}"
         rng = rng if rng is not None else self._next_rng()
@@ -166,13 +168,16 @@ class TpuHybridEngine(TpuEngine):
             self._generate_calls += 1
             return result
         cache_len = bounded_cache_len(total, cfg.max_seq_len, self.config.hybrid_engine.max_out_tokens)
-        prefill_fn, decode_fn, cache_sh = self._ensure_generate_compiled(B, cache_len)
+        # fused whole-generation program (one dispatch per rollout, same
+        # token stream as decode_loop) — RLHF rollouts are decode-bound, so
+        # the per-token dispatch overhead multiplies across the batch loop
+        from deepspeed_tpu.inference.decoding import fused_generate_fn
 
+        gen_fn, cache_sh = fused_generate_fn(
+            self, self.mesh, cfg, self.param_shardings, B, cache_len,
+            max_new_tokens, temperature, top_k, top_p)
         cache = jax.device_put(tf.init_cache(cfg, B, cache_len), cache_sh)
-        result = decode_loop(
-            prefill_fn, decode_fn, params, tokens, cache, max_new_tokens, temperature, top_k, rng,
-            top_p=top_p
-        )
+        result = gen_fn(params, tokens, cache, rng)
         self._generate_calls += 1
         return result
 

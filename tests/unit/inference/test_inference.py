@@ -289,6 +289,37 @@ class TestInferenceEngine:
         assert c.tensor_parallel.tp_size == 4
         assert c.dtype == "float16"
 
+    def test_fused_generate_matches_per_token_loop(self):
+        """The fused whole-generation jit (fused_generate=True, the default)
+        must emit the SAME token stream as the per-token dispatch loop —
+        greedy and sampled (identical rng split order by construction)."""
+        comm.destroy()
+        comm.init_distributed(mesh_shape={"data": -1}, verbose=False)
+        from deepspeed_tpu.inference.engine import init_inference
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+                                max_seq_len=64, dtype="float32")
+        model = TransformerModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        fused = init_inference(model, config={"dtype": "float32"}, params=params)
+        loop = init_inference(model, config={"dtype": "float32",
+                                             "fused_generate": False}, params=params)
+        assert fused.config.fused_generate and not loop.config.fused_generate
+        prompt = np.random.RandomState(0).randint(0, 64, (2, 8))
+        for kwargs in ({"temperature": 0.0},
+                       {"temperature": 0.8, "top_k": 8, "top_p": 0.9,
+                        "rng": jax.random.PRNGKey(7)}):
+            a = np.asarray(fused.generate(prompt, max_new_tokens=6, **kwargs))
+            b = np.asarray(loop.generate(prompt, max_new_tokens=6, **kwargs))
+            np.testing.assert_array_equal(a, b)
+        # single-token edge: scan length 0
+        a = np.asarray(fused.generate(prompt, max_new_tokens=1))
+        assert a.shape == (2, 9)
+        # zero-token edge: prompt returned unchanged (decode_loop contract)
+        z = np.asarray(fused.generate(prompt, max_new_tokens=0))
+        np.testing.assert_array_equal(z, prompt)
+
 
 class TestSampling:
     def test_top_p_restricts_support(self):
