@@ -32,6 +32,11 @@ the footprint for both layouts.
     while eng.has_work():
         eng.step()            # one decode tick per non-empty pool
     out = eng.result(rid)     # prompt + generated tokens (np.int32)
+
+``tokens_per_tick=k`` fuses k decode steps per tick into one compiled
+scan (k× fewer host dispatches per token — the dominant serving cost on
+remote-dispatch links); admission then happens between bursts, adding up
+to k tokens of admission latency. Greedy output is identical to k=1.
 """
 
 from dataclasses import dataclass, field
@@ -87,6 +92,10 @@ class _Pool:
         self.active: Dict[int, _Request] = {}       # slot -> request
         self.pos = np.zeros(n_slots, np.int32)      # next write position
         self.last_tok = np.zeros(n_slots, np.int32)
+        # burst program (tokens_per_tick > 1): shape/sampling are fixed for
+        # the engine's lifetime, so it lives on the pool — built on first
+        # burst tick, never evicted (an LRU here could recompile per tick)
+        self.burst_fn = None
 
     def free_slots(self) -> List[int]:
         return [s for s in range(self.n_slots) if s not in self.active]
@@ -102,7 +111,8 @@ class ContinuousBatchingEngine:
                  max_slots: Optional[int] = None, cache_len: Optional[int] = None,
                  cache_buckets: Optional[List] = None,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 tokens_per_tick: int = 1):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         self._eng = InferenceEngine(model, config=config, params=params,
@@ -111,6 +121,13 @@ class ContinuousBatchingEngine:
         self.mesh = self._eng.mesh
         self.eos_token_id = eos_token_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        # burst decoding: k decode steps per scheduler tick in ONE compiled
+        # program (decoding.compile_burst_segment_fn) — k× fewer host
+        # dispatches per token; new requests admit only between bursts, and
+        # a request finishing mid-burst wastes the rest of its burst row
+        # (the freed slot's stale cache is position-masked on reuse)
+        assert tokens_per_tick >= 1, tokens_per_tick
+        self.tokens_per_tick = tokens_per_tick
         self._rng = jax.random.PRNGKey(seed)
 
         if cache_buckets is None:
@@ -269,11 +286,12 @@ class ContinuousBatchingEngine:
 
     def step(self) -> Dict[int, List[int]]:
         """One scheduler tick: admit pending into free slots, then one
-        decode step for every pool with active slots. Returns
-        {rid: [tokens]} emitted this tick — a just-admitted request emits
-        TWO tokens (its prefill token and the same-tick decode token), so
-        the values are lists; concatenating them across ticks reproduces
-        the generated stream exactly. Finished requests move to
+        decode step (or a ``tokens_per_tick``-token burst) for every pool
+        with active slots. Returns {rid: [tokens]} emitted this tick: an
+        active request emits up to ``tokens_per_tick`` tokens, a
+        just-admitted one additionally leads with its prefill token.
+        Concatenating the lists across ticks reproduces the generated
+        stream exactly. Finished requests move to
         ``finished()``/``result()``."""
         emitted: Dict[int, List[int]] = {}
         # FIFO with skip: a request that only fits the (full) long pool
@@ -290,6 +308,9 @@ class ContinuousBatchingEngine:
 
         for pi, pool in enumerate(self._pools):
             if not pool.active:
+                continue
+            if self.tokens_per_tick > 1:
+                self._burst_tick(pool, emitted)
                 continue
             toks = jnp.asarray(pool.last_tok[:, None])
             pos = jnp.asarray(pool.pos)
@@ -308,6 +329,37 @@ class ContinuousBatchingEngine:
             for slot in [s for s, r in pool.active.items() if r.done]:
                 self._finish(pool, slot)
         return emitted
+
+    def _burst_tick(self, pool: _Pool, emitted: Dict[int, List[int]]):
+        """One k-token burst for a pool: a single dispatch of the compiled
+        burst program, then host-side acceptance (truncate each row at
+        done). Greedy streams are identical to tokens_per_tick=1; sampled
+        streams are equally-distributed but consume the rng in a different
+        order."""
+        from deepspeed_tpu.inference.decoding import compile_burst_segment_fn
+
+        k = self.tokens_per_tick
+        if pool.burst_fn is None:
+            pool.burst_fn = compile_burst_segment_fn(
+                self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
+                pool.length, k, self.temperature, self.top_k, self.top_p)[0]
+        burst_fn = pool.burst_fn
+        toks = jnp.asarray(pool.last_tok[:, None])
+        pos = jnp.asarray(pool.pos)
+        self._rng, sub = jax.random.split(self._rng)
+        out, pool.cache = burst_fn(self._eng.params, toks, pool.cache, pos, sub)
+        out = np.asarray(out)  # (n_slots, k)
+        for slot, req in list(pool.active.items()):
+            accepted = 0
+            for j in range(k):
+                if req.done:
+                    break  # rest of the burst row is wasted work, not state
+                self._record(req, pool, slot, int(out[slot, j]))
+                emitted.setdefault(req.rid, []).append(int(out[slot, j]))
+                accepted += 1
+            pool.pos[slot] += accepted
+        for slot in [s for s, r in pool.active.items() if r.done]:
+            self._finish(pool, slot)
 
     # -- internals ------------------------------------------------------
     def _prefill_for_bucket(self, bucket: int):

@@ -49,6 +49,35 @@ class TestContinuousBatching:
         for rid, want in zip(rids, refs):
             np.testing.assert_array_equal(done[rid], want)
 
+    def test_burst_tick_matches_single_step(self, setup):
+        """tokens_per_tick=4 (k decode steps fused into one compiled scan)
+        must produce the SAME greedy outputs as the per-token tick,
+        including a mid-flight admission and an EOS finishing mid-burst."""
+        model, params, plain = setup
+        prompts = _prompts((5, 9, 3, 7), seed=2)
+        refs = [np.asarray(plain.generate(p[None, :], max_new_tokens=10))[0]
+                for p in prompts]
+        # eos chosen from request 0's stream so it finishes mid-burst
+        eos = int(refs[0][len(prompts[0]) + 2])
+        want = {}
+        for i, r in enumerate(refs):
+            gen = r[len(prompts[i]):]
+            cut = np.nonzero(gen == eos)[0]
+            end = cut[0] + 1 if cut.size else len(gen)
+            want[i] = np.concatenate([prompts[i], gen[:end]])
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=3, cache_len=64,
+                                      eos_token_id=eos, tokens_per_tick=4)
+        rids = [cb.submit(p, max_new_tokens=10) for p in prompts[:3]]
+        cb.step()
+        rids.append(cb.submit(prompts[3], max_new_tokens=10))  # slot reuse
+        while cb.has_work():
+            cb.step()
+        done = cb.finished()
+        for i, rid in enumerate(rids):
+            np.testing.assert_array_equal(done[rid], want[i])
+
     def test_eos_frees_slot_early(self, setup):
         """A request hitting EOS releases its slot while others continue."""
         model, params, plain = setup
