@@ -578,7 +578,7 @@ def _save_winner(device_kind, attn, remat, bs, block=None):
 
 def bench_gpt2_train():
     """Headline bench, SELF-TUNING: unless DSTPU_BENCH_ATTN pins a config,
-    briefly probe ≤3 candidate attention/remat/micro-batch configs (PERF.md
+    briefly probe ≤5 candidate attention/remat/micro-batch configs (PERF.md
     sweep: attention softmax HBM traffic + the dots_saveable remat stash are
     the two dominant costs; the Pallas flash kernel removes both) and run
     the full measurement on the winner. The winner is cached per device
@@ -595,12 +595,16 @@ def bench_gpt2_train():
     cached = None if (pinned_attn or pinned_remat or pinned_bs or _SMOKE
                       or os.environ.get("DSTPU_BENCH_NOCACHE") == "1") else _cached_winner(device_kind)
     # PERF.md sweep: flash kernel (no softmax HBM traffic, no 2.4 GB remat
-    # stash) at bs 8/16 and tile 128(default)/256
+    # stash) at bs 8/16/32 and the silicon-tuned auto tile (None -> 512)
+    # vs a pinned 256. bs32 OOM'd with xla attention (r1); with flash
+    # no-remat the residuals are ~0.15 GB/layer so it should fit — a
+    # failing candidate just records its error and the sweep moves on.
     sweep = [
         ("xla", True, 8, None),
         ("pallas", False, 8, None),   # flash frees the logits stash: no-remat may fit
         ("pallas", False, 8, 256),
         ("pallas", False, 16, None),
+        ("pallas", False, 32, None),  # biggest per-core tiles (MXU efficiency)
     ]
     if pinned_attn or pinned_remat or _SMOKE:
         # any explicit A/B pin disables self-tuning for that axis
