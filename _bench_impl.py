@@ -105,6 +105,12 @@ def _sync(engine, loss):
     return float(loss) + float(jnp.sum(jax.tree.leaves(engine.params)[0]))
 
 
+def _progress(msg):
+    # milestones go to stderr as they happen: when the parent SIGKILLs an
+    # over-budget phase, the log still says WHERE the budget went
+    print(f"bench progress: {msg}", file=sys.stderr, flush=True)
+
+
 def _release_device_memory():
     """Free every device buffer and compiled-executable reference this
     process holds. The r5 self-tune OOM'd because four probe engines'
@@ -141,6 +147,7 @@ def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     jax.block_until_ready(engine.params)
     t_init = time.time() - t_init0
+    _progress(f"engine init done in {t_init:.1f}s")
     rs = np.random.RandomState(0)
     n_dev = jax.device_count()
     if batch is None:
@@ -157,14 +164,26 @@ def _train_bench(model, config, micro_bs, seq, iters, warmup_steps=1, batch=None
         loss = step()
     _sync(engine, loss)
     t_warm = time.time() - t_warm0
+    _progress(f"warmup (compile + {warmup_steps} step) done in {t_warm:.1f}s")
     t0 = time.time()
-    for _ in range(iters):
+    for i in range(iters):
         loss = step()
+        # per-step sync + milestone only for slow phases (timings callers,
+        # e.g. zero3_offload, whose steps are tens of seconds and already
+        # host-synchronous on the offload path — the extra barrier is one
+        # relay RTT, noted in the timings contract below). Fast benches
+        # stay fully pipelined: a mid-loop sync would add a host round
+        # trip to a loop measured in ms.
+        if timings is not None and i < iters - 1:
+            _sync(engine, loss)
+            _progress(f"measured step {i + 1}/{iters} done at {time.time() - t0:.1f}s")
     _sync(engine, loss)
     dt = (time.time() - t0) / iters
     if timings is not None:
         timings["init_s"] = round(t_init, 1)
         timings["warmup_s"] = round(t_warm, 1)
+        # step_s includes one host-sync RTT per step (the progress
+        # barrier above) — honest wall time for host-synchronous phases
         timings["step_s"] = round(dt, 2)
     toks = micro_bs * n_dev * seq / dt
     return toks / n_dev, dt, float(loss), engine
@@ -210,6 +229,7 @@ def bench_zero3_offload(budget_s=240):
         # phase still produces a MEASURED number that localizes the cost to
         # the wire, instead of a fourth consecutive round of skip lines.
         d2h, h2d = _transfer_bandwidth_probe()
+        _progress(f"zero3 bw probe d2h={d2h / 1e9:.3f} GB/s h2d={h2d / 1e9:.3f} GB/s")
         n_steps = 3  # warmup + 2 measured
         compile_margin = 120.0
         model = None
@@ -331,7 +351,13 @@ def bench_decode():
         model = _smoke_model(64)
     else:
         model = TransformerModel.from_preset("gpt2-350m", dtype="bfloat16", max_seq_len=1024)
-    engine = deepspeed_tpu.init_inference(model, config={"dtype": "bfloat16"})
+    # right-size the KV cache to the request (prompt + new tokens): without
+    # max_out_tokens the cache allocates at max_seq_len (1024), and every
+    # decode step streams 4x the needed cache bytes — serving stacks size
+    # the cache to the admitted request, so the bench should too
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "bfloat16",
+                       "max_out_tokens": prompt_len + new_tokens})
     rs = np.random.RandomState(0)
     tokens = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (B, prompt_len)), jnp.int32)
     dt = _decode_window(engine, tokens, new_tokens)
@@ -345,7 +371,9 @@ def bench_decode():
     # bound, so int8 weights should push tokens/s toward 2x
     extra_int8 = {}
     try:
-        eng8 = deepspeed_tpu.init_inference(model, config={"dtype": "int8"})
+        eng8 = deepspeed_tpu.init_inference(
+            model, config={"dtype": "int8",
+                           "max_out_tokens": prompt_len + new_tokens})
         dt8 = _decode_window(eng8, tokens, new_tokens)
         extra_int8 = {
             "int8_tokens_per_sec": round(B * decoded / dt8, 1),

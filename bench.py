@@ -19,7 +19,8 @@ Protocol:
      print the last-good headline with ``"stale": true`` and exit 0.
   3. Self-tuning primary child (<=900 s); on failure a pinned fallback
      child (<=300 s); on failure the stale cache line.
-  4. Secondary phases, each <=240 s, under one global wall-clock budget.
+  4. Secondary phases, each <=240 s (zero3_offload: <=480 s — slow-link
+     transfer volume), under one global wall-clock budget.
   5. Every success updates the last-good cache; the headline line is
      re-printed LAST so drivers that parse the final JSON line see it.
 
@@ -181,7 +182,16 @@ def main():
                       flush=True)
                 _reprint_headline()
                 continue
-            cap = min(per_config_s, int(remaining))
+            # zero3_offload moves ~4 bytes/param over a link measured at
+            # 20-40 MB/s plus a >2 min offload-program compile: the flat
+            # per-config cap killed it four rounds running. It gets 2x the
+            # per-config cap (so an operator-tightened
+            # DSTPU_BENCH_CONFIG_TIMEOUT still scales it down) unless
+            # DSTPU_BENCH_ZERO3_TIMEOUT pins it explicitly.
+            phase_cap = int(os.environ.get("DSTPU_BENCH_ZERO3_TIMEOUT",
+                                           str(2 * per_config_s))) \
+                if name == "zero3_offload" else per_config_s
+            cap = min(phase_cap, int(remaining))
             result, err = _run_child(name, cap,
                                      extra_env={"DSTPU_BENCH_PHASE_BUDGET": str(cap)})
             if result is not None:
