@@ -291,6 +291,60 @@ def bench_zero3_offload(budget_s=240):
     }
 
 
+def bench_long_ctx():
+    """Long-sequence training throughput (the long-context story on one
+    chip: flash attention never materializes the S x S logits, so seq 4096
+    trains where the xla path's fp32 softmax chain pays ~1.6 GB of HBM
+    traffic per layer per direction). Reports the flash number as the
+    metric; the xla+full-remat arm rides along in extra as the A/B.
+
+    Sequence parallelism (ring / Ulysses, parallel/sequence.py) is the
+    multi-chip half of the long-context story — exercised by the dryrun's
+    sp x ep phase; this bench is the single-chip kernel half."""
+    t_phase0 = time.time()
+    budget_s = int(os.environ.get("DSTPU_BENCH_PHASE_BUDGET", "240"))
+    seq, micro_bs = (128, 2) if _SMOKE else (4096, 2)
+
+    # full remat for the xla A/B arm: dots_saveable's stacked-logits stash
+    # is (L,B,H,S,S) bf16 = 9.7 GB at seq 4096 — it cannot ride along
+    model = _gpt2_model(seq, "pallas", remat=False)
+    toks, dt, loss, _ = _train_bench(
+        model, _gpt2_config(micro_bs), micro_bs, seq, iters=8)
+    mfu = toks * model.cfg.flops_per_token(seq) / peak_flops()
+    _release_device_memory()
+    # the flash headline is measured; only run the A/B arm if enough of
+    # the phase budget remains that its compile + 4 iters cannot get the
+    # whole child SIGKILLed (which would lose the headline too)
+    remaining = budget_s - (time.time() - t_phase0)
+    if remaining < 90:
+        xla_ab = {"xla_remat_skipped": f"{int(remaining)}s left of {budget_s}s budget"}
+    else:
+        try:
+            toks_x, _, _, _ = _train_bench(
+                _gpt2_model(seq, "xla", remat=True, remat_policy="nothing_saveable"),
+                _gpt2_config(micro_bs), micro_bs, seq, iters=4)
+            xla_ab = {"xla_remat_tokens_per_sec": round(toks_x, 1),
+                      "flash_speedup_vs_xla": round(toks / toks_x, 2)}
+        except Exception as e:
+            xla_ab = {"xla_remat_error": f"{type(e).__name__}: {e}"[:200]}
+    return {
+        "metric": "gpt2_125m_seq4096_train_tokens_per_sec_per_chip",
+        "value": round(toks, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "seq_len": seq,
+            "micro_bs": micro_bs,
+            "mfu": round(mfu, 4),
+            "step_ms": round(dt * 1e3, 1),
+            "attn_impl": "pallas",
+            "remat": False,
+            "loss": loss,
+            **xla_ab,
+        },
+    }
+
+
 def bench_moe_ep():
     from deepspeed_tpu.models.transformer import TransformerModel, get_config
 
@@ -529,10 +583,10 @@ def bench_bert_mlm():
     }
 
 
-def _gpt2_model(seq, attn, remat, block=None):
+def _gpt2_model(seq, attn, remat, block=None, remat_policy="dots_saveable"):
     from deepspeed_tpu.models.transformer import TransformerModel
 
-    kw = dict(dtype="bfloat16", remat=remat, remat_policy="dots_saveable",
+    kw = dict(dtype="bfloat16", remat=remat, remat_policy=remat_policy,
               max_seq_len=seq, attn_impl=attn, flash_block=block)
     if _SMOKE:
         return _smoke_model(seq, **{k: v for k, v in kw.items() if k != "max_seq_len"})
@@ -749,6 +803,7 @@ PHASES = {
     "primary": bench_gpt2_train,
     "primary_fallback": bench_primary_fallback,
     "decode": bench_decode,
+    "long_ctx": bench_long_ctx,
     "bert_mlm": bench_bert_mlm,
     "moe_ep": bench_moe_ep,
     "hybrid_rlhf": bench_hybrid_rlhf,

@@ -40,7 +40,8 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 _LASTGOOD = os.path.join(_ROOT, ".bench_lastgood.json")
 _SENTINEL = "DSTPU_RESULT "
 
-SECONDARIES = ("decode", "bert_mlm", "moe_ep", "hybrid_rlhf", "zero3_offload")
+SECONDARIES = ("decode", "long_ctx", "bert_mlm", "moe_ep", "hybrid_rlhf",
+               "zero3_offload")
 
 
 def _load_lastgood():
