@@ -312,21 +312,46 @@ def bench_long_ctx():
         model, _gpt2_config(micro_bs), micro_bs, seq, iters=8)
     mfu = toks * model.cfg.flops_per_token(seq) / peak_flops()
     _release_device_memory()
-    # the flash headline is measured; only run the A/B arm if enough of
-    # the phase budget remains that its compile + 4 iters cannot get the
-    # whole child SIGKILLed (which would lose the headline too)
-    remaining = budget_s - (time.time() - t_phase0)
-    if remaining < 90:
-        xla_ab = {"xla_remat_skipped": f"{int(remaining)}s left of {budget_s}s budget"}
-    else:
+
+    # extra arms, each budget-guarded so a slow arm cannot get the whole
+    # child SIGKILLed after the flash headline is already measured
+    def _arm(need_s, fn):
+        remaining = budget_s - (time.time() - t_phase0)
+        if remaining < need_s:
+            return {"skipped": f"{int(remaining)}s left of {budget_s}s budget"}
         try:
-            toks_x, _, _, _ = _train_bench(
-                _gpt2_model(seq, "xla", remat=True, remat_policy="nothing_saveable"),
-                _gpt2_config(micro_bs), micro_bs, seq, iters=4)
-            xla_ab = {"xla_remat_tokens_per_sec": round(toks_x, 1),
-                      "flash_speedup_vs_xla": round(toks / toks_x, 2)}
+            return fn()
         except Exception as e:
-            xla_ab = {"xla_remat_error": f"{type(e).__name__}: {e}"[:200]}
+            return {"error": f"{type(e).__name__}: {e}"[:200]}
+        finally:
+            # a failed arm's engine state must not stay resident in HBM
+            # and poison the next arm
+            _release_device_memory()
+
+    def _sliding_window():
+        # Mistral-style uniform sliding window: the tile-pruned band kernel
+        # does O(S*window) work — at seq 4096 / window 1024 the band visits
+        # ~2/8 of the k-blocks per q-block
+        import dataclasses
+
+        win_model = type(model)(dataclasses.replace(
+            model.cfg, local_attn_windows=(1024,) * model.cfg.num_layers))
+        toks_w, _, _, _ = _train_bench(
+            win_model, _gpt2_config(micro_bs), micro_bs, seq, iters=8)
+        return {"window1024_tokens_per_sec": round(toks_w, 1),
+                "window1024_speedup_vs_full": round(toks_w / toks, 2)}
+
+    def _xla_arm():
+        toks_x, _, _, _ = _train_bench(
+            _gpt2_model(seq, "xla", remat=True, remat_policy="nothing_saveable"),
+            _gpt2_config(micro_bs), micro_bs, seq, iters=4)
+        return {"xla_remat_tokens_per_sec": round(toks_x, 1),
+                "flash_speedup_vs_xla": round(toks / toks_x, 2)}
+
+    win_ab = {f"sliding_{k}" if k in ("skipped", "error") else k: v
+              for k, v in _arm(100, _sliding_window).items()}
+    xla_ab = {f"xla_remat_{k}" if k in ("skipped", "error") else k: v
+              for k, v in _arm(90, _xla_arm).items()}
     return {
         "metric": "gpt2_125m_seq4096_train_tokens_per_sec_per_chip",
         "value": round(toks, 1),
@@ -340,6 +365,7 @@ def bench_long_ctx():
             "attn_impl": "pallas",
             "remat": False,
             "loss": loss,
+            **win_ab,
             **xla_ab,
         },
     }

@@ -468,10 +468,17 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     """Causal multi-head / grouped-query attention.
 
     xla impl: einsum softmax einsum (fp32 logits). pallas impl: flash kernel
-    (ops/pallas/flash_attention.py) once available. ``window`` (traced i32
-    scalar; 0 = unlimited) restricts each query to the last ``window``
-    positions — the GPT-Neo local-attention layers.
+    (ops/pallas/flash_attention.py) once available. ``window`` restricts each
+    query to the last ``window`` positions (0 = unlimited) — GPT-Neo local
+    layers, Mistral sliding windows. A STATIC python-int window rides the
+    flash kernel's tile-pruned sliding-window path (O(S*window) compute and
+    HBM — the layer stack passes static ints whenever the config allows);
+    a traced i32 scalar (per-layer windows inside the layer scan) takes the
+    masked einsum path.
     """
+    if isinstance(window, int) and window <= 0:
+        window = None  # static 0 = a global layer
+    static_window = window if isinstance(window, int) else None
     B, S, nh, hd = q.shape
     nkv = k.shape[2]
     if cfg.seq_parallel in ("ring", "ulysses"):
@@ -505,11 +512,13 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
         # kernel convention matches the model: (B, S, H, hd)
         return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block,
                                       sm_scale=cfg.attn_scale)
-    if window is None and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi":
+    if ((window is None or (static_window is not None and cfg.causal))
+            and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi"):
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
         blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
-        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale, **blk)
+        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale,
+                               window=static_window, **blk)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
@@ -797,8 +806,20 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     )
     pld_on = cfg.pld_enabled and pld_theta is not None and dropout_rng is not None
 
+    # Window staticness: uniform windows (Mistral-style sliding window, or
+    # no windows at all) are baked into the layer body as a python int via
+    # this closure — surviving jax.checkpoint and lax.scan untraced, so
+    # _attention can take the tile-pruned flash path. Only per-layer-varying
+    # windows (GPT-Neo local/global alternation under scan_layers) flow
+    # through as traced scalars.
+    _wins = cfg.local_attn_windows
+    _varying_windows = _wins is not None and len(set(_wins)) > 1
+    _static_win = int(_wins[0]) if (_wins is not None and not _varying_windows) else None
+
     def layer_with_routing(x_in, layer_p, rng, layer_frac, window=None):
         """One layer + data-efficiency wrappers (LTD token subset, PLD skip)."""
+        if not _varying_windows:
+            window = _static_win  # closure keeps it a static python int
         r_drop = r_ltd = r_pld = None
         if rng is not None:
             r_drop, r_ltd, r_pld = jax.random.split(rng, 3)
@@ -827,7 +848,14 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
 
     layer_fn = layer_with_routing
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy), static_argnums=())
+        # unrolled layers receive window as a static python int (per-layer
+        # flash tile pruning); it must stay static THROUGH the checkpoint
+        # wrapper or the tracer defeats the isinstance(int) gate in
+        # _attention. The scan path passes traced windows, where
+        # static_argnums would be an error.
+        static_args = (4,) if (not cfg.scan_layers and _varying_windows) else ()
+        layer_fn = jax.checkpoint(layer_fn, policy=_resolve_remat_policy(cfg.remat_policy),
+                                  static_argnums=static_args)
     if _ckpt.partition_activations_enabled():
         # partition_activations (reference checkpointing.py:366): shard the
         # layer-boundary residual over tensor(+sequence) so the saved stash
@@ -855,15 +883,16 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
         else:
             layer_rngs = jnp.zeros((L, 2), jnp.uint32)
 
-        windows = (
-            jnp.asarray(cfg.local_attn_windows, jnp.int32)
-            if cfg.local_attn_windows is not None else jnp.zeros((L,), jnp.int32)
-        )
+        # uniform/absent windows are baked into the layer body as a static
+        # int (see layer_with_routing); the stacked array only carries
+        # per-layer-VARYING windows
+        windows = (jnp.asarray(cfg.local_attn_windows, jnp.int32)
+                   if _varying_windows else jnp.zeros((L,), jnp.int32))
 
         def scan_step(carry, inp):
             layer_p, rng, frac, win = inp
             rng = rng if needs_rng else None
-            win = win if cfg.local_attn_windows is not None else None
+            win = win if _varying_windows else None
             new_x, aux = layer_fn(carry, layer_p, rng, frac, win)
             return new_x, aux
 
@@ -874,7 +903,10 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
         for i in range(L):
             layer_p = jax.tree.map(lambda p: p[i], layers)
             rng = jax.random.fold_in(dropout_rng, i) if needs_rng else None
-            win = (jnp.int32(cfg.local_attn_windows[i])
+            # unrolled layers: every window is a static python int, so
+            # each local layer gets the tile-pruned flash path (uniform
+            # windows are redundantly re-set by the layer-body closure)
+            win = (int(cfg.local_attn_windows[i])
                    if cfg.local_attn_windows is not None else None)
             x, aux = layer_fn(x, layer_p, rng, layer_fracs[i], win)
             aux_total = aux_total + aux

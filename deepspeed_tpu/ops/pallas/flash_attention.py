@@ -78,10 +78,18 @@ def _auto_block(size: int, cap: Optional[int]) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale, causal, bq, bk, nk):
-    qi, ki = pl.program_id(2), pl.program_id(3)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale, causal, bq, bk, nk, window=None):
+    qi, step = pl.program_id(2), pl.program_id(3)
+    if window is None:
+        ki = step
+        first, last = ki == 0, ki == nk - 1
+    else:
+        # windowed: iterate backward from the diagonal block; the grid's
+        # last dim only spans the k-blocks a window-wide band can touch
+        ki = (qi * bq + bq - 1) // bk - step
+        first, last = step == 0, step == nk - 1  # nk = band width here
 
-    @pl.when(ki == 0)
+    @pl.when(first)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
@@ -90,6 +98,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
     should_compute = True
     if causal:
         should_compute = ki * bk <= qi * bq + bq - 1
+    if window is not None:
+        # block touches [qpos_min - window + 1 .. qpos_max] and exists
+        should_compute = (ki >= 0) & (ki * bk + bk - 1 >= qi * bq - window + 1)
 
     @pl.when(should_compute)
     def _compute():
@@ -102,7 +113,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            ok = qpos >= kpos
+            if window is not None:
+                ok = ok & (qpos - kpos < window)
+            s = jnp.where(ok, s, NEG_INF)
         m_prev = m_scr[:, :1]  # (bq, 1)
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -115,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         m = m_scr[:, :1]
         l = l_scr[:, :1]
@@ -131,25 +145,47 @@ def _sds(shape, dtype, vma):
     return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma=None):
+def _band_width(window, b_outer, b_inner, n_inner):
+    """Number of inner blocks a causal window of ``window`` positions can
+    touch per outer block: the band spans (b_outer + window - 1) positions,
+    plus one block of slack for misalignment — capped at the full grid."""
+    return min(n_inner, (b_outer + window - 1 + b_inner - 1) // b_inner + 1)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma=None, window=None):
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     group = H // Hkv
     bq, bk = _auto_block(Sq, block_q), _auto_block(Sk, block_k)
     assert Sq % bq == 0 and Sk % bk == 0, f"seq lens ({Sq},{Sk}) must tile by ({bq},{bk})"
     nq, nk = Sq // bq, Sk // bk
-    grid = (B, H, nq, nk)
+    if window is None:
+        grid = (B, H, nq, nk)
+        nk_eff = nk
+
+        def k_index(b, h, qi, ki):
+            return (b, h // group, ki, 0)
+    else:
+        # tile pruning: only the k-blocks in the window band are visited
+        # (O(S*W) compute AND DMA); the kernel walks backward from the
+        # diagonal block and masks the band edges
+        nk_eff = _band_width(window, bq, bk, nk)
+        grid = (B, H, nq, nk_eff)
+
+        def k_index(b, h, qi, j):
+            return (b, h // group, jnp.maximum((qi * bq + bq - 1) // bk - j, 0), 0)
 
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk_eff,
+        window=window,
     )
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, bk, hd), k_index),
+            pl.BlockSpec((1, 1, bk, hd), k_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -176,16 +212,24 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma=None):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, sm_scale, causal, bq, bk, nk):
-    qi, ki = pl.program_id(2), pl.program_id(3)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, sm_scale, causal, bq, bk, nk, window=None):
+    qi, step = pl.program_id(2), pl.program_id(3)
+    if window is None:
+        ki = step
+        first, last = ki == 0, ki == nk - 1
+    else:
+        ki = (qi * bq + bq - 1) // bk - step
+        first, last = step == 0, step == nk - 1  # nk = band width here
 
-    @pl.when(ki == 0)
+    @pl.when(first)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     should_compute = True
     if causal:
         should_compute = ki * bk <= qi * bq + bq - 1
+    if window is not None:
+        should_compute = (ki >= 0) & (ki * bk + bk - 1 >= qi * bq - window + 1)
 
     @pl.when(should_compute)
     def _compute():
@@ -201,7 +245,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            ok = qpos >= kpos
+            if window is not None:
+                ok = ok & (qpos - kpos < window)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -209,15 +256,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, 
         ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_scr[...] = dq_scr[...] + jax.lax.dot(ds, k, preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, bq, bk, nq):
-    ki, qi = pl.program_id(2), pl.program_id(3)
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal, bq, bk, nq, window=None, nq_total=None):
+    ki, step = pl.program_id(2), pl.program_id(3)
+    if window is None:
+        qi = step
+        first, last = qi == 0, qi == nq - 1
+    else:
+        # inverted band: walk the q-blocks that can see this k-block,
+        # starting at the diagonal
+        qi = (ki * bk) // bq + step
+        first, last = step == 0, step == nq - 1  # nq = band width here
 
-    @pl.when(qi == 0)
+    @pl.when(first)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -225,6 +280,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     should_compute = True
     if causal:
         should_compute = qi * bq + bq - 1 >= ki * bk
+    if window is not None:
+        # band edge (q-block outside the window of this k-block) and grid
+        # edge (qi walked past the last real q-block, index_map clamped)
+        should_compute = (should_compute
+                          & (qi * bq < ki * bk + bk + window - 1)
+                          & (qi <= nq_total - 1))
 
     @pl.when(should_compute)
     def _compute():
@@ -240,7 +301,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            ok = qpos >= kpos
+            if window is not None:
+                ok = ok & (qpos - kpos < window)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse).astype(do.dtype)  # (bq, bk)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -253,13 +317,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
+def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, window, res, do):
     q, k, v, o, lse = res
     B, H, Sq, hd = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
@@ -269,13 +333,32 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True)  # (B,H,Sq,1)
 
+    if window is None:
+        nk_eff, nq_eff = nk, nq
+
+        def dq_k_index(b, h, qi, ki):
+            return (b, h // group, ki, 0)
+
+        def dkv_q_index(b, h, ki, qi):
+            return (b, h, qi, 0)
+    else:
+        nk_eff = _band_width(window, bq, bk, nk)
+        nq_eff = _band_width(window, bk, bq, nq)
+
+        def dq_k_index(b, h, qi, j):
+            return (b, h // group, jnp.maximum((qi * bq + bq - 1) // bk - j, 0), 0)
+
+        def dkv_q_index(b, h, ki, j):
+            return (b, h, jnp.minimum((ki * bk) // bq + j, nq - 1), 0)
+
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nk=nk),
-        grid=(B, H, nq, nk),
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk,
+                          nk=nk_eff, window=window),
+        grid=(B, H, nq, nk_eff),
         in_specs=[
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, qi, ki: (b, h // group, ki, 0)),
-            pl.BlockSpec((1, 1, bk, hd), lambda b, h, qi, ki: (b, h // group, ki, 0)),
+            pl.BlockSpec((1, 1, bk, hd), dq_k_index),
+            pl.BlockSpec((1, 1, bk, hd), dq_k_index),
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -290,15 +373,16 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
     )(q, k, v, do, lse, delta)
 
     dk_full, dv_full = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk, nq=nq),
-        grid=(B, H, nk, nq),
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal, bq=bq, bk=bk,
+                          nq=nq_eff, window=window, nq_total=nq),
+        grid=(B, H, nk, nq_eff),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, hd), dkv_q_index),
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, ki, qi: (b, h // group, ki, 0)),
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, ki, qi: (b, h // group, ki, 0)),
-            pl.BlockSpec((1, 1, bq, hd), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, bq, hd), dkv_q_index),
+            pl.BlockSpec((1, 1, bq, 1), dkv_q_index),
+            pl.BlockSpec((1, 1, bq, 1), dkv_q_index),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, hd), lambda b, h, ki, qi: (b, h, ki, 0)),
@@ -330,19 +414,19 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
 # public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma, window):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma, window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma)
+def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma, window):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, vma, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, vma, res, do):
-    return _bwd(causal, sm_scale, block_q, block_k, interpret, vma, res, do)
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, vma, window, res, do):
+    return _bwd(causal, sm_scale, block_q, block_k, interpret, vma, window, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -358,6 +442,7 @@ def flash_attention(
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     vma=None,
+    window: Optional[int] = None,
 ):
     """Flash attention on (B, S, H, head_dim) tensors (GQA via fewer KV heads).
 
@@ -369,19 +454,34 @@ def flash_attention(
     varying mesh axes to stamp on the kernel outputs when called inside a
     vma-checked ``shard_map`` (e.g. ``("sequence",)`` for the Ulysses local
     attention).
+
+    ``window``: static sliding-window size — each query attends keys in
+    ``(qpos - window, qpos]`` (Mistral-style; the reference's
+    SparseSelfAttention local modes). The kernel grids only visit the
+    k-blocks inside the window band, so compute AND HBM traffic are
+    O(S * window) instead of O(S^2). Requires ``causal`` and equal q/k
+    lengths; for best pruning pick ``block_k`` no larger than the window.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if window is not None:
+        assert causal, "sliding-window flash attention requires causal=True"
+        assert q.shape[1] == k.shape[1], (
+            "sliding-window flash attention requires equal q/k sequence lengths")
+        window = int(window)
+        assert window >= 1, f"window must be >= 1, got {window}"
     interpret = _auto_interpret(interpret)
     vma = tuple(vma) if vma else None
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    o = _flash_bhsd(qt, kt, vt, causal, sm_scale, block_q, block_k, interpret, vma)
+    o = _flash_bhsd(qt, kt, vt, causal, sm_scale, block_q, block_k, interpret, vma,
+                    window)
     return jnp.transpose(o, (0, 2, 1, 3))
 
 
-def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None):
+def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                  window: Optional[int] = None):
     """jnp reference for parity tests."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -390,9 +490,16 @@ def mha_reference(q, k, v, causal: bool = True, sm_scale: Optional[float] = None
         k = jnp.repeat(k, H // Hkv, axis=2)
         v = jnp.repeat(v, H // Hkv, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    S, Sk = q.shape[1], k.shape[1]
+    mask = None
     if causal:
-        S, Sk = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((S, Sk), jnp.bool_))
+    if window is not None:
+        qp = jnp.arange(S, dtype=jnp.int32)[:, None]
+        kp = jnp.arange(Sk, dtype=jnp.int32)[None, :]
+        local = qp - kp < window
+        mask = local if mask is None else mask & local
+    if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
