@@ -476,10 +476,14 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     a traced i32 scalar (per-layer windows inside the layer scan) takes the
     masked einsum path.
     """
-    if isinstance(window, int) and window <= 0:
-        window = None  # static 0 = a global layer
-    static_window = window if isinstance(window, int) else None
     B, S, nh, hd = q.shape
+    if isinstance(window, int) and (window <= 0 or window >= S):
+        # static 0 = a global layer; a window covering the whole sequence
+        # is a numerical no-op — elide it so e.g. Mistral (sliding_window
+        # 4096) at seq <= 4096 keeps the unwindowed fast paths, including
+        # sequence parallelism below
+        window = None
+    static_window = window if isinstance(window, int) else None
     nkv = k.shape[2]
     if cfg.seq_parallel in ("ring", "ulysses"):
         from deepspeed_tpu import comm

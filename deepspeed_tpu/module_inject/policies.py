@@ -199,6 +199,12 @@ class LlamaPolicy(HFPolicy):
             use_bias=False,
             norm_eps=hf_config.rms_norm_eps,
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            # Mistral: uniform sliding window (HF `sliding_window`) — a
+            # static uniform window rides the tile-pruned flash band
+            # kernel during training/prefill
+            local_attn_windows=(
+                (int(hf_config.sliding_window),) * hf_config.num_hidden_layers
+                if getattr(hf_config, "sliding_window", None) else None),
         )
 
     def params(self, state, cfg) -> Dict:

@@ -84,14 +84,25 @@ class PipelinedTransformer:
         except Exception:
             return None
 
+    @staticmethod
+    def _check_windows(cfg, seq_len):
+        # windows covering the whole sequence are numerical no-ops (the
+        # layer body elides them); only a window that actually restricts
+        # attention at this seq length is unsupported here
+        assert cfg.local_attn_windows is None or all(
+            w <= 0 or w >= seq_len for w in cfg.local_attn_windows
+        ), (
+            f"local-attention windows {cfg.local_attn_windows} restrict "
+            f"attention at seq_len={seq_len} (GPT-Neo local layers, Mistral "
+            "sliding window) and are not supported in the pipeline engine; "
+            "run data/tensor-parallel instead, or train at seq_len <= window"
+        )
+
     def loss(self, params, batch, rng=None):
         cfg = self.cfg
         tokens = batch["input_ids"]  # (M, mb, S)
-        assert cfg.local_attn_windows is None, (
-            "per-layer local-attention windows (GPT-Neo) are not supported in "
-            "the pipeline engine; run data/tensor-parallel instead"
-        )
         assert tokens.ndim == 3, f"pipeline batch must be (microbatches, mb, seq), got {tokens.shape}"
+        self._check_windows(cfg, tokens.shape[2])
         M, mb, S = tokens.shape
         dtype = cfg.jnp_dtype
 
@@ -156,11 +167,8 @@ class PipelinedTransformer:
 
         cfg = self.cfg
         tokens = batch["input_ids"]
-        assert cfg.local_attn_windows is None, (
-            "per-layer local-attention windows (GPT-Neo) are not supported in "
-            "the pipeline engine; run data/tensor-parallel instead"
-        )
         assert tokens.ndim == 3, f"pipeline batch must be (microbatches, mb, seq), got {tokens.shape}"
+        self._check_windows(cfg, tokens.shape[2])
         M, mb, S = tokens.shape
         dtype = cfg.jnp_dtype
 

@@ -34,6 +34,20 @@ def _tiny_llama():
     return LlamaForCausalLM(cfg).eval()
 
 
+def _tiny_mistral():
+    import torch
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    cfg = MistralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=8,  # < the test prompt length, so the window matters
+        attn_implementation="eager",
+    )
+    return MistralForCausalLM(cfg).eval()
+
+
 def _tiny_opt(post_ln=False):
     import torch
     from transformers import OPTConfig, OPTForCausalLM
@@ -92,8 +106,8 @@ def _tiny_gptj():
 class TestHFConversion:
     @pytest.mark.parametrize(
         "maker",
-        [_tiny_gpt2, _tiny_llama, _tiny_opt, _tiny_opt_postln, _tiny_bloom, _tiny_neox, _tiny_gptj],
-        ids=["gpt2", "llama", "opt", "opt-350m-postln", "bloom", "gptneox", "gptj"],
+        [_tiny_gpt2, _tiny_llama, _tiny_mistral, _tiny_opt, _tiny_opt_postln, _tiny_bloom, _tiny_neox, _tiny_gptj],
+        ids=["gpt2", "llama", "mistral", "opt", "opt-350m-postln", "bloom", "gptneox", "gptj"],
     )
     def test_logits_parity_with_hf(self, maker):
         import torch

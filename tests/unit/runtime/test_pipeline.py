@@ -158,6 +158,39 @@ class TestPipelinedTransformer:
         assert engine.global_steps == 8
         assert losses[-1] < losses[0], f"no learning: {losses}"
 
+    def test_noop_windows_allowed_restricting_rejected(self):
+        """Mistral checkpoints carry sliding_window in config; when the run's
+        seq length is <= the window it is a numerical no-op and the pipeline
+        engine must accept it (loss matches the windowless config exactly).
+        A window that actually restricts attention still fails loudly."""
+        comm.destroy()
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+
+        kw = dict(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4, max_seq_len=16)
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"pipe": 2, "data": 4},
+            "steps_per_print": 10_000,
+        }
+        rs = np.random.RandomState(0)
+        batch = rs.randint(0, 64, (4, 16)).astype(np.int32)
+
+        def one_loss(cfg):
+            comm.destroy()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerModel(cfg), config=config)
+            it = iter(lambda: {"input_ids": batch}, None)
+            return float(engine.train_batch(it))
+
+        base = one_loss(TransformerConfig(**kw))
+        noop = one_loss(TransformerConfig(**kw, local_attn_windows=(16,) * 4))
+        np.testing.assert_allclose(noop, base, rtol=1e-6)
+
+        with pytest.raises(AssertionError, match="restrict attention"):
+            one_loss(TransformerConfig(**kw, local_attn_windows=(8,) * 4))
+
 
 class Test1F1B:
     """Fused 1F1B executor (pipelining.pipeline_1f1b_grads): gradient parity
