@@ -57,6 +57,18 @@ class InferenceConfig:
     # distinct (batch, cache_len, max_new_tokens, sampling) combination;
     # disable for workloads that sweep many generation lengths.
     fused_generate: bool = True
+    # rolling (ring-buffer) KV cache for uniform-sliding-window models
+    # (Mistral): the cache holds only the last `window` positions — decode
+    # HBM footprint and cache-read bytes are O(window) instead of O(total
+    # length). Auto-applies when safe (uniform window, rope/no pos-emb,
+    # flash prefill available, no speculative decoding); exact — slot
+    # positions derive modulo the cache length.
+    rolling_kv_cache: bool = True
+    # override the model's attention implementation for inference
+    # ("xla" | "pallas" | "block_sparse"); None keeps the model config's.
+    # Flash ("pallas") is exact and the TPU bench winner — converted
+    # Llama/Mistral checkpoints already default to it via their policy.
+    attn_impl: Optional[str] = None
     max_tokens: int = 1024  # alias accepted from reference configs
     replace_with_kernel_inject: bool = False  # TPU: kernels come from XLA/Pallas
     replace_method: str = "auto"

@@ -117,7 +117,11 @@ class ContinuousBatchingEngine:
 
         self._eng = InferenceEngine(model, config=config, params=params,
                                     mesh=mesh, seed=seed)
-        self.cfg = self._eng.cfg
+        # slot caches are written at per-row depths (ragged admission), which
+        # the rolling ring's aligned-path math does not cover — the slot
+        # pools run plain full/bucket-length caches; bucketing already bounds
+        # the footprint (see PERF.md bucketed-KV table)
+        self.cfg = self._eng._ring_off_cfg
         self.mesh = self._eng.mesh
         self.eos_token_id = eos_token_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p

@@ -79,6 +79,22 @@ class InferenceEngine:
             overrides["kv_cache_dtype"] = self.config.kv_cache_dtype
         if want_dtype is not None:
             overrides["dtype"] = want_dtype
+        if self.config.attn_impl is not None and self.config.attn_impl != cfg.attn_impl:
+            assert self.config.attn_impl in ("xla", "pallas", "block_sparse"), \
+                self.config.attn_impl
+            overrides["attn_impl"] = self.config.attn_impl
+        # rolling KV cache: exact for uniform-window models when prefill
+        # rides the flash band kernel (segment attention never reads the
+        # ring) and positions are relative (rope) or absent. Speculative
+        # decoding writes per-row segments at varying depths — its paths
+        # compile ring-off (full-length caches), so leave it off entirely.
+        if (self.config.rolling_kv_cache
+                and cfg.uniform_window is not None
+                and cfg.pos_embedding in ("rope", "none")
+                and overrides.get("attn_impl", cfg.attn_impl) == "pallas"
+                and cfg.causal
+                and not self.config.speculative.enabled):
+            overrides["rolling_kv_cache"] = True
         if overrides:
             import dataclasses
 
@@ -314,6 +330,7 @@ class InferenceEngine:
             return result
 
         max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
+        max_len = self._ring_cache_len(max_len, S)
         if self.config.fused_generate:
             # one dispatch for the whole generation (prefill + scan over
             # decode steps) — identical token stream to decode_loop
@@ -343,6 +360,32 @@ class InferenceEngine:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
 
+    def _ring_cache_len(self, max_len: int, prompt_len: int) -> int:
+        """Rolling-cache sizing: shrink the cache to the sliding window when
+        prefill will ride the flash band path (segment attention never reads
+        the ring) — or the prompt is a single token. Otherwise keep the full
+        length: the ring math degenerates to a plain cache when nothing
+        wraps, so correctness never depends on this choice."""
+        if not self.cfg.rolling_kv_cache:
+            return max_len
+        from deepspeed_tpu.ops.pallas.flash_attention import supports_seq_len
+
+        if prompt_len > 1 and not supports_seq_len(prompt_len):
+            return max_len  # einsum prefill must see an unwrapped cache
+        return min(max_len, self.cfg.uniform_window)
+
+    @property
+    def _ring_off_cfg(self):
+        """cfg clone for the per-row-depth compiled families (speculative /
+        ragged / continuous segments): they write rows at varying offsets,
+        which the ring's aligned-path math does not cover — they run with
+        full-length caches instead."""
+        if not self.cfg.rolling_kv_cache:
+            return self.cfg
+        import dataclasses
+
+        return dataclasses.replace(self.cfg, rolling_kv_cache=False)
+
     def _cached_fn(self, kind: str, key, builder):
         """Bounded memoization for every compiled-fn family on the engine
         (plain decode, speculative, ragged) — decoding.cached_fn, shared
@@ -360,7 +403,7 @@ class InferenceEngine:
 
         return self._cached_fn(
             "segment", (batch_size, max_len),
-            lambda: compile_segment_fn(self.mesh, self.cfg, self.param_shardings,
+            lambda: compile_segment_fn(self.mesh, self._ring_off_cfg, self.param_shardings,
                                        batch_size, max_len)[0],
         )
 
@@ -382,7 +425,7 @@ class InferenceEngine:
 
         prefill_fn, cache_sh = self._cached_fn(
             "ragged_prefill", (batch_size, max_len),
-            lambda: compile_ragged_prefill_fn(self.mesh, self.cfg, self.param_shardings,
+            lambda: compile_ragged_prefill_fn(self.mesh, self._ring_off_cfg, self.param_shardings,
                                               batch_size, max_len)[:2],
         )
         return prefill_fn, self._segment_fn(batch_size, max_len), cache_sh
@@ -397,7 +440,7 @@ class InferenceEngine:
         prefill_fn, cache_sh = self._cached_fn(
             "spec_prefill", (batch_size, max_len),
             lambda: (lambda r: (r[0], r[2]))(compile_decode_fns(
-                self.mesh, self.cfg, self.param_shardings, batch_size, max_len)),
+                self.mesh, self._ring_off_cfg, self.param_shardings, batch_size, max_len)),
         )
         return prefill_fn, self._segment_fn(batch_size, max_len), cache_sh
 
@@ -408,7 +451,7 @@ class InferenceEngine:
 
         t0 = time.time()
         result = speculative_generate(
-            self.cfg, self.params, draft, tokens, max_new_tokens, temperature,
+            self._ring_off_cfg, self.params, draft, tokens, max_new_tokens, temperature,
             top_k, top_p, rng, gamma, self.config.max_out_tokens,
             get_fns=self._spec_fns, eos_token_id=eos_token_id,
         )

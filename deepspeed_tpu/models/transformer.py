@@ -84,6 +84,14 @@ class TransformerConfig:
     # decode reads half the cache bytes, context capacity doubles — the
     # quantize/dequantize lives in ops/transformer/inference_ops)
     kv_cache_dtype: str = "model"
+    # rolling (ring-buffer) KV cache for uniform-sliding-window models
+    # (Mistral): the cache holds only the last `window` positions — decode
+    # memory and cache-read bandwidth are O(window) instead of O(total
+    # generated length). Set by the inference engine when the conditions
+    # hold (uniform window, rope/no pos-emb, flash prefill available);
+    # slot absolute positions are derived modulo the cache length, so the
+    # math degenerates to the plain cache whenever nothing wraps.
+    rolling_kv_cache: bool = False
     # --- MoE (reference: deepspeed/moe/; 0 experts = dense MLP) ---
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -116,6 +124,24 @@ class TransformerConfig:
             object.__setattr__(
                 self, "sparse_attention", tuple(sorted(self.sparse_attention.items()))
             )
+
+    @property
+    def uniform_window(self) -> Optional[int]:
+        """The single static sliding-window size when every layer shares one
+        positive window (Mistral); None for no windows or per-layer mixes
+        (GPT-Neo alternation)."""
+        w = self.local_attn_windows
+        if w is None or len(set(w)) != 1 or int(w[0]) <= 0:
+            return None
+        return int(w[0])
+
+    @property
+    def varying_windows(self) -> bool:
+        """True when windows differ per layer (GPT-Neo alternation) and must
+        ride the layer scan as traced scalars; uniform/absent windows stay
+        static python ints (flash band kernel + rolling cache rely on it)."""
+        w = self.local_attn_windows
+        return w is not None and len(set(w)) > 1
 
     @property
     def head_dim(self):
@@ -477,11 +503,14 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     masked einsum path.
     """
     B, S, nh, hd = q.shape
+    had_window = window is not None
     if isinstance(window, int) and (window <= 0 or window >= S):
         # static 0 = a global layer; a window covering the whole sequence
         # is a numerical no-op — elide it so e.g. Mistral (sliding_window
         # 4096) at seq <= 4096 keeps the unwindowed fast paths, including
-        # sequence parallelism below
+        # sequence parallelism below. had_window still gates the
+        # block-sparse branch: elision must not reroute a windowed config
+        # onto an APPROXIMATE kernel it never used before.
         window = None
     static_window = window if isinstance(window, int) else None
     nkv = k.shape[2]
@@ -501,7 +530,7 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
                 q, k, v, impl=cfg.seq_parallel, causal=cfg.causal, mesh=mesh,
                 attn_impl=cfg.attn_impl, sm_scale=cfg.attn_scale,
             )
-    if window is None and cfg.attn_impl == "block_sparse":
+    if not had_window and window is None and cfg.attn_impl == "block_sparse":
         # layout-aware Pallas kernel: long-sequence training/prefill path
         # (reference SparseSelfAttention; decode stays dense — the KV-cache
         # loop attends a single query row)
@@ -816,9 +845,10 @@ def forward(params, cfg: TransformerConfig, tokens, dropout_rng=None,
     # _attention can take the tile-pruned flash path. Only per-layer-varying
     # windows (GPT-Neo local/global alternation under scan_layers) flow
     # through as traced scalars.
-    _wins = cfg.local_attn_windows
-    _varying_windows = _wins is not None and len(set(_wins)) > 1
-    _static_win = int(_wins[0]) if (_wins is not None and not _varying_windows) else None
+    _varying_windows = cfg.varying_windows
+    _static_win = (int(cfg.local_attn_windows[0])
+                   if (cfg.local_attn_windows is not None and not _varying_windows)
+                   else None)
 
     def layer_with_routing(x_in, layer_p, rng, layer_frac, window=None):
         """One layer + data-efficiency wrappers (LTD token subset, PLD skip)."""
@@ -1099,35 +1129,47 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     # program (compile_decode_fns traces with a Python 0), where attention
     # over the segment is exactly causal self-attention — the Pallas flash
     # kernel computes it without materializing the (B, H, S, T) logits
-    # (reference: the inference softmax_context kernel family)
+    # (reference: the inference softmax_context kernel family). Static
+    # windows ride the kernel's tile-pruned band path; the rolling cache
+    # RELIES on this (segment attention must not read the ring, whose
+    # slots a long segment partially evicts).
     from deepspeed_tpu.ops.pallas.flash_attention import supports_seq_len
 
     use_flash_prefill = (
         isinstance(pos, int) and pos == 0 and S > 1
-        and window is None
+        and (window is None or isinstance(window, int))
         and cfg.attn_impl == "pallas" and cfg.causal
         and cfg.pos_embedding != "alibi"
         # seq lens the auto-tiler can't cover stay on the einsum path
         # rather than erroring at trace time
         and supports_seq_len(S)
     )
+    ring = cfg.rolling_kv_cache
 
-    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos, positions)
+    k_cache, v_cache = update_kv_cache(k_cache, v_cache, k, v, pos, positions,
+                                       ring=ring)
 
     if use_flash_prefill:
         from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-        attn_out = flash_attention(q, k, v, causal=True,
-                                   sm_scale=cfg.attn_scale).reshape(B, S, nh * hd)
+        w = window if isinstance(window, int) and window > 0 and window < S else None
+        attn_out = flash_attention(q, k, v, causal=True, sm_scale=cfg.attn_scale,
+                                   window=w).reshape(B, S, nh * hd)
         attn_out = _linear(attn_out, attn_p["wo"])
         if cfg.use_bias:
             attn_out = attn_out + attn_p["bo"]
         return _finish_layer_cached(x, h, attn_out, layer_params, cfg, k_cache, v_cache)
 
+    cache_T = (k_cache["q8"] if isinstance(k_cache, dict) else k_cache).shape[1]
+    assert not (ring and S > 1 and cache_T < S), (
+        "rolling KV cache: a multi-token segment longer than the ring must "
+        f"take the flash prefill path (S={S}, cache={cache_T}) — a segment "
+        "read through the ring would see its own evictions; the engine "
+        "gates cache sizing on this")
     slopes = _alibi_slopes(nh) if cfg.pos_embedding == "alibi" else None
     attn_out = softmax_context(
         q, k_cache, v_cache, pos, scale=cfg.attn_scale, positions=positions,
-        alibi_slopes=slopes, local_window=window,
+        alibi_slopes=slopes, local_window=window, ring=ring,
     ).reshape(B, S, nh * hd)
     attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
@@ -1189,16 +1231,20 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, posit
 
     layers = _cast_layers(params["layers"], dtype)
 
+    # mirror forward(): a uniform window stays a STATIC int through the
+    # scan (flash band prefill + the rolling cache depend on it); only
+    # per-layer-varying windows ride the scan as traced scalars
+    uniform_w = cfg.uniform_window
+    varying = cfg.varying_windows
     windows = (
         jnp.asarray(cfg.local_attn_windows, jnp.int32)
-        if cfg.local_attn_windows is not None
-        else jnp.zeros((cfg.num_layers,), jnp.int32)
+        if varying else jnp.zeros((cfg.num_layers,), jnp.int32)
     )
 
     def body(carry, inp):
         h = carry
         layer_p, k_c, v_c, win = inp
-        win = win if cfg.local_attn_windows is not None else None
+        win = win if varying else uniform_w
         h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos, window=win)
         return h, (k_c, v_c)
 

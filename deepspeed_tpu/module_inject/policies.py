@@ -199,6 +199,10 @@ class LlamaPolicy(HFPolicy):
             use_bias=False,
             norm_eps=hf_config.rms_norm_eps,
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            # the flash kernel is the TPU-preferred exact attention (bench
+            # self-tune winner) and the gate for the tile-pruned window
+            # band + rolling KV cache below
+            attn_impl="pallas",
             # Mistral: uniform sliding window (HF `sliding_window`) — a
             # static uniform window rides the tile-pruned flash band
             # kernel during training/prefill

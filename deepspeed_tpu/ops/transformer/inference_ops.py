@@ -74,7 +74,18 @@ def dequantize_kv(cache_component, dtype):
     return (cache_component["q8"].astype(jnp.float32) * cache_component["s"]).astype(dtype)
 
 
-def _write_component(cache, new, pos, positions):
+def _write_component(cache, new, pos, positions, ring=False):
+    if ring:
+        # ring-buffer write: slot = absolute position mod cache length.
+        # Stale tokens of an over-long segment (more new tokens than
+        # slots) drop instead of colliding: only the last T positions of
+        # the segment land, later tokens must win.
+        T = cache.shape[1]
+        assert jnp.ndim(pos) == 0, "ring cache writes need the aligned (scalar-pos) path"
+        total = pos + new.shape[1]
+        rows = jnp.arange(new.shape[0], dtype=jnp.int32)[:, None]
+        cols = jnp.where(positions >= total - T, positions % T, T)
+        return cache.at[rows, cols].set(new.astype(cache.dtype), mode="drop")
     if jnp.ndim(pos) == 0:
         return jax.lax.dynamic_update_slice(cache, new.astype(cache.dtype), (0, pos, 0, 0))
     rows = jnp.arange(new.shape[0], dtype=jnp.int32)[:, None]
@@ -83,7 +94,7 @@ def _write_component(cache, new, pos, positions):
 
 
 def update_kv_cache(k_cache, v_cache, k_new, v_new, pos,
-                    positions=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                    positions=None, ring=False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Write S new keys/values into (B, T, H, hd) caches (or int8
     {"q8","s"} cache components — the write quantizes per token/head).
 
@@ -92,19 +103,22 @@ def update_kv_cache(k_cache, v_cache, k_new, v_new, pos,
     speculative-decode verify/draft path writes each row's segment at its
     own depth; out-of-bounds columns (>= T) are dropped, matching the
     clamped read mask in :func:`softmax_context`.
+    ``ring``: rolling-cache mode (sliding-window models) — positions wrap
+    modulo the cache length; requires scalar ``pos`` + ``positions``.
     """
     def write(cache, new):
         if isinstance(cache, dict):
             q, s = quantize_kv(new)
-            return {"q8": _write_component(cache["q8"], q, pos, positions),
-                    "s": _write_component(cache["s"], s, pos, positions)}
-        return _write_component(cache, new, pos, positions)
+            return {"q8": _write_component(cache["q8"], q, pos, positions, ring),
+                    "s": _write_component(cache["s"], s, pos, positions, ring)}
+        return _write_component(cache, new, pos, positions, ring)
 
     return write(k_cache, k_new), write(v_cache, v_new)
 
 
 def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
-                    positions=None, alibi_slopes=None, local_window=None) -> jnp.ndarray:
+                    positions=None, alibi_slopes=None, local_window=None,
+                    ring=False) -> jnp.ndarray:
     """Cached masked attention (softmax_context binding): q (B, S, nh, hd)
     against (B, T, nkv, hd) caches (GQA repeat applied here).
 
@@ -117,8 +131,14 @@ def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
         (speculative decode); same causal rule row-wise.
 
     ``alibi_slopes`` (nh,) adds the ALiBi relative-position bias (BLOOM).
-    ``local_window`` (traced i32 scalar; 0/None = unlimited) restricts each
-    query to the last ``local_window`` key positions (GPT-Neo local layers).
+    ``local_window`` (i32 scalar; 0/None = unlimited) restricts each
+    query to the last ``local_window`` key positions (GPT-Neo local layers,
+    Mistral sliding window).
+    ``ring``: the cache is a rolling buffer — slot s holds the most recent
+    absolute position congruent to s mod T; masking runs over the derived
+    absolute positions (identical to the plain cache while nothing has
+    wrapped). Requires the aligned path (scalar ``pos`` + ``positions``)
+    and a ``local_window`` no larger than the cache.
     """
     B, S, nh, hd = q.shape
     if isinstance(k_cache, dict):  # int8 KV cache: dequant at the read
@@ -132,7 +152,18 @@ def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk).astype(jnp.float32) * scale  # (B,nh,S,T)
     T = kk.shape[1]
-    kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
+    if ring:
+        assert positions is not None and jnp.ndim(pos) == 0, (
+            "ring cache reads need the aligned (scalar-pos + positions) path")
+        assert alibi_slopes is None, "ring cache does not support ALiBi"
+        assert local_window is not None, "ring cache requires a sliding window"
+        # absolute position held by each slot after this segment's write:
+        # the largest a < pos + S with a ≡ slot (mod T); negative = unwritten
+        slot = jnp.arange(T, dtype=jnp.int32)[None, :]
+        total = pos + S
+        kpos = (total - 1) - ((total - 1 - slot) % T)  # (1, T)
+    else:
+        kpos = jnp.arange(T, dtype=jnp.int32)[None, :]  # (1, T)
     if positions is None:
         qpos = None
         mask = (kpos <= pos)[None, None]  # all rows attend the [0..pos] prefix
@@ -151,6 +182,10 @@ def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     if local_window is not None and qpos is not None:
         local_ok = (local_window <= 0) | (kpos > qpos - local_window)
         mask = mask & (local_ok[None, None] if jnp.ndim(pos) == 0 else local_ok[:, None])
+    if ring:
+        # unwritten slots carry a negative derived position; the causal
+        # mask alone would wrongly admit them for early queries
+        mask = mask & (kpos >= 0)[None, None]
     logits = jnp.where(mask, logits, jnp.float32(-1e30))
     probs = fused_softmax(logits).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
