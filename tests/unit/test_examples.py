@@ -21,6 +21,9 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
      # test_speculative / test_hybrid_engine; the subprocess runs pay a
      # full jax import + compile each on the 1-core host
      pytest.param("inference_speculative.py", marks=pytest.mark.slow),
+     # the rolling-cache mechanics are unit-covered fast in
+     # test_rolling_cache; the example pays generate-program compiles
+     pytest.param("serve_mistral_sliding.py", marks=pytest.mark.slow),
      pytest.param("rlhf_hybrid.py", marks=pytest.mark.slow)],
 )
 def test_example_runs(script, tmp_path, monkeypatch):
