@@ -64,6 +64,13 @@ class InferenceConfig:
     # flash prefill available, no speculative decoding); exact — slot
     # positions derive modulo the cache length.
     rolling_kv_cache: bool = True
+    # chunked prefill: stream the prompt through a fixed (B, chunk) prefill
+    # program instead of one program per prompt length. Serving workloads
+    # with varied prompt lengths compile ONE prefill (each distinct length
+    # otherwise pays its own 20-40s remote compile) and prefill peak memory
+    # is bounded by the chunk. Trades the fused single-dispatch generate
+    # for ceil(S/chunk) + per-token dispatches; token streams unchanged.
+    prefill_chunk_size: Optional[int] = None
     # override the model's attention implementation for inference
     # ("xla" | "pallas" | "block_sparse"); None keeps the model config's.
     # Flash ("pallas") is exact and the TPU bench winner — converted

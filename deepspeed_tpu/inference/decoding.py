@@ -134,6 +134,22 @@ def compile_ragged_prefill_fn(mesh, cfg, param_shardings, batch_size: int, cache
     return fn, cache_sh, batch_sh
 
 
+def _segment_decode_tail(segment_fn, params, first_tok, cache, prompt_lens,
+                         n_more: int, temperature: float, top_k: int, rng,
+                         top_p: float):
+    """Per-row-position decode loop shared by the ragged and chunked-prefill
+    generate paths: ``first_tok`` (B,) was already sampled from the prefill
+    logits; emits ``n_more`` further tokens."""
+    out = [first_tok]
+    pos = jnp.asarray(prompt_lens)
+    for _ in range(n_more):
+        rng, sub = jax.random.split(rng)
+        step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos)
+        out.append(select_token(step_logits[:, 0], temperature, top_k, sub, top_p))
+        pos = pos + 1
+    return jnp.stack(out, axis=1)
+
+
 def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_mask,
                        cache, cache_len: int, max_new_tokens: int, temperature: float,
                        top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
@@ -160,14 +176,71 @@ def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_
         logits, jnp.asarray(last_col)[:, None, None], axis=1
     )[:, 0]
     nxt = select_token(last_logits, temperature, top_k, rng, top_p)
-    out = [nxt]
-    pos = jnp.asarray(prompt_lens)
-    for _ in range(max_new_tokens - 1):
-        rng, sub = jax.random.split(rng)
-        step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos)
-        out.append(select_token(step_logits[:, 0], temperature, top_k, sub, top_p))
-        pos = pos + 1
-    return jnp.concatenate([jnp.asarray(tokens), jnp.stack(out, axis=1)], axis=1)
+    gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
+                               max_new_tokens - 1, temperature, top_k, rng, top_p)
+    return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
+
+
+def chunked_generate(ragged_prefill_fn, segment_fn, params, tokens, cache,
+                     cache_len: int, chunk: int, max_new_tokens: int,
+                     temperature: float, top_k: int, rng,
+                     top_p: float = 1.0, attention_mask=None) -> jnp.ndarray:
+    """Generate with CHUNKED prefill: the prompt streams through a fixed
+    (B, chunk) prefill program, so ONE compiled program serves every prompt
+    length (each distinct length otherwise compiles its own prefill — 20-40s
+    per variant through a remote-compile link) and prefill peak memory is
+    bounded by the chunk, not the prompt. The final (padded) chunk drops its
+    pad writes via out-of-range positions; decode then shares the ragged
+    per-row segment tail. Token streams are identical to the unchunked path
+    (same cache contents, same sampling order).
+
+    ``attention_mask`` ((B, S) of 0/1, HF semantics, left or right padding)
+    composes: per-row dense positions come from the mask — the varied-width
+    serving batches that motivate chunking in the first place still reuse
+    the one chunk program.
+    """
+    import numpy as np
+
+    B, S = tokens.shape
+    if max_new_tokens <= 0:
+        return tokens
+    assert chunk >= 1, chunk
+    if attention_mask is None:
+        mask = np.ones((B, S), np.int64)
+    else:
+        mask = np.asarray(attention_mask)
+        assert mask.shape == (B, S), (mask.shape, (B, S))
+        assert (mask.sum(axis=1) > 0).all(), "every row needs at least one real token"
+    prompt_lens = mask.sum(axis=1).astype(np.int32)
+    # dense per-row positions; pads park at cache_len -> writes drop and
+    # their garbage logits are never selected
+    positions_all = np.where(mask > 0, np.cumsum(mask, axis=1) - 1, cache_len).astype(np.int32)
+    last_col_all = np.array([np.nonzero(mask[b])[0][-1] for b in range(B)])
+
+    n_chunks = -(-S // chunk)
+    padded_toks = np.zeros((B, n_chunks * chunk), np.int32)
+    padded_toks[:, :S] = np.asarray(tokens)
+    padded_pos = np.full((B, n_chunks * chunk), cache_len, np.int32)
+    padded_pos[:, :S] = positions_all
+
+    last_logits = None
+    for i in range(n_chunks):
+        lo, hi = i * chunk, (i + 1) * chunk
+        if (padded_pos[:, lo:hi] >= cache_len).all():
+            continue  # all-pad chunk (left padding / width padding)
+        logits, cache = ragged_prefill_fn(
+            params, jnp.asarray(padded_toks[:, lo:hi]),
+            jnp.asarray(padded_pos[:, lo:hi]), cache)
+        # rows whose LAST real token lands in this chunk take their logits
+        in_chunk = (last_col_all >= lo) & (last_col_all < hi)
+        col = jnp.asarray(np.where(in_chunk, last_col_all - lo, 0))
+        picked = jnp.take_along_axis(logits, col[:, None, None], axis=1)[:, 0]
+        sel = jnp.asarray(in_chunk)[:, None]
+        last_logits = picked if last_logits is None else jnp.where(sel, picked, last_logits)
+    nxt = select_token(last_logits, temperature, top_k, rng, top_p)
+    gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
+                               max_new_tokens - 1, temperature, top_k, rng, top_p)
+    return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
 
 
 def _filter_logits(logits, temperature: float, top_k: int, top_p: float):

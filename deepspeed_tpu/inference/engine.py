@@ -289,6 +289,29 @@ class InferenceEngine:
         from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
 
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if self.config.prefill_chunk_size and draft is None \
+                and not self.config.speculative.enabled:
+            # fixed-shape (B, chunk) prefill program for EVERY prompt
+            # length and padded width — including attention_mask batches,
+            # the varied-width serving workload that motivates chunking.
+            # Rides the ragged/segment families (ring-off, full cache).
+            from deepspeed_tpu.inference.decoding import chunked_generate
+
+            max_len = bounded_cache_len(total, self.cfg.max_seq_len,
+                                        self.config.max_out_tokens)
+            prefill_fn, segment_fn, cache_sh = self._ragged_fns_for(B, max_len)
+            cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), cache_sh)
+            t0 = time.time()
+            result = chunked_generate(
+                prefill_fn, segment_fn, self.params, tokens, cache, max_len,
+                self.config.prefill_chunk_size, max_new_tokens, temperature,
+                top_k, rng, top_p, attention_mask=attention_mask)
+            if self.config.profile_model_time:
+                jax.block_until_ready(result)
+                self._model_times.append(time.time() - t0)
+            if eos_token_id is not None:
+                result = self._truncate_eos(result, S, eos_token_id)
+            return result
         if attention_mask is not None:
             if draft is not None or self.config.speculative.enabled:
                 raise NotImplementedError(
