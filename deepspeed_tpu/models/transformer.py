@@ -547,11 +547,7 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
                                       sm_scale=cfg.attn_scale)
     if ((window is None or (static_window is not None and cfg.causal))
             and cfg.attn_impl == "pallas" and cfg.pos_embedding != "alibi"):
-        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-
-        blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
-        return flash_attention(q, k, v, causal=cfg.causal, sm_scale=cfg.attn_scale,
-                               window=static_window, **blk)
+        return _flash_sharded(q, k, v, cfg, causal=cfg.causal, window=static_window)
     if nkv != nh:
         k = jnp.repeat(k, nh // nkv, axis=2)
         v = jnp.repeat(v, nh // nkv, axis=2)
@@ -573,6 +569,61 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
         logits = jnp.where(mask[None, None, :, :], logits, jnp.float32(-1e30))
     probs = fused_softmax(logits).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_sharded(q, k, v, cfg: TransformerConfig, causal: bool, window=None):
+    """Flash-attention call that partitions under tensor parallelism.
+
+    GSPMD cannot partition a ``pallas_call`` custom call: left alone it
+    ALL-GATHERS q/k/v and computes every head replicated on every chip —
+    silently undoing TP for the attention block (measured: 15 all-gathers
+    and full-head operand shapes in the compiled HLO of a TP-2 step).
+    When a mesh with tensor>1 is live and the head counts divide, the
+    kernel runs inside ``shard_map`` instead: each shard computes its own
+    heads (and its own batch shard over data/fsdp). Semantics are
+    preserved in every case — shard_map reshards inputs to the stated
+    specs and back, so a mismatched caller pays a reshard, never a wrong
+    answer."""
+    from jax.sharding import PartitionSpec
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
+    kwargs = dict(causal=causal, sm_scale=cfg.attn_scale, window=window, **blk)
+
+    mesh = None
+    if comm.is_initialized():
+        mesh = comm.get_mesh()
+    tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
+    B, _, nh, _ = q.shape
+    nkv = k.shape[2]
+    if tp <= 1 or nh % tp or nkv % tp:
+        return flash_attention(q, k, v, **kwargs)
+
+    import inspect
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    # batch rides its data-parallel axes only when it divides; heads ride
+    # the tensor axis (this is the qkv projections' output sharding, so
+    # the common case reshards nothing)
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = ()
+    spec = PartitionSpec(batch_axes or None, None, "tensor", None)
+    check_kw = ({"check_vma": False}
+                if "check_vma" in inspect.signature(shard_map).parameters
+                else {"check_rep": False})
+    fn = shard_map(
+        lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **check_kw,
+    )
+    return fn(q, k, v)
 
 
 def _quick_gelu(x):
@@ -1150,11 +1201,9 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
                                        ring=ring)
 
     if use_flash_prefill:
-        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-
         w = window if isinstance(window, int) and window > 0 and window < S else None
-        attn_out = flash_attention(q, k, v, causal=True, sm_scale=cfg.attn_scale,
-                                   window=w).reshape(B, S, nh * hd)
+        attn_out = _flash_sharded(q, k, v, cfg, causal=True,
+                                  window=w).reshape(B, S, nh * hd)
         attn_out = _linear(attn_out, attn_p["wo"])
         if cfg.use_bias:
             attn_out = attn_out + attn_p["bo"]

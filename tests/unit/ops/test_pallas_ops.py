@@ -75,6 +75,47 @@ class TestFlashAttention:
         np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
 
 
+class TestFlashTensorParallel:
+    def test_no_allgather_under_tp(self):
+        """GSPMD cannot partition a pallas_call: without the shard_map
+        wrapper (_flash_sharded) a TP mesh ALL-GATHERS q/k/v and computes
+        every head on every chip. Pin the fixed behavior: zero all-gathers
+        and per-shard operand shapes in the compiled HLO, plus numerical
+        parity with the unsharded path."""
+        import dataclasses
+        import re
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu import comm
+        from deepspeed_tpu.models import transformer as tf
+
+        comm.destroy()
+        mesh = comm.init_distributed(mesh_shape={"data": 2, "tensor": 4},
+                                     verbose=False)
+        # GQA: nkv=4 < nh=8, both dividing tp=4 — the subtle property is
+        # that per-shard query-head-to-KV-head grouping stays aligned
+        cfg = tf.TransformerConfig(vocab_size=64, hidden_size=256, num_layers=1,
+                                   num_heads=8, num_kv_heads=4, max_seq_len=64,
+                                   attn_impl="pallas")
+        B, S, H, hd = 4, 64, 8, 32
+        sh = NamedSharding(mesh, P("data", None, "tensor", None))
+        rs = np.random.RandomState(0)
+        q = jax.device_put(jnp.asarray(rs.randn(B, S, H, hd), jnp.float32), sh)
+        k, v = (jax.device_put(jnp.asarray(rs.randn(B, S, 4, hd), jnp.float32), sh)
+                for _ in range(2))
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        f = jax.jit(lambda a, b, c: tf._attention(a, b, c, cfg, positions),
+                    in_shardings=(sh, sh, sh), out_shardings=sh)
+        txt = f.lower(q, k, v).compile().as_text()
+        assert not re.search(r"all-gather", txt), "flash attention re-gathered under TP"
+        ref = tf._attention(q, k, v,
+                            dataclasses.replace(cfg, attn_impl="xla"), positions)
+        np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        comm.destroy()
+
+
 class TestSlidingWindowFlash:
     """Tile-pruned sliding-window flash path (Mistral-style; the reference's
     SparseSelfAttention local modes, deepspeed/ops/sparse_attention): the
