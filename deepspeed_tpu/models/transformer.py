@@ -543,6 +543,21 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
             v = jnp.repeat(v, nh // nkv, axis=2)
         layout, block = _sparse_layout(cfg.sparse_attention or (("mode", "fixed"),), nh, S)
         # kernel convention matches the model: (B, S, H, hd)
+        info = _tp_head_shard(B, nh, nh)
+        if info is not None:
+            # same GSPMD-unpartitionable story as flash (_head_shard_map):
+            # heads and their layout rows shard over 'tensor'
+            from jax.sharding import PartitionSpec
+
+            mesh, spec = info
+            lspec = PartitionSpec("tensor", None, None)
+            fn = _head_shard_map(
+                mesh,
+                lambda q_, k_, v_, l_: block_sparse_attention(
+                    q_, k_, v_, l_, causal=cfg.causal, block=block,
+                    sm_scale=cfg.attn_scale),
+                (spec, spec, spec, lspec), spec)
+            return fn(q, k, v, jnp.asarray(layout))
         return block_sparse_attention(q, k, v, layout, causal=cfg.causal, block=block,
                                       sm_scale=cfg.attn_scale)
     if ((window is None or (static_window is not None and cfg.causal))
@@ -571,36 +586,37 @@ def _attention(q, k, v, cfg: TransformerConfig, segment_positions, window=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_sharded(q, k, v, cfg: TransformerConfig, causal: bool, window=None):
-    """Flash-attention call that partitions under tensor parallelism.
-
-    GSPMD cannot partition a ``pallas_call`` custom call: left alone it
-    ALL-GATHERS q/k/v and computes every head replicated on every chip —
-    silently undoing TP for the attention block (measured: 15 all-gathers
-    and full-head operand shapes in the compiled HLO of a TP-2 step).
-    When a mesh with tensor>1 is live and the head counts divide, the
-    kernel runs inside ``shard_map`` instead: each shard computes its own
-    heads (and its own batch shard over data/fsdp). Semantics are
-    preserved in every case — shard_map reshards inputs to the stated
-    specs and back, so a mismatched caller pays a reshard, never a wrong
-    answer."""
+def _tp_head_shard(B, nh, nkv):
+    """(mesh, qkv_spec) when a live mesh has tensor>1 and the head counts
+    divide it — the precondition for running a Pallas attention kernel
+    per-shard under shard_map; None otherwise. The spec shards (B, S, H,
+    hd): heads over 'tensor' (the qkv projections' output sharding, so the
+    common case reshards nothing), batch over its data-parallel axes when
+    it divides them."""
     from jax.sharding import PartitionSpec
 
     from deepspeed_tpu import comm
-    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-    blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
-    kwargs = dict(causal=causal, sm_scale=cfg.attn_scale, window=window, **blk)
-
-    mesh = None
-    if comm.is_initialized():
-        mesh = comm.get_mesh()
-    tp = mesh.shape.get("tensor", 1) if mesh is not None else 1
-    B, _, nh, _ = q.shape
-    nkv = k.shape[2]
+    if not comm.is_initialized():
+        return None
+    mesh = comm.get_mesh()
+    tp = mesh.shape.get("tensor", 1)
     if tp <= 1 or nh % tp or nkv % tp:
-        return flash_attention(q, k, v, **kwargs)
+        return None
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
+        batch_axes = ()
+    return mesh, PartitionSpec(batch_axes or None, None, "tensor", None)
 
+
+def _head_shard_map(mesh, fn, in_specs, out_spec):
+    """shard_map wrapper for Pallas attention kernels (GSPMD cannot
+    partition a pallas_call custom call: left alone it ALL-GATHERS the
+    operands and computes every head replicated on every chip — measured
+    as 15 all-gathers and full-head operand shapes in a TP-2 step's HLO).
+    Semantics are preserved for every caller — shard_map reshards inputs
+    to the stated specs and back, so a mismatched sharding pays a
+    reshard, never a wrong answer."""
     import inspect
 
     try:
@@ -608,21 +624,28 @@ def _flash_sharded(q, k, v, cfg: TransformerConfig, causal: bool, window=None):
     except ImportError:
         from jax.experimental.shard_map import shard_map
 
-    # batch rides its data-parallel axes only when it divides; heads ride
-    # the tensor axis (this is the qkv projections' output sharding, so
-    # the common case reshards nothing)
-    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
-    if batch_axes and B % math.prod(mesh.shape[a] for a in batch_axes):
-        batch_axes = ()
-    spec = PartitionSpec(batch_axes or None, None, "tensor", None)
     check_kw = ({"check_vma": False}
                 if "check_vma" in inspect.signature(shard_map).parameters
                 else {"check_rep": False})
-    fn = shard_map(
-        lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        **check_kw,
-    )
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_spec,
+                     **check_kw)
+
+
+def _flash_sharded(q, k, v, cfg: TransformerConfig, causal: bool, window=None):
+    """Flash attention, partitioned under tensor parallelism when a mesh
+    is live (see _head_shard_map)."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    blk = {"block_q": cfg.flash_block, "block_k": cfg.flash_block} if cfg.flash_block else {}
+    kwargs = dict(causal=causal, sm_scale=cfg.attn_scale, window=window, **blk)
+
+    info = _tp_head_shard(q.shape[0], q.shape[2], k.shape[2])
+    if info is None:
+        return flash_attention(q, k, v, **kwargs)
+    mesh, spec = info
+    fn = _head_shard_map(
+        mesh, lambda q_, k_, v_: flash_attention(q_, k_, v_, **kwargs),
+        (spec, spec, spec), spec)
     return fn(q, k, v)
 
 
