@@ -146,19 +146,33 @@ def sequence_parallel_attention(
         return _full_causal_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     S = q.shape[1]
     assert S % n == 0, f"seq len {S} must divide over {n} sequence shards"
+    # combined sequence x tensor meshes: the ring and the xla Ulysses local
+    # step are jnp einsums GSPMD partitions over 'tensor' on its own, but a
+    # pallas_call is GSPMD-unpartitionable (it would all-gather and compute
+    # every head replicated — see models/transformer._head_shard_map). When
+    # Ulysses runs the flash kernel and a tensor axis is live, take that
+    # axis manual too: heads shard over 'tensor' AND redistribute over
+    # 'sequence' via the all-to-all, so each device runs H/(n*tp) heads.
+    manual_axes = {seq_axis}
+    head_axis = None
+    tp = mesh.shape.get("tensor", 1)
     if impl == "ulysses":
         assert q.shape[2] % n == 0, f"num_heads {q.shape[2]} must divide over {n} for Ulysses"
         attn_fn = None
         if attn_impl == "pallas":
             from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-            attn_fn = partial(flash_attention, causal=causal, sm_scale=sm_scale, vma=(seq_axis,))
+            if tp > 1 and q.shape[2] % (n * tp) == 0 and k.shape[2] % tp == 0:
+                manual_axes = {seq_axis, "tensor"}
+                head_axis = "tensor"
+            attn_fn = partial(flash_attention, causal=causal, sm_scale=sm_scale,
+                              vma=tuple(sorted(manual_axes)))
         local = partial(ulysses_attention, causal=causal, axis_name=seq_axis, attn_fn=attn_fn,
                         sm_scale=sm_scale)
     elif impl == "ring":
         local = partial(ring_attention, causal=causal, axis_name=seq_axis, sm_scale=sm_scale)
     else:
         raise ValueError(f"unknown sequence-parallel impl '{impl}' (ring | ulysses)")
-    spec = PartitionSpec(None, seq_axis, None, None)
-    fn = jax.shard_map(local, mesh=mesh, axis_names={seq_axis}, in_specs=(spec, spec, spec), out_specs=spec)
+    spec = PartitionSpec(None, seq_axis, head_axis, None)
+    fn = jax.shard_map(local, mesh=mesh, axis_names=manual_axes, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

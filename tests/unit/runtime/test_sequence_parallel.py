@@ -48,6 +48,35 @@ class TestSequenceParallelAttention:
         )(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
+    def test_ulysses_flash_with_tensor_axis(self):
+        """Combined sequence x tensor mesh: the Ulysses flash path takes the
+        tensor axis manual too (each device runs H/(n*tp) heads; a GSPMD-
+        managed pallas_call would all-gather and replicate every head).
+        Parity + all-gather-free HLO."""
+        import re
+
+        comm.destroy()
+        mesh = comm.init_distributed(
+            mesh_shape={"data": 2, "sequence": 2, "tensor": 2}, verbose=False)
+        q, k, v = _mk_qkv(S=128, H=8, hd=8)
+        ref = _full_causal_attention(q, k, v)
+        f = jax.jit(lambda q, k, v: sequence_parallel_attention(
+            q, k, v, impl="ulysses", mesh=mesh, attn_impl="pallas"))
+        txt = f.lower(q, k, v).compile().as_text()
+        assert not re.search(r"all-gather", txt), "flash re-gathered under seq x tp"
+        np.testing.assert_allclose(np.asarray(f(q, k, v)), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        # GQA: the trickiest math is the local repeat of a TENSOR-sharded
+        # KV head slice (local q head j -> global kv head i*nkv/tp + j//rep)
+        q, k, v = _mk_qkv(S=128, H=8, hd=8, nkv=4, seed=1)
+        ref = _full_causal_attention(q, jnp.repeat(k, 2, axis=2),
+                                     jnp.repeat(v, 2, axis=2))
+        out = jax.jit(lambda q, k, v: sequence_parallel_attention(
+            q, k, v, impl="ulysses", mesh=mesh, attn_impl="pallas"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        comm.destroy()
+
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_gqa(self, seq_mesh, impl):
         q, k, v = _mk_qkv(H=8, nkv=2)
