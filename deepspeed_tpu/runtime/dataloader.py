@@ -20,13 +20,21 @@ def _stack(items):
 class TpuDataLoader:
     """Wraps an indexable or iterable dataset into global-batch numpy dicts."""
 
-    def __init__(self, dataset, batch_size: int, collate_fn=None, seed: int = 0, shuffle: bool = True, drop_last: bool = True):
+    def __init__(self, dataset, batch_size: int, collate_fn=None, seed: int = 0,
+                 shuffle: bool = True, drop_last: bool = True,
+                 process_shard=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or _stack
         self.seed = seed
         self.shuffle = shuffle
         self.drop_last = drop_last
+        # multi-controller striding: None = auto (stride when the batch
+        # divides the process count). The engine passes False when the
+        # data-parallel degree does not span the processes (dp % nprocs
+        # != 0, e.g. pure TP across hosts) — there every process must
+        # feed the SAME full global batch, never a slice.
+        self.process_shard = process_shard
         self.epoch = 0
         try:
             self._len = len(dataset)
@@ -53,10 +61,12 @@ class TpuDataLoader:
             order = np.random.RandomState(self.seed + self.epoch).permutation(n)
         # process-level slice for multi-host: contiguous stride partitioning
         pcount, pidx = jax.process_count(), jax.process_index()
-        per_proc = self.batch_size // pcount if self.batch_size % pcount == 0 else self.batch_size
+        shard = (self.process_shard if self.process_shard is not None
+                 else self.batch_size % pcount == 0)
+        per_proc = self.batch_size // pcount if shard and self.batch_size % pcount == 0 else self.batch_size
         for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
             idx = order[start : start + self.batch_size]
-            if pcount > 1 and self.batch_size % pcount == 0:
+            if pcount > 1 and shard and self.batch_size % pcount == 0:
                 idx = idx[pidx * per_proc : (pidx + 1) * per_proc]
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 

@@ -850,6 +850,10 @@ class TpuEngine:
             batch_size=batch_size or self.train_micro_batch_size_per_gpu * comm.dp_world_size(),
             collate_fn=collate_fn,
             seed=self.config.seed,
+            # pure-TP/pipe process spans (dp not dividing the processes)
+            # feed the SAME global batch everywhere; _shard_batch then
+            # assembles per-device from the sharding's index map
+            process_shard=comm.dp_world_size() % jax.process_count() == 0,
         )
 
     def _shard_batch(self, batch):
@@ -890,22 +894,37 @@ class TpuEngine:
             if bdim is None:  # replicated leaf: full copy on every process
                 return jax.make_array_from_process_local_data(sh, x)
             rows = x.shape[bdim]
-            if expected_rows % nprocs == 0 and rows == expected_rows // nprocs:
-                pass  # striding-loader local slice
-            elif rows % nprocs == 0:
+            dp = comm.dp_world_size()
+            if (dp % nprocs == 0 and expected_rows % nprocs == 0
+                    and rows == expected_rows // nprocs):
+                # striding-loader local slice (only meaningful when the
+                # data axes actually split across processes)
+                return jax.make_array_from_process_local_data(sh, x)
+            if rows == expected_rows:
+                # full global feed, identical on every process: assemble
+                # per-device from the sharding's own index map — correct
+                # for ANY mesh layout (tensor/pipe axes spanning the
+                # process boundary, batch blocks replicated across process
+                # groups, pipe-major device orders, ...)
+                gshape = x.shape
+                idx_map = sh.addressable_devices_indices_map(gshape)
+                arrs = [jax.device_put(np.ascontiguousarray(x[idx]), d)
+                        for d, idx in idx_map.items()]
+                return jax.make_array_from_single_device_arrays(gshape, sh, arrs)
+            if dp % nprocs == 0 and rows % nprocs == 0:
                 per = rows // nprocs
                 sl = [slice(None)] * x.ndim
                 sl[bdim] = slice(jax.process_index() * per,
                                  (jax.process_index() + 1) * per)
                 x = x[tuple(sl)]
-            else:
-                raise ValueError(
-                    f"multi-controller batch leaf has {rows} rows on dim "
-                    f"{bdim}: expected the process-local "
-                    f"{expected_rows // max(nprocs, 1)} rows (striding "
-                    f"dataloader) or a global copy divisible by "
-                    f"process_count={nprocs}")
-            return jax.make_array_from_process_local_data(sh, x)
+                return jax.make_array_from_process_local_data(sh, x)
+            raise ValueError(
+                f"multi-controller batch leaf has {rows} rows on dim "
+                f"{bdim}: expected the global batch of {expected_rows} "
+                f"rows (identical on every process)"
+                + (f" or the process-local {expected_rows // nprocs} rows "
+                   f"from the striding dataloader"
+                   if dp % nprocs == 0 and expected_rows % nprocs == 0 else ""))
 
         return jax.tree.map(put, batch)
 

@@ -18,8 +18,17 @@ import json
 import os
 import sys
 
-MESH = {"data": 2, "fsdp": 4}
-GLOBAL_BS = 8
+# DSTPU_TEST_MESH selects the parallelism under test: the default exercises
+# cross-process DATA-parallel collectives; {"tensor": 8} exercises
+# cross-process TENSOR-parallel collectives (matmul partial-sum psums over
+# the process boundary) with a replicated batch both processes must feed
+# identically (dataloader dp=1 path).
+MESH = json.loads(os.environ.get("DSTPU_TEST_MESH", '{"data": 2, "fsdp": 4}'))
+DP = MESH.get("data", 1) * MESH.get("fsdp", 1)
+MICRO_BS = 2  # >1 so the tensor mesh hits batch%nprocs==0 with dp=1 — the
+#               loader must NOT stride there (the engine passes
+#               process_shard=False); regression for a silent wrong-data bug
+GLOBAL_BS = MICRO_BS * DP
 SEQ = 16
 VOCAB = 64
 STEPS = 2
@@ -43,12 +52,13 @@ def build_engine():
 
     from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
 
+    # heads/dims divide every mesh under test (tensor up to 8)
     cfg = TransformerConfig(
-        vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=2,
+        vocab_size=VOCAB, hidden_size=64, num_layers=2, num_heads=8,
         max_seq_len=SEQ, dtype="float32",
     )
     config = {
-        "train_micro_batch_size_per_gpu": 1,
+        "train_micro_batch_size_per_gpu": MICRO_BS,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
         "zero_optimization": {"stage": 2},

@@ -44,7 +44,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _worker_env(port: int, pid: int) -> dict:
+def _worker_env(port: int, pid: int, mesh_json=None) -> dict:
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # parent may force a device count
     env["PALLAS_AXON_POOL_IPS"] = ""  # never touch the TPU relay
@@ -54,15 +54,25 @@ def _worker_env(port: int, pid: int) -> dict:
     env["DSTPU_COORDINATOR"] = f"127.0.0.1:{port}"
     env["DSTPU_NUM_PROCESSES"] = "2"
     env["DSTPU_PROCESS_ID"] = str(pid)
+    if mesh_json:
+        env["DSTPU_TEST_MESH"] = mesh_json
     return env
 
 
 class TestTwoProcessDistributed:
-    def test_train_save_load_parity(self, tmp_path):
+    # default mesh: cross-process DATA-parallel collectives + per-process
+    # batch striding. {"tensor": 8}: TP spans the process boundary (matmul
+    # partial-sum psums over "DCN") with a replicated dp=1 batch both
+    # processes must feed identically.
+    @pytest.mark.parametrize("mesh_json", [None, '{"tensor": 8}'],
+                             ids=["data-fsdp", "tensor-spanning"])
+    def test_train_save_load_parity(self, tmp_path, mesh_json, monkeypatch):
         # --- single-process 8-device reference on the same data/config ---
         from deepspeed_tpu import comm
 
         comm.destroy()
+        if mesh_json:
+            monkeypatch.setenv("DSTPU_TEST_MESH", mesh_json)
         w = _load_worker_module()
         engine, _, loader, _ = w.build_engine()
         ref_losses = []
@@ -83,7 +93,7 @@ class TestTwoProcessDistributed:
         procs = [
             subprocess.Popen(
                 [sys.executable, os.path.join(HERE, "mp_worker.py"), outs[i], ckpt],
-                env=_worker_env(port, i),
+                env=_worker_env(port, i, mesh_json),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             )
             for i in range(2)
