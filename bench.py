@@ -40,8 +40,10 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 _LASTGOOD = os.path.join(_ROOT, ".bench_lastgood.json")
 _SENTINEL = "DSTPU_RESULT "
 
-SECONDARIES = ("decode", "long_ctx", "bert_mlm", "moe_ep", "hybrid_rlhf",
-               "zero3_offload")
+# ordered by round priority: a relay window is ~35 min, so the chronically
+# missing numbers (decode post-fix, zero3) run before the already-fresh ones
+SECONDARIES = ("decode", "zero3_offload", "long_ctx", "serving", "bert_mlm",
+               "moe_ep", "hybrid_rlhf")
 
 
 def _load_lastgood():
