@@ -1,0 +1,149 @@
+"""``dstpu_prewarm`` — precompile a serving program set into the persistent
+XLA compile cache, so servers cold-start warm.
+
+On TPU every distinct compiled program costs tens of seconds (20-40 s each
+through a remote-compile link); a serving stack touches several per
+configuration: the fused generate (per prompt-length/new-tokens combo), or
+the chunked-prefill + per-token decode pair, plus the continuous engine's
+per-bucket prefill/insert and burst programs. Run this once per model
+configuration with ``JAX_COMPILATION_CACHE_DIR`` pointing at a shared
+directory (the engine honours ``jax_compilation_cache_dir`` config too) and
+every later process reuses the executables.
+
+The reference has no analogue (CUDA kernels load from prebuilt .so); this
+is the XLA-world equivalent of shipping compiled kernels.
+
+Usage:
+  dstpu_prewarm --preset gpt2-125m --batch 8 --prompt 128 --new 128 \\
+                --cache-dir /path/to/xla_cache [--chunk 128] \\
+                [--continuous --slots 8 --cache-len 512 --burst 4] \\
+                [--dtype bfloat16] [--kv-int8]
+"""
+
+import argparse
+import sys
+import time
+
+
+def _parse_value(val: str):
+    """KEY=VALUE override values: int, float, bool, None, or string."""
+    low = val.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    for cast in (int, float):
+        try:
+            return cast(val)
+        except ValueError:
+            continue
+    return val
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="precompile serving programs into the persistent XLA cache")
+    p.add_argument("--preset", default="gpt2-125m",
+                   help="model preset name (models/transformer.py PRESETS)")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt", type=int, default=128,
+                   help="prompt length to compile for (fused generate is "
+                        "shape-specialized; pass several runs for several "
+                        "lengths, or --chunk for length-agnostic prefill)")
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--chunk", type=int, default=0,
+                   help="also warm the chunked-prefill program set")
+    p.add_argument("--continuous", action="store_true",
+                   help="warm the continuous-batching pool programs")
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--cache-len", type=int, default=512)
+    p.add_argument("--burst", type=int, default=1)
+    p.add_argument("--cache-dir", default=None,
+                   help="persistent XLA cache dir (defaults to jax config / "
+                        "JAX_COMPILATION_CACHE_DIR)")
+    p.add_argument("--override", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="TransformerConfig field override (repeatable), e.g. "
+                        "--override num_layers=2 to prewarm a truncated "
+                        "model while debugging a serving config")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.cache_dir:
+        jax.config.update("jax_compilation_cache_dir", args.cache_dir)
+        # persist EVERYTHING: skipping fast-compiling programs would defeat
+        # the tool (the server would still pay those compiles)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:  # an already-initialized cache instance ignores config updates
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    overrides = {}
+    for item in args.override:
+        key, sep, val = item.partition("=")
+        assert sep and val, f"--override needs KEY=VALUE, got {item!r}"
+        overrides[key] = _parse_value(val)
+    model = TransformerModel.from_preset(args.preset, dtype=args.dtype, **overrides)
+    cfg = {"dtype": args.dtype}
+    if args.kv_int8:
+        cfg["kv_cache_dtype"] = "int8"
+    rs = np.random.RandomState(0)
+
+    def tick(name, fn):
+        t0 = time.time()
+        fn()
+        print(f"prewarm: {name} ready in {time.time() - t0:.1f}s", flush=True)
+
+    toks = rs.randint(0, model.cfg.vocab_size,
+                      (args.batch, args.prompt)).astype(np.int32)
+    # one param init shared by every engine: a second engine would
+    # re-initialize AND hold another full on-device copy (3x HBM at 7B)
+    params = model.init(jax.random.PRNGKey(0))
+    eng = deepspeed_tpu.init_inference(model, params=params, config=dict(cfg))
+    tick(f"fused generate (B={args.batch}, S={args.prompt}, new={args.new})",
+         lambda: np.asarray(eng.generate(toks, max_new_tokens=args.new)))
+
+    if args.chunk:
+        eng_c = deepspeed_tpu.init_inference(
+            model, params=params, config=dict(cfg, prefill_chunk_size=args.chunk))
+        tick(f"chunked prefill (chunk={args.chunk}) + per-token decode",
+             lambda: np.asarray(eng_c.generate(toks, max_new_tokens=2)))
+
+    if args.continuous:
+        from deepspeed_tpu.inference import ContinuousBatchingEngine
+
+        serve = ContinuousBatchingEngine(
+            model, params=params, config=dict(cfg), max_slots=args.slots,
+            cache_len=args.cache_len, tokens_per_tick=args.burst)
+
+        def run_pool():
+            pool_new = min(args.new, 8)
+            plen = min(args.prompt, args.cache_len - pool_new)
+            assert plen >= 1, (
+                f"--cache-len {args.cache_len} leaves no room for a prompt "
+                f"(warming {pool_new} tokens)")
+            serve.submit(toks[0, :plen], max_new_tokens=pool_new)
+            while serve.has_work():
+                serve.step()
+            serve.finished()
+
+        tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
+             f"burst={args.burst})", run_pool)
+    print("prewarm: done — executables persisted to the XLA compile cache",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
