@@ -768,7 +768,7 @@ def _save_winner(device_kind, attn, remat, bs, block=None):
 
 def bench_gpt2_train():
     """Headline bench, SELF-TUNING: unless DSTPU_BENCH_ATTN pins a config,
-    briefly probe ≤5 candidate attention/remat/micro-batch configs (PERF.md
+    briefly probe ≤6 candidate attention/remat/micro-batch configs (PERF.md
     sweep: attention softmax HBM traffic + the dots_saveable remat stash are
     the two dominant costs; the Pallas flash kernel removes both) and run
     the full measurement on the winner. The winner is cached per device
@@ -794,6 +794,11 @@ def bench_gpt2_train():
         ("pallas", False, 8, None),   # flash frees the logits stash: no-remat may fit
         ("pallas", False, 8, 256),
         ("pallas", False, 16, None),
+        # bs16 at auto tile (512) died in the remote compile helper (HTTP
+        # 500 exit 1 = compile-side OOM, r5 window 2); smaller tiles
+        # shrink Mosaic's compile footprint — the bs-16 MXU win is the
+        # projected path past 35% MFU, worth a second candidate
+        ("pallas", False, 16, 256),
         ("pallas", False, 32, None),  # biggest per-core tiles (MXU efficiency)
     ]
     if pinned_attn or pinned_remat or _SMOKE:
