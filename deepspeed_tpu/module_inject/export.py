@@ -1,0 +1,114 @@
+"""Export trained parameters BACK to HF state-dict format — the inverse of
+the injection policies' HF->ours mapping (policies.py), so a model trained
+on TPU can be published/served as a standard HF checkpoint.
+
+Reference parity note: v0.9.1 converts HF checkpoints IN (module_inject/
+load_checkpoint.py) and exports its own ZeRO formats; the HF round trip is
+the TPU-stack equivalent of handing a trained model to the torch ecosystem.
+
+Supported families mirror the flagship import policies: GPT-2 and
+Llama/Mistral. Round-trip tested (convert -> export -> strict
+load_state_dict -> logits parity)."""
+
+from typing import Dict
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def export_hf_state_dict(params: Dict, cfg: TransformerConfig,
+                         architecture: str) -> Dict[str, np.ndarray]:
+    """params: the model tree (engine.params / InferenceEngine.params —
+    layers stacked (L, ...)); returns {hf_param_name: np.ndarray} matching
+    the given architecture family ("gpt2" | "llama" | "mistral")."""
+    arch = architecture.lower()
+    if arch in ("gpt2", "gpt2lmheadmodel"):
+        return _export_gpt2(params, cfg)
+    if arch in ("llama", "llamaforcausallm", "mistral", "mistralforcausallm"):
+        return _export_llama(params, cfg)
+    raise NotImplementedError(
+        f"HF export supports gpt2 and llama/mistral; got {architecture!r}")
+
+
+def _export_gpt2(params: Dict, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    L = cfg.num_layers
+    layers = params["layers"]
+    out = {
+        "transformer.wte.weight": _np(params["embed"]["tok"]),
+        "transformer.wpe.weight": _np(params["embed"]["pos"]),
+        "transformer.ln_f.weight": _np(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": _np(params["final_norm"]["bias"]),
+        # tied head: HF GPT2LMHeadModel's state_dict carries the shared
+        # tensor under both names
+        "lm_head.weight": _np(params["embed"]["tok"]),
+    }
+    attn, mlp = layers["attn"], layers["mlp"]
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        # Conv1D stores (in, out) = our orientation; qkv re-concatenate
+        out[p + "attn.c_attn.weight"] = np.concatenate(
+            [_np(attn["wq"][i]), _np(attn["wk"][i]), _np(attn["wv"][i])], axis=1)
+        out[p + "attn.c_attn.bias"] = np.concatenate(
+            [_np(attn["bq"][i]), _np(attn["bk"][i]), _np(attn["bv"][i])])
+        out[p + "attn.c_proj.weight"] = _np(attn["wo"][i])
+        out[p + "attn.c_proj.bias"] = _np(attn["bo"][i])
+        out[p + "mlp.c_fc.weight"] = _np(mlp["wi"][i])
+        out[p + "mlp.c_fc.bias"] = _np(mlp["bi"][i])
+        out[p + "mlp.c_proj.weight"] = _np(mlp["wo"][i])
+        out[p + "mlp.c_proj.bias"] = _np(mlp["bo"][i])
+        out[p + "ln_1.weight"] = _np(layers["ln1"]["scale"][i])
+        out[p + "ln_1.bias"] = _np(layers["ln1"]["bias"][i])
+        out[p + "ln_2.weight"] = _np(layers["ln2"]["scale"][i])
+        out[p + "ln_2.bias"] = _np(layers["ln2"]["bias"][i])
+    return out
+
+
+def _export_llama(params: Dict, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    L = cfg.num_layers
+    layers = params["layers"]
+    out = {
+        "model.embed_tokens.weight": _np(params["embed"]["tok"]),
+        "model.norm.weight": _np(params["final_norm"]["scale"]),
+    }
+    if cfg.tie_embeddings:
+        out["lm_head.weight"] = _np(params["embed"]["tok"])
+    else:
+        out["lm_head.weight"] = _np(params["lm_head"]["w"]).T
+    attn, mlp = layers["attn"], layers["mlp"]
+    for i in range(L):
+        p = f"model.layers.{i}."
+        # torch Linear stores (out, in); ours is (in, out)
+        out[p + "self_attn.q_proj.weight"] = _np(attn["wq"][i]).T
+        out[p + "self_attn.k_proj.weight"] = _np(attn["wk"][i]).T
+        out[p + "self_attn.v_proj.weight"] = _np(attn["wv"][i]).T
+        out[p + "self_attn.o_proj.weight"] = _np(attn["wo"][i]).T
+        out[p + "mlp.gate_proj.weight"] = _np(mlp["wg"][i]).T
+        out[p + "mlp.up_proj.weight"] = _np(mlp["wi"][i]).T
+        out[p + "mlp.down_proj.weight"] = _np(mlp["wo"][i]).T
+        out[p + "input_layernorm.weight"] = _np(layers["ln1"]["scale"][i])
+        out[p + "post_attention_layernorm.weight"] = _np(layers["ln2"]["scale"][i])
+    return out
+
+
+def save_hf_checkpoint(save_dir: str, params: Dict, cfg: TransformerConfig,
+                       architecture: str, hf_config=None) -> str:
+    """Write an HF-loadable checkpoint directory: pytorch_model.bin (torch
+    state dict, float32) plus config.json when an HF config object is
+    given. Returns the state-dict path."""
+    import os
+
+    import torch
+
+    os.makedirs(save_dir, exist_ok=True)
+    state = {k: torch.from_numpy(np.ascontiguousarray(v.astype(np.float32)))
+             for k, v in export_hf_state_dict(params, cfg, architecture).items()}
+    path = os.path.join(save_dir, "pytorch_model.bin")
+    torch.save(state, path)
+    if hf_config is not None:
+        hf_config.save_pretrained(save_dir)
+    return path
