@@ -241,6 +241,17 @@ def get_comms_logger():
     return _STATE.comms_logger
 
 
+def ensure_comms_logger():
+    """Return the global CommsLogger, creating it if init_distributed ran
+    without ``enable_comms_logging`` — the telemetry layer needs the volume
+    counters regardless of how the mesh was brought up."""
+    if _STATE.comms_logger is None:
+        from deepspeed_tpu.comm.comms_logging import CommsLogger
+
+        _STATE.comms_logger = CommsLogger()
+    return _STATE.comms_logger
+
+
 GroupLike = Union[None, str, Sequence[str]]
 
 

@@ -49,6 +49,14 @@ class CommsLogger:
             out[op] = {"count": count, "total_bytes": total, "total_human": convert_size(total)}
         return out
 
+    def totals(self) -> dict:
+        """{op_name: cumulative bytes} — the telemetry layer diffs
+        successive snapshots for per-step comm-volume deltas."""
+        return {
+            op: sum(size * count for size, count in sizes.items())
+            for op, sizes in self.comms_dict.items()
+        }
+
     def log_all(self):
         from deepspeed_tpu.utils.logging import logger
 

@@ -6,6 +6,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 from deepspeed_tpu.runtime.config_utils import from_dict
+from deepspeed_tpu.telemetry.config import TelemetryConfig
 
 
 @dataclass
@@ -48,6 +49,9 @@ class InferenceConfig:
     moe: MoEInferenceConfig = field(default_factory=MoEInferenceConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
     speculative: SpeculativeConfig = field(default_factory=SpeculativeConfig)
+    # structured request traces + latency metrics (docs/telemetry.md);
+    # default off — generate() behavior is unchanged when disabled
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     max_out_tokens: int = 1024
     min_out_tokens: int = 1
     # fuse the whole generation (prefill + lax.scan over decode steps) into
@@ -112,14 +116,21 @@ class InferenceConfig:
         spec = config.get("speculative", {})
         if isinstance(spec, bool):
             spec = {"enabled": spec}
+        telemetry = config.get("telemetry", {})
+        if isinstance(telemetry, bool):
+            telemetry = {"enabled": telemetry}
+        if isinstance(telemetry, TelemetryConfig):
+            telemetry = dict(telemetry.__dict__)
         known = {f for f in cls.__dataclass_fields__}
         base = {k: v for k, v in config.items()
-                if k in known and k not in ("tensor_parallel", "moe", "quant", "speculative", "dtype")}
+                if k in known and k not in ("tensor_parallel", "moe", "quant", "speculative",
+                                            "telemetry", "dtype")}
         return cls(
             dtype=dtype,
             tensor_parallel=from_dict(TensorParallelConfig, tp if isinstance(tp, dict) else {}),
             moe=from_dict(MoEInferenceConfig, moe),
             quant=from_dict(QuantConfig, quant),
             speculative=from_dict(SpeculativeConfig, spec),
+            telemetry=from_dict(TelemetryConfig, telemetry),
             **base,
         )

@@ -6,11 +6,22 @@ Single home for the compile-and-sample logic used by BOTH the standalone
 jits, same sampling loop, so fixes propagate to both surfaces.
 """
 
+import time
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
+
+
+def _mark_first_token(timings: Optional[dict], token):
+    """TTFT hook: when the caller passes a ``timings`` dict (telemetry
+    enabled), block on the first sampled token and stamp its wall-clock.
+    ``None`` (the default everywhere) keeps the async dispatch pipeline
+    untouched."""
+    if timings is not None:
+        jax.block_until_ready(token)
+        timings["first_token_s"] = time.time()
 
 
 def _decode_shardings(mesh, cfg, batch_size: int):
@@ -152,7 +163,8 @@ def _segment_decode_tail(segment_fn, params, first_tok, cache, prompt_lens,
 
 def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_mask,
                        cache, cache_len: int, max_new_tokens: int, temperature: float,
-                       top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
+                       top_k: int, rng, top_p: float = 1.0,
+                       timings: Optional[dict] = None) -> jnp.ndarray:
     """Generate over a PADDED prompt batch (HF attention_mask semantics,
     left or right padding): prefill once with per-row dense positions, then
     per-row-position decode. Returns (B, S + max_new_tokens) — the prompt
@@ -176,6 +188,7 @@ def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_
         logits, jnp.asarray(last_col)[:, None, None], axis=1
     )[:, 0]
     nxt = select_token(last_logits, temperature, top_k, rng, top_p)
+    _mark_first_token(timings, nxt)
     gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
                                max_new_tokens - 1, temperature, top_k, rng, top_p)
     return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
@@ -184,7 +197,8 @@ def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_
 def chunked_generate(ragged_prefill_fn, segment_fn, params, tokens, cache,
                      cache_len: int, chunk: int, max_new_tokens: int,
                      temperature: float, top_k: int, rng,
-                     top_p: float = 1.0, attention_mask=None) -> jnp.ndarray:
+                     top_p: float = 1.0, attention_mask=None,
+                     timings: Optional[dict] = None) -> jnp.ndarray:
     """Generate with CHUNKED prefill: the prompt streams through a fixed
     (B, chunk) prefill program, so ONE compiled program serves every prompt
     length (each distinct length otherwise compiles its own prefill — 20-40s
@@ -238,6 +252,7 @@ def chunked_generate(ragged_prefill_fn, segment_fn, params, tokens, cache,
         sel = jnp.asarray(in_chunk)[:, None]
         last_logits = picked if last_logits is None else jnp.where(sel, picked, last_logits)
     nxt = select_token(last_logits, temperature, top_k, rng, top_p)
+    _mark_first_token(timings, nxt)
     gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
                                max_new_tokens - 1, temperature, top_k, rng, top_p)
     return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
@@ -278,13 +293,15 @@ def select_token(logits, temperature: float, top_k: int, rng, top_p: float = 1.0
 
 
 def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: int,
-                temperature: float, top_k: int, rng, top_p: float = 1.0) -> jnp.ndarray:
+                temperature: float, top_k: int, rng, top_p: float = 1.0,
+                timings: Optional[dict] = None) -> jnp.ndarray:
     """Prefill + token-by-token decode; returns (B, S + max_new_tokens)."""
     if max_new_tokens <= 0:
         return tokens
     S = tokens.shape[1]
     logits, cache = prefill_fn(params, tokens, cache)
     last = select_token(logits[:, -1], temperature, top_k, rng, top_p)
+    _mark_first_token(timings, last)
     out = [last]
     pos = S
     for _ in range(max_new_tokens - 1):
@@ -562,17 +579,30 @@ def fused_generate_fn(holder, mesh, cfg, param_shardings, batch_size: int,
 def cached_fn(holder, kind: str, key, builder, slots: int = 4):
     """Bounded per-family memoization of compiled functions on ``holder``
     (InferenceEngine and TpuHybridEngine share this; a long-running server
-    alternating shapes must not retain unbounded compiled programs)."""
+    alternating shapes must not retain unbounded compiled programs).
+
+    Hit/miss accounting rides along for telemetry: ``holder`` grows
+    ``_compile_hits``/``_compile_misses`` ints (request events diff the
+    miss count to tag compile-triggering requests), and a holder carrying
+    an enabled ``telemetry`` hub gets per-family labeled counters."""
     cache = getattr(holder, "_fn_cache", None)
     if cache is None:
         cache = holder._fn_cache = {}
     family = cache.setdefault(kind, {})
-    if key not in family:
+    miss = key not in family
+    if miss:
         if len(family) >= slots:
             family.pop(next(iter(family)))  # evict least-recently-used
         family[key] = builder()
     else:
         family[key] = family.pop(key)  # refresh recency (LRU, not FIFO)
+    attr = "_compile_misses" if miss else "_compile_hits"
+    setattr(holder, attr, getattr(holder, attr, 0) + 1)
+    tele = getattr(holder, "telemetry", None)
+    if tele is not None and tele.enabled:
+        tele.registry.counter(
+            "compile_cache", {"kind": kind, "outcome": "miss" if miss else "hit"}
+        ).inc()
     return family[key]
 
 

@@ -152,6 +152,14 @@ class InferenceEngine:
         self._decode_fn = None
         self._forward_fn = None
         self._model_times = []
+        # --- telemetry hub (telemetry/: JSONL request traces, TTFT/decode
+        # latency, compile-cache counters; inert when the block is disabled)
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry(self.config.telemetry, role="inference")
+        self._request_id = 0
+        self._compile_hits = 0
+        self._compile_misses = 0
         log_dist(
             f"InferenceEngine ready: dtype={cfg.dtype} quant={self._weight_quant} "
             f"mesh={dict(mesh.shape)}",
@@ -224,8 +232,16 @@ class InferenceEngine:
         self._compiled_shape = (batch_size, max_len)
 
     def _ensure_compiled(self, batch_size: int, max_len: int):
-        if self._prefill_fn is None or self._compiled_shape != (batch_size, max_len):
+        miss = self._prefill_fn is None or self._compiled_shape != (batch_size, max_len)
+        if miss:
             self._compile(batch_size, max_len)
+            self._compile_misses += 1
+        else:
+            self._compile_hits += 1
+        if self.telemetry.enabled:
+            self.telemetry.registry.counter(
+                "compile_cache", {"kind": "decode", "outcome": "miss" if miss else "hit"}
+            ).inc()
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, **kwargs):
@@ -236,10 +252,10 @@ class InferenceEngine:
             cfg = self.cfg
             self._forward_fn = jax.jit(lambda p, t: tf.apply(p, cfg, t))
         logits = self._forward_fn(self.params, tokens)
-        if self.config.profile_model_time:
-            jax.block_until_ready(logits)
-            self._model_times.append(time.time() - t0)
-        return logits
+        return self._finish_request(
+            "forward", t0, logits,
+            prompt_tokens=tokens.shape[1], new_tokens=0, batch=tokens.shape[0],
+        )
 
     __call__ = forward
 
@@ -247,6 +263,57 @@ class InferenceEngine:
         times = self._model_times
         self._model_times = []
         return times
+
+    def _finish_request(self, path: str, t0: float, result, prompt_tokens: int,
+                        new_tokens: int, batch: int, cache_len: Optional[int] = None,
+                        timings: Optional[dict] = None,
+                        misses_before: Optional[int] = None):
+        """Single exit point for every forward/generate path. Preserves the
+        reference's ``profile_model_time`` wall-clock list (``model_times()``
+        drain semantics unchanged) and emits one structured
+        "inference_request" telemetry event: TTFT when the path exposes a
+        first-token boundary (the host-driven loops; the fused program is
+        one dispatch, so TTFT degenerates to total), batch-aggregate decode
+        tokens/sec, the chosen KV-cache length, and whether the request hit
+        the compiled-fn cache or paid a compile."""
+        want_time = self.config.profile_model_time or self.telemetry.enabled
+        if not want_time:
+            return result
+        jax.block_until_ready(result)
+        now = time.time()
+        total_s = now - t0
+        if self.config.profile_model_time:
+            self._model_times.append(total_s)
+        if self.telemetry.enabled:
+            self._request_id += 1
+            event = {
+                "request": self._request_id,
+                "path": path,
+                "batch": int(batch),
+                "prompt_tokens": int(prompt_tokens),
+                "new_tokens": int(new_tokens),
+                "total_ms": total_s * 1000.0,
+            }
+            if cache_len is not None:
+                event["cache_len"] = int(cache_len)
+            if misses_before is not None:
+                event["compile_cache_hit"] = self._compile_misses == misses_before
+            ttft_s = (timings or {}).get("first_token_s")
+            if ttft_s is not None:
+                event["ttft_ms"] = (ttft_s - t0) * 1000.0
+            if new_tokens > 0 and total_s > 0:
+                event["tokens_per_sec"] = int(batch) * (prompt_tokens + new_tokens) / total_s
+                if ttft_s is None:
+                    event["decode_tokens_per_sec"] = int(batch) * new_tokens / total_s
+                elif new_tokens > 1:
+                    # the first token lands at TTFT; rate the remaining
+                    # tokens over the decode span (a 1-token request has no
+                    # decode span — omit rather than divide by ~0)
+                    event["decode_tokens_per_sec"] = (
+                        int(batch) * (new_tokens - 1) / max(now - ttft_s, 1e-9)
+                    )
+            self.telemetry.emit("inference_request", event)
+        return result
 
     def generate(
         self,
@@ -289,6 +356,10 @@ class InferenceEngine:
         from deepspeed_tpu.inference.decoding import bounded_cache_len, decode_loop
 
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # telemetry: compile-cache snapshot (events tag compile-paying
+        # requests) and the TTFT stamp dict for the host-driven loops
+        misses0 = self._compile_misses
+        timings = {} if self.telemetry.enabled else None
         if self.config.prefill_chunk_size and draft is None \
                 and not self.config.speculative.enabled:
             # fixed-shape (B, chunk) prefill program for EVERY prompt
@@ -305,10 +376,12 @@ class InferenceEngine:
             result = chunked_generate(
                 prefill_fn, segment_fn, self.params, tokens, cache, max_len,
                 self.config.prefill_chunk_size, max_new_tokens, temperature,
-                top_k, rng, top_p, attention_mask=attention_mask)
-            if self.config.profile_model_time:
-                jax.block_until_ready(result)
-                self._model_times.append(time.time() - t0)
+                top_k, rng, top_p, attention_mask=attention_mask,
+                timings=timings)
+            result = self._finish_request(
+                "chunked_prefill", t0, result, prompt_tokens=S,
+                new_tokens=max_new_tokens, batch=B, cache_len=max_len,
+                timings=timings, misses_before=misses0)
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
@@ -326,10 +399,12 @@ class InferenceEngine:
             result = ragged_decode_loop(
                 prefill_fn, segment_fn, self.params, tokens, attention_mask,
                 cache, max_len, max_new_tokens, temperature, top_k, rng, top_p,
+                timings=timings,
             )
-            if self.config.profile_model_time:
-                jax.block_until_ready(result)
-                self._model_times.append(time.time() - t0)
+            result = self._finish_request(
+                "ragged", t0, result, prompt_tokens=S,
+                new_tokens=max_new_tokens, batch=B, cache_len=max_len,
+                timings=timings, misses_before=misses0)
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
@@ -362,9 +437,10 @@ class InferenceEngine:
             cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), cache_sh)
             t0 = time.time()
             result = fused_fn(self.params, tokens, cache, rng)
-            if self.config.profile_model_time:
-                jax.block_until_ready(result)
-                self._model_times.append(time.time() - t0)
+            result = self._finish_request(
+                "fused", t0, result, prompt_tokens=S,
+                new_tokens=max_new_tokens, batch=B, cache_len=max_len,
+                misses_before=misses0)
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
@@ -375,10 +451,12 @@ class InferenceEngine:
         result = decode_loop(
             self._prefill_fn, self._decode_fn, self.params, tokens, cache,
             max_new_tokens, temperature, top_k, rng, top_p=top_p,
+            timings=timings,
         )
-        if self.config.profile_model_time:
-            jax.block_until_ready(result)
-            self._model_times.append(time.time() - t0)
+        result = self._finish_request(
+            "decode_loop", t0, result, prompt_tokens=S,
+            new_tokens=max_new_tokens, batch=B, cache_len=max_len,
+            timings=timings, misses_before=misses0)
         if eos_token_id is not None:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
@@ -472,16 +550,17 @@ class InferenceEngine:
                               eos_token_id: Optional[int] = None):
         from deepspeed_tpu.inference.decoding import speculative_generate
 
+        misses0 = self._compile_misses
         t0 = time.time()
         result = speculative_generate(
             self._ring_off_cfg, self.params, draft, tokens, max_new_tokens, temperature,
             top_k, top_p, rng, gamma, self.config.max_out_tokens,
             get_fns=self._spec_fns, eos_token_id=eos_token_id,
         )
-        if self.config.profile_model_time:
-            jax.block_until_ready(result)
-            self._model_times.append(time.time() - t0)
-        return result
+        return self._finish_request(
+            "speculative", t0, result, prompt_tokens=tokens.shape[1],
+            new_tokens=max_new_tokens, batch=tokens.shape[0],
+            misses_before=misses0)
 
     @staticmethod
     def _select(logits, temperature, top_k, rng, top_p=1.0):

@@ -16,6 +16,9 @@ class _Writer:
     def flush(self):
         pass
 
+    def close(self):
+        pass
+
 
 class TensorBoardMonitor(_Writer):
     def __init__(self, cfg):
@@ -43,23 +46,39 @@ class TensorBoardMonitor(_Writer):
 class CSVMonitor(_Writer):
     def __init__(self, cfg):
         self.enabled = cfg.enabled
+        self._files = {}  # before the early return: flush()/close() iterate it
         if not self.enabled:
             return
         self.dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
         os.makedirs(self.dir, exist_ok=True)
-        self._files = {}
 
     def write_events(self, events):
         import csv
 
+        touched = set()
         for name, value, step in events:
-            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as fh:
-                w = csv.writer(fh)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, value])
+            fh = self._files.get(name)
+            if fh is None:
+                fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+                header = not os.path.exists(fname) or os.path.getsize(fname) == 0
+                fh = self._files[name] = open(fname, "a", newline="")
+                if header:
+                    csv.writer(fh).writerow(["step", name])
+            csv.writer(fh).writerow([step, value])
+            touched.add(name)
+        # rows are durable per batch (readers tail these files mid-run);
+        # the win over the old code is one open() per metric, not per event
+        for name in touched:
+            self._files[name].flush()
+
+    def flush(self):
+        for fh in self._files.values():
+            fh.flush()
+
+    def close(self):
+        for fh in self._files.values():
+            fh.close()
+        self._files.clear()
 
 
 class WandbMonitor(_Writer):
@@ -103,3 +122,7 @@ class MonitorMaster(_Writer):
     def flush(self):
         for w in self.writers:
             w.flush()
+
+    def close(self):
+        for w in self.writers:
+            w.close()

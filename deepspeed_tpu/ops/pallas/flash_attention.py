@@ -26,6 +26,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 spells this TPUCompilerParams; same fields
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 
@@ -142,7 +146,10 @@ def _sds(shape, dtype, vma):
     vma-checked shard_map (sequence-parallel Ulysses local attention)."""
     if vma is None:
         return jax.ShapeDtypeStruct(shape, dtype)
-    return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    except TypeError:  # pre-VMA jax: no varying-axis typing to declare
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _band_width(window, b_outer, b_inner, n_inner):

@@ -174,5 +174,24 @@ def sequence_parallel_attention(
     else:
         raise ValueError(f"unknown sequence-parallel impl '{impl}' (ring | ulysses)")
     spec = PartitionSpec(None, seq_axis, head_axis, None)
-    fn = jax.shard_map(local, mesh=mesh, axis_names=manual_axes, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = _partial_manual_shard_map(local, mesh, manual_axes,
+                                   in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
+
+
+def _partial_manual_shard_map(fn, mesh, manual_axes, in_specs, out_specs):
+    """shard_map manual over ``manual_axes`` only (other mesh axes stay
+    under GSPMD): jax >= 0.8 spells that ``axis_names=``. Older jax's
+    partial-auto support raises NotImplementedError on the collectives
+    inside, so the fallback goes full-manual over every mesh axis — the
+    specs only name seq/tensor axes, so inputs reshard (replicate) over
+    the rest; a perf cost on combined meshes, never a wrong answer."""
+    try:
+        return jax.shard_map(fn, mesh=mesh, axis_names=manual_axes,
+                             in_specs=in_specs, out_specs=out_specs)
+    except (AttributeError, TypeError):
+        # no jax.shard_map at all, or one without axis_names support
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)

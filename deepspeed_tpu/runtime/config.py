@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import AUTO, ConfigError, from_dict, is_auto
 from deepspeed_tpu.runtime.zero.config import ZeroConfig
+from deepspeed_tpu.telemetry.config import TelemetryConfig
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -244,6 +245,7 @@ class TpuConfig:
         self.pipeline = from_dict(PipelineConfig, g("pipeline", {}))
         self.moe = from_dict(MoEConfig, g("moe", {}))
         self.comms_logger = from_dict(CommsLoggerConfig, g("comms_logger", {}))
+        self.telemetry = from_dict(TelemetryConfig, g("telemetry", {}))
         self.eigenvalue = from_dict(EigenvalueConfig, g("eigenvalue", {}))
         self.curriculum = from_dict(CurriculumConfig, g("curriculum_learning", {}))
         self.hybrid_engine = from_dict(HybridEngineConfig, g("hybrid_engine", {}))

@@ -414,6 +414,7 @@ class TpuEngine:
         self._last_metrics: Optional[StepMetrics] = None
         self._pending_loss = None
         self._flops_profiled = False
+        self._micro_cost_cache = None  # (cost_dict, compiled) AOT artifact
 
         # --- timers / monitor
         self.timers = EngineTimers(enable=config.wall_clock_breakdown)
@@ -423,6 +424,20 @@ class TpuEngine:
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(config)
+
+        # --- telemetry hub (telemetry/: JSONL step traces + MFU + registry;
+        # inert when the config block is absent/disabled)
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry(config.telemetry, monitor=self.monitor, role="train")
+        self._tele_window = {"fwd_ms": 0.0, "bwd_ms": 0.0}
+        self._tele_flops_per_micro = None  # model FLOPs per micro-step (MFU)
+        self._tele_tokens_per_micro = None
+        self._comm_totals_prev = {}
+        self._iter_t0 = None
+        if self.telemetry.enabled:
+            # comm-volume deltas in step events need the trace-time counters
+            comm.ensure_comms_logger()
 
         # --- data-efficiency runtime schedules: progressive layer drop +
         # random-LTD (reference engine.py:1512 PLD theta pass-through;
@@ -975,6 +990,23 @@ class TpuEngine:
             self._profiling = False
 
     def forward(self, batch, rng=None):
+        if not self.telemetry.enabled:
+            return self._forward_impl(batch, rng)
+        if self._iter_t0 is None:  # first micro-step of the accumulation window
+            self._iter_t0 = time.time()
+        t0 = time.time()
+        loss = self._forward_impl(batch, rng)
+        if self.config.telemetry.sync_timers:
+            try:
+                jax.block_until_ready(loss)
+            except Exception:
+                pass
+        self._tele_window["fwd_ms"] += (time.time() - t0) * 1000.0
+        if self._tele_flops_per_micro is None:
+            self._tele_capture_flops(batch)
+        return loss
+
+    def _forward_impl(self, batch, rng=None):
         self.timers(EngineTimers.FORWARD).start()
         self.tput_timer.start()
         if self.curriculum_scheduler is not None:
@@ -1022,6 +1054,7 @@ class TpuEngine:
     def backward(self, loss=None):
         """Micro-step boundary (gradients were produced in forward; this
         advances the accumulation counter for API parity)."""
+        t0 = time.time() if self.telemetry.enabled else 0.0
         self.timers(EngineTimers.BACKWARD).start()
         self.micro_steps += 1
         self.global_samples += self.train_micro_batch_size_per_gpu * comm.dp_world_size()
@@ -1047,6 +1080,8 @@ class TpuEngine:
                 if hasattr(g, "copy_to_host_async"):
                     g.copy_to_host_async()
         self.timers(EngineTimers.BACKWARD).stop()
+        if self.telemetry.enabled:
+            self._tele_window["bwd_ms"] += (time.time() - t0) * 1000.0
         return loss if loss is not None else self._pending_loss
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -1057,6 +1092,8 @@ class TpuEngine:
             self.tput_timer.stop(global_step=False)
             return
         assert self.optimizer is not None, "step() requires an optimizer (config or client-provided)"
+        tele = self.telemetry.enabled
+        t_step = time.time() if tele else 0.0
         self.timers(EngineTimers.STEP).start()
         if self.offload_device in ("cpu", "nvme"):
             metrics = self._host_offload_step(self.get_lr_value())
@@ -1090,8 +1127,33 @@ class TpuEngine:
         self.timers(EngineTimers.STEP).stop()
         self.tput_timer.stop(global_step=True)
         self._write_monitor()
+        if tele:
+            if self.config.telemetry.sync_timers:
+                try:
+                    jax.block_until_ready(metrics)
+                except Exception:
+                    pass
+            self._emit_step_telemetry((time.time() - t_step) * 1000.0)
+            self.telemetry.maybe_capture(self.global_steps)
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
             self.timers.log(normalizer=self.gradient_accumulation_steps)
+            self._emit_comm_summary()
+
+    def _micro_cost_analysis(self, batch, rng):
+        """(cost_dict, compiled) for the default micro program via one AOT
+        lower+compile, cached on the engine — the flops profiler and the
+        telemetry MFU capture share the result, so the extra compile (the
+        jit dispatch cache is separate from AOT artifacts) happens at most
+        once per engine."""
+        if self._micro_cost_cache is None:
+            compiled = self._micro_fn.lower(
+                self.params, self.grad_acc, batch, rng, self.scale_state.scale, jnp.float32(1.0)
+            ).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            self._micro_cost_cache = (dict(cost or {}), compiled)
+        return self._micro_cost_cache
 
     def _profile_flops(self, batch, rng):
         """One-shot micro-step cost report (reference: engine.py:1646-1664
@@ -1101,14 +1163,9 @@ class TpuEngine:
         self._flops_profiled = True
         prof = FlopsProfiler(self.model, engine=self)
         try:
-            compiled = self._micro_fn.lower(
-                self.params, self.grad_acc, batch, rng, self.scale_state.scale, jnp.float32(1.0)
-            ).compile()
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            prof.flops = float((cost or {}).get("flops", 0.0))
-            prof.bytes_accessed = float((cost or {}).get("bytes accessed", 0.0))
+            cost, compiled = self._micro_cost_analysis(batch, rng)
+            prof.flops = float(cost.get("flops", 0.0))
+            prof.bytes_accessed = float(cost.get("bytes accessed", 0.0))
             # timed run on a throwaway grad buffer (the real one is donated to
             # the subsequent training call); host fetch forces completion
             zeros = jax.jit(
@@ -1171,6 +1228,118 @@ class TpuEngine:
 
     def zero_optimization_stage(self) -> int:
         return self.zero_stage
+
+    # ------------------------------------------------------------------
+    # telemetry (telemetry/: structured step traces, MFU, comm volume)
+    # ------------------------------------------------------------------
+    def _tele_capture_flops(self, batch):
+        """One-shot model-FLOPs-per-micro-step capture for MFU: the model's
+        own ``flops_per_token`` (Megatron 6N accounting, fwd+bwd) when it
+        declares one, else XLA ``cost_analysis`` of the compiled micro
+        program — the same number the flops profiler fetches."""
+        self._tele_flops_per_micro = 0.0
+        try:
+            seq = None
+            if isinstance(batch, dict):
+                for key in self._SEQ_KEYS:
+                    arr = batch.get(key)
+                    if getattr(arr, "ndim", 0) >= 2:
+                        seq = (int(arr.shape[0]), int(arr.shape[1]))
+                        break
+            if seq is not None:
+                self._tele_tokens_per_micro = seq[0] * seq[1]
+            if seq is not None and hasattr(self.model, "flops_per_token"):
+                self._tele_flops_per_micro = (
+                    float(self.model.flops_per_token(seq[1])) * seq[0] * seq[1]
+                )
+                return
+            if self._micro_fn is not None:
+                cost, _ = self._micro_cost_analysis(batch, jax.random.PRNGKey(0))
+                self._tele_flops_per_micro = float(cost.get("flops", 0.0))
+        except Exception as e:  # telemetry must never kill training
+            logger.warning(f"telemetry flops capture failed: {e}")
+
+    def _emit_step_telemetry(self, step_ms: float):
+        """One "train_step" trace event per optimizer step (docs/telemetry.md
+        schema): phase wall-times, throughput, MFU, loss/grad-norm/scale,
+        and comm-volume deltas since the previous step."""
+        now = time.time()
+        iter_ms = (now - self._iter_t0) * 1000.0 if self._iter_t0 is not None else step_ms
+        iter_s = iter_ms / 1000.0
+        comm_delta = {}
+        cl = comm.get_comms_logger()
+        if cl is not None:
+            totals = cl.totals()
+            comm_delta = {
+                op: totals[op] - self._comm_totals_prev.get(op, 0) for op in totals
+            }
+            self._comm_totals_prev = totals
+        flops_per_step = (self._tele_flops_per_micro or 0.0) * self.gradient_accumulation_steps
+        peak = self.telemetry.peak_flops_per_device() * max(jax.device_count(), 1)
+        mfu = flops_per_step / (iter_s * peak) if flops_per_step > 0 and iter_s > 0 else 0.0
+        m = self._last_metrics
+        event = {
+            "step": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "samples": self.global_samples,
+            "fwd_ms": self._tele_window["fwd_ms"],
+            "bwd_ms": self._tele_window["bwd_ms"],
+            "step_ms": step_ms,
+            "iter_ms": iter_ms,
+            "samples_per_sec": self.train_batch_size / iter_s if iter_s > 0 else 0.0,
+            "avg_samples_per_sec": self.tput_timer.avg_samples_per_sec(),
+            "lr": self.get_lr_value(),
+            "loss_scale": float(m.loss_scale) if m is not None else 1.0,
+            "grad_norm": float(m.grad_norm) if m is not None else 0.0,
+            "overflow": bool(m.overflow) if m is not None else False,
+            "skipped_steps": self.skipped_steps,
+            "mfu": mfu,
+            "model_flops_per_step": flops_per_step,
+            "comm_bytes": comm_delta,
+            "comm_bytes_total": float(sum(comm_delta.values())),
+        }
+        if self._pending_loss is not None:
+            event["loss"] = float(self._pending_loss)
+        if self._tele_tokens_per_micro:
+            tokens = self._tele_tokens_per_micro * self.gradient_accumulation_steps
+            event["tokens_per_sec"] = tokens / iter_s if iter_s > 0 else 0.0
+        self.telemetry.emit(
+            "train_step", event,
+            monitor_prefix="Train/Telemetry", monitor_step=self.global_samples,
+        )
+        self._tele_window = {"fwd_ms": 0.0, "bwd_ms": 0.0}
+        self._iter_t0 = None
+
+    def comm_summary(self) -> dict:
+        """Cumulative per-op collective volume (``CommsLogger.summary()``):
+        {op: {count, total_bytes, total_human}} — empty when no comms
+        logger is active. The user-facing accessor for what ``log_all``
+        used to leave orphaned."""
+        cl = comm.get_comms_logger()
+        return cl.summary() if cl is not None else {}
+
+    def _emit_comm_summary(self):
+        """Surface the comm-volume summary at steps_per_print boundaries
+        through both the telemetry trace and the monitor writers."""
+        summary = self.comm_summary()
+        if not summary:
+            return
+        self.telemetry.emit(
+            "comm_summary", {"step": self.global_steps, "ops": summary}
+        )
+        if self.monitor.enabled:
+            events = []
+            for op, stats in summary.items():
+                events.append((f"Train/Comms/{op}/total_bytes",
+                               float(stats["total_bytes"]), self.global_samples))
+                events.append((f"Train/Comms/{op}/count",
+                               float(stats["count"]), self.global_samples))
+            self.monitor.write_events(events)
+
+    def telemetry_summary(self) -> dict:
+        """Aggregated registry view (counters/gauges/histogram percentiles)
+        of everything this engine emitted."""
+        return self.telemetry.summary()
 
     def _write_monitor(self):
         if not self.monitor.enabled:

@@ -59,9 +59,13 @@ def _wire_axis() -> tuple:
 
 def _shard_map_no_repcheck(fn, mesh, in_specs, out_specs):
     try:
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+        sm = jax.shard_map  # jax >= 0.8
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
     except TypeError:  # older shard_map API
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
 
 
 def _compressed_sync_leaf(m, cs, mesh, axis, world):

@@ -1,0 +1,27 @@
+"""Unified telemetry layer: labeled metrics, structured JSONL step/request
+traces, MFU accounting, and jax.profiler capture hooks.
+
+Entry points:
+  - :class:`Telemetry` — per-engine hub (``TpuEngine.telemetry``,
+    ``InferenceEngine.telemetry``), built from the ``telemetry`` config
+    block (default off).
+  - :class:`MetricsRegistry` — standalone counters/gauges/histograms/spans.
+  - :class:`TraceWriter` / :func:`read_trace` — the JSONL format
+    (``"schema": 1``) consumed by ``tools/ds_trace_report.py``.
+"""
+
+from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, metric_key, percentile
+from deepspeed_tpu.telemetry.telemetry import Telemetry
+from deepspeed_tpu.telemetry.trace import SCHEMA_VERSION, TraceWriter, read_trace
+
+__all__ = [
+    "Telemetry",
+    "TelemetryConfig",
+    "MetricsRegistry",
+    "TraceWriter",
+    "read_trace",
+    "metric_key",
+    "percentile",
+    "SCHEMA_VERSION",
+]

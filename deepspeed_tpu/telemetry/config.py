@@ -1,0 +1,44 @@
+"""Telemetry config block, shared verbatim by the training config
+(``TpuConfig.telemetry``) and the inference config
+(``InferenceConfig.telemetry``). Default off: with ``enabled: false`` the
+engines behave bit-identically to a build without the telemetry layer and
+no trace file is ever created.
+
+JSON shape (see docs/telemetry.md for the full schema):
+
+    "telemetry": {
+        "enabled": true,
+        "trace_file": "runs/trace.jsonl",
+        "profile_start_step": 10,
+        "profile_num_steps": 3
+    }
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class TelemetryConfig:
+    enabled: bool = False
+    # JSONL destination, one event per line ("schema": 1). Written by
+    # process 0 only. Relative paths resolve against the CWD.
+    trace_file: str = "telemetry_trace.jsonl"
+    # mirror numeric event fields into MonitorMaster writers
+    # (tensorboard/csv/wandb) when any are configured
+    emit_to_monitor: bool = True
+    # block on device work at micro-step/step boundaries so fwd/step wall
+    # times measure compute, not dispatch. Costs the dispatch overlap —
+    # that is the price of honest per-phase numbers; turn off to keep the
+    # async pipeline and accept dispatch-time phase attribution.
+    sync_timers: bool = True
+    # per-device peak FLOP/s (in TFLOP/s) for the MFU denominator.
+    # 0 = auto-detect from jax device_kind (v4/v5e/v5p/v6e table),
+    # falling back to the v5e peak (197) on unknown hardware — override
+    # for anything else.
+    peak_tflops_per_device: float = 0.0
+    # jax.profiler device-trace capture window: start at this global step
+    # (0 = never) and run for profile_num_steps steps. The xplane dump
+    # lands in profile_dir (default: alongside the trace file).
+    profile_start_step: int = 0
+    profile_num_steps: int = 1
+    profile_dir: str = ""

@@ -1,0 +1,170 @@
+"""Telemetry hub: one object per engine fanning events into every export
+path — the JSONL trace file, the in-process :class:`MetricsRegistry`
+(for ``summary()`` percentiles), and ``MonitorMaster`` writers
+(tensorboard/csv/wandb) — plus the optional ``jax.profiler`` device-trace
+capture window.
+
+Disabled (the default) it is inert: ``emit`` returns immediately, no file
+is opened, no profiler started. Engines therefore construct one
+unconditionally and guard hot-path measurement (timers, host syncs) on
+``telemetry.enabled`` only.
+"""
+
+import json
+import os
+import time
+from typing import Optional
+
+from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.trace import SCHEMA_VERSION, TraceWriter
+from deepspeed_tpu.utils.logging import logger
+
+# Per-chip bf16 peaks (TFLOP/s) by jax device_kind substring; the MFU
+# denominator. Override via telemetry.peak_tflops_per_device.
+_DEVICE_PEAK_TFLOPS = (
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+_FALLBACK_PEAK_TFLOPS = 197.0  # v5e, the repo's headline bench part
+
+
+def _numeric_items(payload: dict):
+    for k, v in payload.items():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            yield k, float(v)
+
+
+class Telemetry:
+    def __init__(self, cfg: Optional[TelemetryConfig] = None, monitor=None,
+                 role: str = "train"):
+        self.cfg = cfg if cfg is not None else TelemetryConfig()
+        self.enabled = self.cfg.enabled
+        self.role = role
+        self.monitor = monitor
+        self.registry = MetricsRegistry()
+        self._writer = None
+        self._profiling = False
+        self._peak_flops_per_device = None
+        if self.enabled and self.cfg.trace_file:
+            import jax
+
+            if jax.process_index() == 0:
+                self._writer = TraceWriter(self.cfg.trace_file)
+
+    # ------------------------------------------------------------------
+    def span(self, name: str, labels: Optional[dict] = None):
+        return self.registry.span(name, labels)
+
+    def emit(self, kind: str, payload: dict, monitor_prefix: Optional[str] = None,
+             monitor_step: Optional[int] = None):
+        """Fan one structured event into every export path. ``payload`` is
+        flat-ish JSON (nested dicts allowed; only top-level numerics feed
+        the registry/monitor). Returns the full event dict (None when
+        disabled)."""
+        if not self.enabled:
+            return None
+        event = {"role": self.role}
+        event.update(payload)
+        for field, value in _numeric_items(payload):
+            self.registry.histogram(f"{kind}.{field}").observe(value)
+        if self._writer is not None:
+            try:
+                self._writer.write(kind, event)
+            except OSError as e:  # telemetry must never kill the step loop
+                logger.warning(f"telemetry trace write failed: {e}")
+                self._writer = None
+        if (monitor_prefix and self.cfg.emit_to_monitor
+                and self.monitor is not None and self.monitor.enabled):
+            step = int(monitor_step if monitor_step is not None
+                       else payload.get("step", 0))
+            self.monitor.write_events(
+                [(f"{monitor_prefix}/{field}", value, step)
+                 for field, value in _numeric_items(payload)]
+            )
+        event.setdefault("schema", SCHEMA_VERSION)
+        event.setdefault("kind", kind)
+        return event
+
+    # ------------------------------------------------------------------
+    def peak_flops_per_device(self) -> float:
+        """MFU denominator in FLOP/s per local device."""
+        if self._peak_flops_per_device is None:
+            tflops = self.cfg.peak_tflops_per_device
+            if not tflops:
+                kind = ""
+                try:
+                    import jax
+
+                    kind = jax.local_devices()[0].device_kind.lower()
+                except Exception:
+                    pass
+                tflops = next(
+                    (peak for sub, peak in _DEVICE_PEAK_TFLOPS if sub in kind),
+                    _FALLBACK_PEAK_TFLOPS,
+                )
+            self._peak_flops_per_device = tflops * 1e12
+        return self._peak_flops_per_device
+
+    # ------------------------------------------------------------------
+    def maybe_capture(self, step: int):
+        """Drive the configured jax.profiler window: start when ``step``
+        reaches ``profile_start_step``, stop ``profile_num_steps`` later.
+        Failures never propagate into the training loop."""
+        cfg = self.cfg
+        if not self.enabled or cfg.profile_start_step <= 0:
+            return
+        try:
+            import jax.profiler
+        except Exception:
+            return
+        try:
+            if not self._profiling and step == cfg.profile_start_step:
+                logdir = cfg.profile_dir or os.path.join(
+                    os.path.dirname(os.path.abspath(cfg.trace_file or ".")),
+                    "xla_trace",
+                )
+                jax.profiler.start_trace(logdir)
+                self._profiling = True
+            elif self._profiling and step >= cfg.profile_start_step + cfg.profile_num_steps:
+                jax.profiler.stop_trace()
+                self._profiling = False
+        except Exception as e:
+            logger.warning(f"telemetry profiler capture failed: {e}")
+            self._profiling = False
+
+    # ------------------------------------------------------------------
+    def summary(self) -> dict:
+        """Aggregated view of everything emitted so far (counters, gauges,
+        per-field histogram percentiles)."""
+        return {
+            "schema": SCHEMA_VERSION,
+            "role": self.role,
+            "metrics": self.registry.dump(),
+        }
+
+    def dump_summary(self, path: str) -> dict:
+        s = self.summary()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(s, fh, indent=2, sort_keys=True)
+        return s
+
+    def close(self):
+        if self._profiling:
+            try:
+                import jax.profiler
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
+        if self._writer is not None:
+            self._writer.close()

@@ -1,0 +1,71 @@
+"""Structured JSONL trace writer.
+
+One event per line; every event carries ``"schema": 1`` (bump on any
+incompatible field change), a ``"kind"`` discriminator ("train_step",
+"inference_request", "comm_summary", ...) and a wall-clock ``"ts"``.
+``tools/ds_trace_report.py`` renders per-kind p50/p95/max tables from
+these files; docs/telemetry.md documents the per-kind fields.
+"""
+
+import json
+import os
+import time
+
+SCHEMA_VERSION = 1
+
+
+def _json_default(obj):
+    """Coerce numpy/jax scalars (and anything with .item()) to JSON."""
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+class TraceWriter:
+    """Append-only JSONL writer; the file opens lazily on the first event
+    (so a constructed-but-never-used writer creates nothing) and each line
+    is flushed — a crashed run keeps every completed event."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    def write(self, kind: str, payload: dict):
+        event = {"schema": SCHEMA_VERSION, "kind": kind, "ts": time.time()}
+        event.update(payload)
+        if self._fh is None:
+            parent = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(parent, exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(event, default=_json_default) + "\n")
+        self._fh.flush()
+        return event
+
+    def flush(self):
+        if self._fh is not None:
+            self._fh.flush()
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_trace(path: str):
+    """Yield parsed events from a JSONL trace, skipping malformed lines
+    (a crashed writer may leave a torn final line)."""
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict):
+                yield ev

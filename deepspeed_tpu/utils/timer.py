@@ -130,6 +130,7 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        self.last_duration = 0.0  # most recent start..stop span (telemetry)
         self._started = False
         self._start_time = 0.0
 
@@ -149,6 +150,7 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         duration = time.time() - self._start_time
+        self.last_duration = duration
         if self.global_step_count >= self.start_step:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
@@ -165,6 +167,13 @@ class ThroughputTimer:
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
             steps = self.global_step_count - self.start_step
             return self.batch_size * steps / self.total_elapsed_time
+        return 0.0
+
+    def last_samples_per_sec(self) -> float:
+        """Instantaneous samples/sec of the most recent span — the
+        telemetry step events report this next to the running average."""
+        if self.last_duration > 0:
+            return self.batch_size / self.last_duration
         return 0.0
 
 

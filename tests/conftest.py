@@ -16,7 +16,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.4.38) spells this as an XLA flag; the backend has not
+    # initialized yet at conftest import, so the env route still lands
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
 # Persistent XLA compile cache: compiles survive the per-module
 # clear_caches() below AND rerun invocations (measured ~2x on warm,
 # compile-heavy modules; the build host has one CPU core, so compiles

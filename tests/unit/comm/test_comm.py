@@ -5,7 +5,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec
-from jax import shard_map
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 
 from deepspeed_tpu import comm
 

@@ -1,0 +1,102 @@
+"""Inference request telemetry: every generate path emits one structured
+"inference_request" event (TTFT where a first-token boundary exists,
+decode tokens/sec, chosen cache length, compile-cache outcome) while
+``model_times()`` keeps its drain semantics."""
+
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def _engine(tmp_path, **config_over):
+    comm.destroy()
+    mesh = comm.init_distributed(mesh_shape={"data": -1, "tensor": 1}, verbose=False)
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=64, dtype="float32",
+    )
+    config = {
+        "dtype": "float32",
+        "profile_model_time": True,
+        "telemetry": {"enabled": True, "trace_file": str(tmp_path / "itrace.jsonl")},
+    }
+    config.update(config_over)
+    return deepspeed_tpu.init_inference(cfg, config=config, mesh=mesh)
+
+
+def _events(tmp_path):
+    with open(tmp_path / "itrace.jsonl") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+PROMPT = np.arange(8, dtype=np.int32).reshape(1, 8)
+
+
+def test_fused_and_decode_loop_request_events(tmp_path):
+    eng = _engine(tmp_path)
+    eng.generate(PROMPT, max_new_tokens=4)  # fused (default)
+    eng.config.fused_generate = False
+    eng.generate(PROMPT, max_new_tokens=4)  # decode_loop, compiles
+    eng.generate(PROMPT, max_new_tokens=4)  # decode_loop, cache hit
+    events = _events(tmp_path)
+    assert [e["kind"] for e in events] == ["inference_request"] * 3
+    fused, first, second = events
+    assert fused["path"] == "fused"
+    assert fused["schema"] == 1 and fused["role"] == "inference"
+    assert fused["prompt_tokens"] == 8 and fused["new_tokens"] == 4
+    assert fused["total_ms"] > 0.0
+    assert fused["cache_len"] > 0
+    assert fused["compile_cache_hit"] is False
+    assert fused["decode_tokens_per_sec"] > 0.0
+    # host-driven loop exposes the prefill/first-token boundary
+    assert first["path"] == "decode_loop"
+    assert 0.0 < first["ttft_ms"] <= first["total_ms"]
+    assert first["compile_cache_hit"] is False
+    assert second["compile_cache_hit"] is True
+    assert second["total_ms"] < first["total_ms"]  # no compile in the way
+    # drain semantics preserved: one wall time per request, then empty
+    times = eng.model_times()
+    assert len(times) == 3 and all(t > 0 for t in times)
+    assert eng.model_times() == []
+
+
+def test_ragged_path_records_ttft(tmp_path):
+    eng = _engine(tmp_path)
+    mask = np.ones((1, 8), np.int64)
+    mask[0, :2] = 0  # left padding
+    eng.generate(PROMPT, max_new_tokens=4, attention_mask=mask)
+    (ev,) = _events(tmp_path)
+    assert ev["path"] == "ragged"
+    assert 0.0 < ev["ttft_ms"] <= ev["total_ms"]
+    assert ev["new_tokens"] == 4
+
+
+def test_forward_event_and_registry_counters(tmp_path):
+    eng = _engine(tmp_path)
+    eng.forward(PROMPT)
+    eng.config.fused_generate = False
+    eng.generate(PROMPT, max_new_tokens=2)
+    eng.generate(PROMPT, max_new_tokens=2)
+    events = _events(tmp_path)
+    assert events[0]["path"] == "forward"
+    assert events[0]["new_tokens"] == 0
+    counters = eng.telemetry.summary()["metrics"]["counters"]
+    assert counters["compile_cache{kind=decode,outcome=miss}"] == 1.0
+    assert counters["compile_cache{kind=decode,outcome=hit}"] == 1.0
+    # request histograms aggregate for the summary path
+    hist = eng.telemetry.summary()["metrics"]["histograms"]
+    assert hist["inference_request.total_ms"]["count"] == 3
+
+
+def test_disabled_telemetry_writes_nothing(tmp_path):
+    eng = _engine(tmp_path, telemetry={"enabled": False,
+                                       "trace_file": str(tmp_path / "off.jsonl")})
+    eng.generate(PROMPT, max_new_tokens=2)
+    assert not (tmp_path / "off.jsonl").exists()
+    # profile_model_time drain list still works without telemetry
+    assert len(eng.model_times()) == 1

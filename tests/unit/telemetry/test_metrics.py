@@ -1,0 +1,70 @@
+"""MetricsRegistry unit coverage: counters/gauges/histograms/spans and the
+dump shape the summary path serializes."""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry import MetricsRegistry, metric_key, percentile
+
+
+def test_metric_key_label_order_irrelevant():
+    assert metric_key("m", {"a": 1, "b": 2}) == metric_key("m", {"b": 2, "a": 1})
+    assert metric_key("m") == "m"
+    assert metric_key("m", {"path": "fused"}) == "m{path=fused}"
+
+
+def test_counter_labels_are_distinct_series():
+    reg = MetricsRegistry()
+    reg.counter("req", {"path": "fused"}).inc()
+    reg.counter("req", {"path": "fused"}).inc(2)
+    reg.counter("req", {"path": "ragged"}).inc()
+    dump = reg.dump()["counters"]
+    assert dump["req{path=fused}"] == 3.0
+    assert dump["req{path=ragged}"] == 1.0
+
+
+def test_gauge_last_value_wins():
+    reg = MetricsRegistry()
+    reg.gauge("loss_scale").set(1024.0)
+    reg.gauge("loss_scale").set(512.0)
+    assert reg.dump()["gauges"]["loss_scale"] == 512.0
+
+
+def test_histogram_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_ms")
+    for v in range(1, 101):  # 1..100
+        h.observe(float(v))
+    snap = reg.dump()["histograms"]["lat_ms"]
+    assert snap["count"] == 100
+    assert snap["min"] == 1.0 and snap["max"] == 100.0
+    assert snap["p50"] == pytest.approx(50.5)
+    assert snap["p95"] == pytest.approx(95.05)
+    assert snap["mean"] == pytest.approx(50.5)
+
+
+def test_histogram_reservoir_bounded_but_count_exact():
+    reg = MetricsRegistry()
+    h = reg.histogram("x")
+    for v in range(10000):
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 10000  # running stats are exact
+    assert len(h._values) == 4096  # reservoir stays bounded
+
+
+def test_span_times_block_into_histogram():
+    reg = MetricsRegistry()
+    with reg.span("step_ms", {"phase": "fwd"}) as span:
+        time.sleep(0.01)
+    assert span.elapsed_ms >= 5.0
+    snap = reg.dump()["histograms"]["step_ms{phase=fwd}"]
+    assert snap["count"] == 1
+    assert snap["max"] >= 5.0
+
+
+def test_percentile_edge_cases():
+    assert percentile([], 50.0) == 0.0
+    assert percentile([7.0], 95.0) == 7.0
+    assert percentile([1.0, 3.0], 50.0) == 2.0

@@ -1,0 +1,52 @@
+"""CSV monitor round-trip: the handle cache must actually be used (one open
+per metric, not per event) and flush()/close() must manage the handles."""
+
+import csv
+import os
+
+from deepspeed_tpu.monitor.monitor import CSVMonitor
+from deepspeed_tpu.runtime.config import CSVConfig
+from deepspeed_tpu.runtime.config_utils import from_dict
+
+
+def _monitor(tmp_path):
+    cfg = from_dict(CSVConfig, {"enabled": True, "output_path": str(tmp_path),
+                                "job_name": "job"})
+    return CSVMonitor(cfg)
+
+
+def test_csv_round_trip_single_header(tmp_path):
+    mon = _monitor(tmp_path)
+    mon.write_events([("Train/Samples/lr", 0.01, 8)])
+    mon.write_events([("Train/Samples/lr", 0.02, 16),
+                      ("Train/Samples/train_loss", 0.5, 16)])
+    mon.flush()
+    fname = os.path.join(str(tmp_path), "job", "Train_Samples_lr.csv")
+    with open(fname) as fh:
+        rows = list(csv.reader(fh))
+    # exactly one header even across multiple write_events calls
+    assert rows[0] == ["step", "Train/Samples/lr"]
+    assert rows[1:] == [["8", "0.01"], ["16", "0.02"]]
+
+
+def test_csv_handles_are_cached_not_reopened(tmp_path):
+    mon = _monitor(tmp_path)
+    mon.write_events([("m", 1.0, 1)])
+    fh_first = mon._files["m"]
+    mon.write_events([("m", 2.0, 2)])
+    assert mon._files["m"] is fh_first  # the dead cache is alive now
+    assert len(mon._files) == 1
+    mon.close()
+    assert not mon._files  # close() releases the handles
+
+
+def test_csv_reopen_after_close_appends_without_second_header(tmp_path):
+    mon = _monitor(tmp_path)
+    mon.write_events([("m", 1.0, 1)])
+    mon.close()
+    mon.write_events([("m", 2.0, 2)])
+    mon.flush()
+    fname = os.path.join(str(tmp_path), "job", "m.csv")
+    with open(fname) as fh:
+        rows = list(csv.reader(fh))
+    assert rows == [["step", "m"], ["1", "1.0"], ["2", "2.0"]]

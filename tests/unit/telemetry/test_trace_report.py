@@ -1,0 +1,83 @@
+"""ds_trace_report CLI: aggregation math on the checked-in miniature
+fixture plus a subprocess smoke test so the tool can't silently rot."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+CLI = os.path.join(REPO, "tools", "ds_trace_report.py")
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "mini_trace.jsonl")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import ds_trace_report  # noqa: E402
+
+
+def test_aggregate_fixture():
+    events, skipped = ds_trace_report.load_events(FIXTURE)
+    assert skipped == 0
+    report = ds_trace_report.aggregate(events)
+    steps = report["train_step"]
+    assert steps["fwd_ms"]["count"] == 3
+    assert steps["fwd_ms"]["max"] == 2.5
+    assert steps["fwd_ms"]["p50"] == 1.2
+    # nested comm dict flattens to a dotted metric
+    assert steps["comm_bytes.all_reduce"]["max"] == 4096
+    req = report["inference_request"]
+    assert req["total_ms"]["count"] == 3
+    assert req["ttft_ms"]["count"] == 2  # fused path has no TTFT field
+    # comm_summary ops flatten too
+    assert report["comm_summary"]["ops.all_reduce.total_bytes"]["max"] == 12288
+
+
+def test_kind_filter_and_skip_fields():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    report = ds_trace_report.aggregate(events, kinds=["train_step"])
+    assert list(report) == ["train_step"]
+    assert "ts" not in report["train_step"]  # bookkeeping skipped
+    report_all = ds_trace_report.aggregate(events, kinds=["train_step"],
+                                           all_fields=True)
+    assert "ts" in report_all["train_step"]
+
+
+def test_malformed_lines_are_counted_not_fatal(tmp_path):
+    p = tmp_path / "torn.jsonl"
+    p.write_text('{"schema": 1, "kind": "k", "x": 1.0}\n{"torn...\n')
+    events, skipped = ds_trace_report.load_events(str(p))
+    assert len(events) == 1 and skipped == 1
+
+
+def test_cli_smoke_tables():
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "== train_step (3 events) ==" in out
+    assert "== inference_request (3 events) ==" in out
+    assert "p50" in out and "p95" in out and "max" in out
+    assert "fwd_ms" in out and "ttft_ms" in out and "mfu" in out
+
+
+def test_cli_json_mode():
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--json", "--kind", "inference_request"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert list(report) == ["inference_request"]
+    assert report["inference_request"]["total_ms"]["count"] == 3
+
+
+def test_cli_missing_file_exit_code():
+    proc = subprocess.run(
+        [sys.executable, CLI, "/nonexistent/trace.jsonl"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2

@@ -1,0 +1,135 @@
+"""Training telemetry end-to-end: a short SimpleModel run with the
+``telemetry`` block enabled must produce a JSONL trace whose step events
+carry non-zero phase times, an MFU estimate, and comm-volume counters —
+and with the block absent (default) training must be bit-identical and
+write nothing."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from simple_model import SimpleModel, random_batch
+
+HIDDEN = 16
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 5,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"data": 1, "fsdp": -1},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run(config, steps=5, seed=0):
+    comm.destroy()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HIDDEN), config=config)
+    losses = []
+    for i in range(steps):
+        batch = random_batch(8, HIDDEN, seed=seed + i)
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses, engine
+
+
+def read_events(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def test_train_trace_schema_and_contents(tmp_path):
+    trace = str(tmp_path / "trace.jsonl")
+    _, engine = run(base_config(telemetry={"enabled": True, "trace_file": trace}), steps=5)
+    events = read_events(trace)
+    steps = [e for e in events if e["kind"] == "train_step"]
+    assert [e["step"] for e in steps] == [1, 2, 3, 4, 5]
+    for ev in steps:
+        assert ev["schema"] == 1
+        assert ev["role"] == "train"
+        assert ev["fwd_ms"] > 0.0
+        assert ev["step_ms"] > 0.0
+        assert ev["iter_ms"] >= ev["fwd_ms"]
+        assert "mfu" in ev and ev["mfu"] >= 0.0
+        assert ev["model_flops_per_step"] > 0.0  # XLA cost_analysis path
+        assert isinstance(ev["comm_bytes"], dict)
+        assert "comm_bytes_total" in ev
+        assert ev["samples_per_sec"] > 0.0
+        assert "loss" in ev and "grad_norm" in ev and "lr" in ev
+    # registry aggregated the same fields for summary()
+    hist = engine.telemetry_summary()["metrics"]["histograms"]
+    assert hist["train_step.fwd_ms"]["count"] == 5
+    assert hist["train_step.fwd_ms"]["p95"] >= hist["train_step.fwd_ms"]["p50"] > 0.0
+
+
+def test_comm_summary_accessor_and_event(tmp_path):
+    trace = str(tmp_path / "trace.jsonl")
+    _, engine = run(base_config(telemetry={"enabled": True, "trace_file": trace}), steps=5)
+    # accessor mirrors CommsLogger.summary(): dict keyed by op (possibly
+    # empty — the jit-first engine's collectives are GSPMD-inserted, the
+    # logger counts explicit comm.* wrapper calls)
+    summary = engine.comm_summary()
+    assert isinstance(summary, dict)
+    # at a steps_per_print boundary a traced collective surfaces as a
+    # comm_summary event; record wrapper traffic the way the comm.* ops do
+    # at trace time (calling all_reduce outside a traced program would
+    # unbind its axis names) and re-cross a boundary
+    comm.get_comms_logger().append(
+        "all_reduce", np.ones((4,), np.float32), ("data",)
+    )
+    for i in range(5):
+        batch = random_batch(8, HIDDEN, seed=100 + i)
+        engine.backward(engine.forward(batch))
+        engine.step()
+    assert engine.comm_summary()  # wrapper call recorded
+    events = read_events(trace)
+    kinds = [e["kind"] for e in events]
+    assert "comm_summary" in kinds
+    comm_ev = [e for e in events if e["kind"] == "comm_summary"][-1]
+    assert comm_ev["ops"]  # per-op {count, total_bytes, ...}
+    # the step event after the collective carries the volume delta
+    step_after = [e for e in events if e["kind"] == "train_step" and e["step"] == 6][0]
+    assert step_after["comm_bytes_total"] > 0.0
+
+
+def test_disabled_is_default_writes_nothing_and_is_bit_identical(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    ref_losses, engine = run(base_config(), steps=3)
+    assert not engine.telemetry.enabled
+    # nothing telemetry-shaped appeared in the CWD
+    assert not list(tmp_path.glob("*.jsonl"))
+    # enabled run produces bit-identical losses (telemetry only observes)
+    trace = str(tmp_path / "sub" / "trace.jsonl")
+    tele_losses, _ = run(
+        base_config(telemetry={"enabled": True, "trace_file": trace}), steps=3
+    )
+    assert tele_losses == ref_losses  # exact float equality, not allclose
+    assert os.path.exists(trace)
+
+
+def test_profiler_capture_window(tmp_path):
+    trace = str(tmp_path / "trace.jsonl")
+    profdir = str(tmp_path / "xprof")
+    _, engine = run(
+        base_config(telemetry={
+            "enabled": True, "trace_file": trace,
+            "profile_start_step": 2, "profile_num_steps": 1,
+            "profile_dir": profdir,
+        }),
+        steps=4,
+    )
+    # the capture window opened and closed without disturbing training,
+    # and left a device-trace dump behind
+    assert not engine.telemetry._profiling
+    assert os.path.isdir(profdir) and os.listdir(profdir)

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Render per-metric p50/p95/max tables from a telemetry JSONL trace.
+
+The trace is what the engines write with the ``telemetry`` config block
+enabled (``docs/telemetry.md``): one JSON event per line, each carrying
+``"schema": 1`` and a ``"kind"`` discriminator ("train_step",
+"inference_request", "comm_summary", ...). This CLI aggregates every
+numeric field per kind — nested dicts flatten to dotted names
+(``comm_bytes.all_reduce``) — and prints count/mean/p50/p95/max tables.
+
+Usage:
+    python tools/ds_trace_report.py runs/trace.jsonl
+    python tools/ds_trace_report.py runs/trace.jsonl --kind train_step
+    python tools/ds_trace_report.py runs/trace.jsonl --json   # machine-readable
+
+Deliberately stdlib-only (no jax/numpy import): runs anywhere, including
+laptops holding traces scp'd off a pod.
+"""
+
+import argparse
+import json
+import sys
+
+SUPPORTED_SCHEMA = 1
+# bookkeeping fields that aren't latencies/rates — excluded from tables
+# unless --all-fields asks for them
+_SKIP_FIELDS = {"schema", "ts", "request", "step", "micro_steps", "samples"}
+
+
+def percentile(sorted_vals, q):
+    """Linear-interpolated percentile over an ALREADY SORTED list."""
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    rank = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return float(sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac)
+
+
+def flatten_numeric(event, prefix=""):
+    """Yield (dotted_name, float) for every numeric field, recursing into
+    nested dicts (comm_bytes, comm_summary ops...). Bools excluded."""
+    for key, value in event.items():
+        name = f"{prefix}{key}"
+        if isinstance(value, bool):
+            continue
+        if isinstance(value, (int, float)):
+            yield name, float(value)
+        elif isinstance(value, dict):
+            yield from flatten_numeric(value, prefix=f"{name}.")
+
+
+def load_events(path):
+    """(events, skipped_lines): parsed event dicts + malformed-line count
+    (a crashed writer may leave a torn last line)."""
+    events, skipped = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def aggregate(events, kinds=None, all_fields=False):
+    """{kind: {field: {count, mean, p50, p95, max}}} over numeric fields."""
+    by_kind = {}
+    for ev in events:
+        kind = ev.get("kind", "?")
+        if kinds and kind not in kinds:
+            continue
+        fields = by_kind.setdefault(kind, {})
+        for name, value in flatten_numeric(ev):
+            if not all_fields and name in _SKIP_FIELDS:
+                continue
+            fields.setdefault(name, []).append(value)
+    report = {}
+    for kind, fields in by_kind.items():
+        report[kind] = {}
+        for name, vals in sorted(fields.items()):
+            vals.sort()
+            report[kind][name] = {
+                "count": len(vals),
+                "mean": sum(vals) / len(vals),
+                "p50": percentile(vals, 50.0),
+                "p95": percentile(vals, 95.0),
+                "max": vals[-1],
+            }
+    return report
+
+
+def _fmt(v):
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e6 or abs(v) < 1e-3:
+        return f"{v:.3e}"
+    return f"{v:,.3f}".rstrip("0").rstrip(".")
+
+
+def format_tables(report):
+    lines = []
+    for kind in sorted(report):
+        fields = report[kind]
+        if not fields:
+            continue
+        n_events = max(stats["count"] for stats in fields.values())
+        lines.append(f"== {kind} ({n_events} events) ==")
+        name_w = max(len("metric"), max(len(n) for n in fields))
+        cols = ("count", "mean", "p50", "p95", "max")
+        col_w = 12
+        header = "metric".ljust(name_w) + "".join(c.rjust(col_w) for c in cols)
+        lines.append(header)
+        lines.append("-" * len(header))
+        for name, stats in fields.items():
+            row = name.ljust(name_w)
+            row += str(stats["count"]).rjust(col_w)
+            for c in ("mean", "p50", "p95", "max"):
+                row += _fmt(stats[c]).rjust(col_w)
+            lines.append(row)
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="p50/p95/max tables from a deepspeed_tpu telemetry JSONL trace"
+    )
+    ap.add_argument("trace", help="path to the JSONL trace file")
+    ap.add_argument("--kind", action="append", default=None,
+                    help="restrict to this event kind (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the aggregate as JSON instead of tables")
+    ap.add_argument("--all-fields", action="store_true",
+                    help="include bookkeeping fields (ts, step, ...)")
+    args = ap.parse_args(argv)
+
+    try:
+        events, skipped = load_events(args.trace)
+    except OSError as e:
+        print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    newer = sum(1 for ev in events if ev.get("schema", 0) > SUPPORTED_SCHEMA)
+    if newer:
+        print(f"warning: {newer} events use a schema newer than "
+              f"{SUPPORTED_SCHEMA}; fields may be missing from this report",
+              file=sys.stderr)
+    if skipped:
+        print(f"warning: skipped {skipped} malformed line(s)", file=sys.stderr)
+    if not events:
+        print(f"no events in {args.trace}", file=sys.stderr)
+        return 1
+
+    report = aggregate(events, kinds=args.kind, all_fields=args.all_fields)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        sys.stdout.write(format_tables(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
