@@ -1,0 +1,42 @@
+"""ds-lint: JAX/TPU-aware static analysis for the deepspeed_tpu stack.
+
+AST-only (never imports the linted code), stdlib-only, and loadable
+standalone via ``tools/ds_lint.py`` — every intra-package import here must
+stay *relative* so the package also works under an alias name without
+executing ``deepspeed_tpu/__init__``. See docs/static_analysis.md.
+
+Entry points:
+    python -m deepspeed_tpu.analysis [args]
+    ds-lint [args]                      (pyproject console script)
+    python tools/ds_lint.py [args]      (no jax / package import needed)
+"""
+
+from .baseline import Baseline
+from .cli import main as cli_main
+from .core import (
+    Analyzer,
+    AnalysisResult,
+    Finding,
+    ModuleContext,
+    Rule,
+    SEVERITY_ERROR,
+    SEVERITY_INFO,
+    SEVERITY_WARNING,
+)
+from .rules import all_rules, make_rules, rules_by_id
+
+__all__ = [
+    "Analyzer",
+    "AnalysisResult",
+    "Baseline",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "SEVERITY_ERROR",
+    "SEVERITY_INFO",
+    "SEVERITY_WARNING",
+    "all_rules",
+    "cli_main",
+    "make_rules",
+    "rules_by_id",
+]

@@ -1,0 +1,7 @@
+"""``python -m deepspeed_tpu.analysis`` — the ds-lint CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,80 @@
+"""Baseline files: accepted pre-existing findings, so the tier-1 gate only
+fails on *new* debt.
+
+Matching is by ``(rule, path, code)`` — the stripped source line, not the
+line number — so unrelated edits that shift a file don't invalidate the
+baseline; moving or editing the offending line *does* (by design: touched
+code must come clean or carry an explicit suppression). Entries are a
+multiset: two identical offending lines need two baseline entries.
+"""
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+
+BASELINE_VERSION = 1
+
+
+@dataclass
+class Baseline:
+    entries: list = field(default_factory=list)  # raw dicts (rule/path/line/code)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        if data.get("version") != BASELINE_VERSION:
+            raise ValueError(
+                f"baseline {path}: unsupported version {data.get('version')!r} "
+                f"(expected {BASELINE_VERSION})"
+            )
+        return cls(entries=list(data.get("findings", [])))
+
+    @classmethod
+    def from_findings(cls, findings, root: str = "") -> "Baseline":
+        entries = [
+            {
+                "rule": f.rule_id,
+                "path": _rel(f.path, root),
+                "line": f.line,
+                "code": f.code,
+            }
+            for f in findings
+        ]
+        entries.sort(key=lambda e: (e["path"], e["line"], e["rule"]))
+        return cls(entries=entries)
+
+    def save(self, path: str):
+        payload = {
+            "version": BASELINE_VERSION,
+            "findings": self.entries,
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    def split_new(self, findings, root: str = ""):
+        """(new, baselined) partition of ``findings``."""
+        budget = Counter(
+            (e.get("rule"), e.get("path"), e.get("code")) for e in self.entries
+        )
+        new, baselined = [], []
+        for f in findings:
+            key = (f.rule_id, _rel(f.path, root), f.code)
+            if budget.get(key, 0) > 0:
+                budget[key] -= 1
+                baselined.append(f)
+            else:
+                new.append(f)
+        return new, baselined
+
+
+def _rel(path: str, root: str) -> str:
+    """Baseline paths are stored relative to the lint root, '/' separated."""
+    if root:
+        try:
+            path = os.path.relpath(path, root)
+        except ValueError:  # different drive on windows
+            pass
+    return path.replace(os.sep, "/")

@@ -1,0 +1,234 @@
+"""ds-lint CLI.
+
+Exit codes: 0 = clean (no findings outside the baseline), 1 = new
+findings, 2 = usage / IO error. ``--write-baseline`` accepts the current
+state: it rewrites the baseline with every present finding and exits 0.
+
+    ds-lint deepspeed_tpu/                      # text report
+    ds-lint --format json deepspeed_tpu/        # machine-readable
+    ds-lint --rule host-sync-in-jit file.py     # one rule only
+    ds-lint --baseline tools/ds_lint_baseline.json --write-baseline ...
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import Baseline
+from .core import Analyzer
+from .rules import make_rules, rules_by_id
+
+_DEFAULT_BASELINE = os.path.join("tools", "ds_lint_baseline.json")
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="ds-lint",
+        description="JAX/TPU-aware static analysis for the deepspeed_tpu stack",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the deepspeed_tpu "
+             "package next to this checkout's tools/)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="RULE_ID",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline JSON of accepted findings (default: "
+             f"{_DEFAULT_BASELINE} under the repo root when present)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline; report and fail on every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline file accepting all current findings",
+    )
+    parser.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="directory baseline paths are relative to (default: the "
+             "common parent of the linted paths)",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    return parser
+
+
+def _default_paths():
+    """`deepspeed_tpu` package sitting next to this file's repo checkout,
+    else the current directory."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if os.path.basename(here) == "deepspeed_tpu":
+        return [here]
+    return ["."]
+
+
+_ROOT_MARKERS = (os.path.join("tools", "ds_lint_baseline.json"), "pyproject.toml", ".git")
+
+
+def _infer_root(paths):
+    """Walk up from the linted paths to the enclosing repo root (marked by
+    the baseline file / pyproject / .git) so `ds-lint some/deep/file.py`
+    still finds the checked-in baseline and matches its root-relative
+    paths. Falls back to the paths' common parent when no marker exists."""
+    absolutes = [os.path.abspath(p) for p in paths]
+    start = os.path.commonpath(absolutes)
+    if not os.path.isdir(start):
+        start = os.path.dirname(start)
+    probe = start
+    while True:
+        if any(os.path.exists(os.path.join(probe, m)) for m in _ROOT_MARKERS):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    if len(absolutes) == 1:
+        return os.path.dirname(start) or start
+    return start
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, cls in sorted(rules_by_id().items()):
+            print(f"{rule_id:24s} [{cls.severity}] {cls.description}")
+        return 0
+
+    try:
+        rules = make_rules(args.rule)
+    except ValueError as exc:
+        print(f"ds-lint: {exc}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or _default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"ds-lint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    root = os.path.abspath(args.root) if args.root else _infer_root(paths)
+    result = Analyzer(rules).check_paths(paths)
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        candidate = os.path.join(root, _DEFAULT_BASELINE)
+        if os.path.exists(candidate):
+            baseline_path = candidate
+
+    if args.write_baseline:
+        if args.rule:
+            # a filtered run sees only a slice of the findings; writing it
+            # out would silently drop every other rule's accepted entries
+            print("ds-lint: --write-baseline cannot be combined with --rule "
+                  "(it would erase other rules' baseline entries)", file=sys.stderr)
+            return 2
+        if baseline_path is None:
+            baseline_path = os.path.join(root, _DEFAULT_BASELINE)
+        fresh = Baseline.from_findings(result.findings, root=root)
+        # merge: entries for files OUTSIDE the linted scope are preserved —
+        # `ds-lint --write-baseline some/file.py` must only rewrite that
+        # file's entries, not truncate the repo baseline
+        if os.path.exists(baseline_path):
+            try:
+                existing = Baseline.load(baseline_path)
+            except (OSError, ValueError, json.JSONDecodeError) as exc:
+                print(f"ds-lint: cannot read baseline: {exc}", file=sys.stderr)
+                return 2
+            kept = [
+                e for e in existing.entries
+                if not _path_in_scope(os.path.join(root, e.get("path", "")), paths)
+            ]
+            fresh.entries = sorted(
+                kept + fresh.entries,
+                key=lambda e: (e.get("path", ""), e.get("line", 0), e.get("rule", "")),
+            )
+        fresh.save(baseline_path)
+        print(f"ds-lint: wrote {len(fresh.entries)} finding(s) to {baseline_path}")
+        return 0
+
+    if baseline_path is not None and not args.no_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"ds-lint: cannot read baseline: {exc}", file=sys.stderr)
+            return 2
+        new, baselined = baseline.split_new(result.findings, root=root)
+    else:
+        new, baselined = result.findings, []
+
+    report = _build_report(result, new, baselined, root)
+    if args.fmt == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        _print_text(report)
+    return 1 if new or result.parse_errors else 0
+
+
+def _path_in_scope(abs_path, scope_paths):
+    abs_path = os.path.abspath(abs_path)
+    for p in scope_paths:
+        p = os.path.abspath(p)
+        if abs_path == p or abs_path.startswith(p.rstrip(os.sep) + os.sep):
+            return True
+    return False
+
+
+def _build_report(result, new, baselined, root):
+    def rel(f):
+        d = f.to_dict()
+        try:
+            d["path"] = os.path.relpath(os.path.abspath(f.path), root).replace(os.sep, "/")
+        except ValueError:
+            pass
+        return d
+
+    by_rule = {}
+    for f in new:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    return {
+        "version": 1,
+        "findings": [rel(f) for f in new],
+        "summary": {
+            "files_checked": result.files_checked,
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": result.suppressed,
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+        "parse_errors": [
+            {"path": p, "error": e} for p, e in result.parse_errors
+        ],
+    }
+
+
+def _print_text(report):
+    for f in report["findings"]:
+        print(
+            f"{f['path']}:{f['line']}:{f['col']}: [{f['severity']}] "
+            f"{f['rule']}: {f['message']}"
+        )
+        if f["code"]:
+            print(f"    {f['code']}")
+    for err in report["parse_errors"]:
+        print(f"{err['path']}: parse error: {err['error']}")
+    s = report["summary"]
+    verdict = "clean" if not report["findings"] and not report["parse_errors"] else "FAIL"
+    print(
+        f"ds-lint: {s['files_checked']} file(s), {s['new']} new finding(s), "
+        f"{s['baselined']} baselined, {s['suppressed']} suppressed — {verdict}"
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,248 @@
+"""ds-lint core: Finding records, the Rule protocol, suppression comments,
+and the per-module analysis driver.
+
+Design constraints (docs/static_analysis.md):
+
+- **Pure AST, zero imports of the linted code.** Rules see source text and
+  an ``ast`` tree, never live objects, so linting ``deepspeed_tpu/`` cannot
+  trigger jax initialization, TPU discovery, or import-time side effects —
+  and the CLI runs on machines without jax installed.
+- **Relative imports only** inside ``deepspeed_tpu.analysis`` so
+  ``tools/ds_lint.py`` can load the package standalone (stdlib-only,
+  without executing ``deepspeed_tpu/__init__``).
+- Findings are value objects keyed by ``(rule, path, code)`` — the stripped
+  source line, not the line *number* — so baselines survive unrelated edits
+  that shift lines.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+SEVERITY_INFO = "info"
+
+_SEVERITY_ORDER = {SEVERITY_ERROR: 0, SEVERITY_WARNING: 1, SEVERITY_INFO: 2}
+
+# `# ds-lint: disable=rule-a,rule-b` — trailing on the flagged line, or a
+# standalone comment line directly above it. `disable=all` mutes every rule.
+_SUPPRESS_RE = re.compile(r"#\s*ds-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*ds-lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete source location."""
+
+    rule_id: str
+    severity: str
+    path: str  # as given to the analyzer (relative paths stay relative)
+    line: int  # 1-based
+    col: int  # 0-based, ast convention
+    message: str
+    code: str = ""  # stripped source line — the baseline match key
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "path": _norm_path(self.path),
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "code": self.code,
+        }
+
+
+def _norm_path(path: str) -> str:
+    """Forward-slash relative-ish path so baselines are portable."""
+    return path.replace(os.sep, "/")
+
+
+class Rule:
+    """Base class for ds-lint rules.
+
+    Subclasses set ``id`` (kebab-case slug — also the suppression token),
+    ``severity``, ``description``, and implement ``check(ctx)`` yielding
+    :class:`Finding` objects. Rules must not mutate ``ctx``.
+    """
+
+    id = "abstract-rule"
+    severity = SEVERITY_WARNING
+    description = ""
+
+    def check(self, ctx: "ModuleContext"):
+        raise NotImplementedError
+
+    def finding(self, ctx: "ModuleContext", node, message: str, severity=None) -> Finding:
+        """Build a Finding anchored at ``node`` (any object with .lineno)."""
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule_id=self.id,
+            severity=severity or self.severity,
+            path=ctx.path,
+            line=line,
+            col=col,
+            message=message,
+            code=ctx.code_at(line),
+        )
+
+
+@dataclass
+class ModuleContext:
+    """Everything a rule may inspect about one source file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list = field(default_factory=list)
+    _cache: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_source(cls, source: str, path: str = "<string>") -> "ModuleContext":
+        tree = ast.parse(source, filename=path)
+        return cls(path=path, source=source, tree=tree, lines=source.splitlines())
+
+    @classmethod
+    def from_file(cls, path: str) -> "ModuleContext":
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls.from_source(fh.read(), path=path)
+
+    def code_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def cached(self, key, builder):
+        """Memoize expensive per-module indexes (e.g. the jit index) so
+        multiple rules share one tree walk."""
+        if key not in self._cache:
+            self._cache[key] = builder(self)
+        return self._cache[key]
+
+    # -- suppressions ---------------------------------------------------
+    def suppressed_rules_for_line(self, line: int):
+        table = self.cached("_suppress", lambda c: c._build_suppressions())
+        return table["file"] | table["lines"].get(line, set())
+
+    def _build_suppressions(self):
+        lines_table = {}
+        file_level = set()
+        for idx, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                file_level |= _split_rule_list(m.group(1))
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = _split_rule_list(m.group(1))
+            lines_table.setdefault(idx, set()).update(rules)
+            if text.lstrip().startswith("#"):
+                # standalone comment line: applies to the next line too
+                lines_table.setdefault(idx + 1, set()).update(rules)
+        return {"file": file_level, "lines": lines_table}
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        active = self.suppressed_rules_for_line(finding.line)
+        return "all" in active or finding.rule_id in active
+
+
+def _split_rule_list(raw: str):
+    return {token.strip() for token in raw.split(",") if token.strip()}
+
+
+@dataclass
+class AnalysisResult:
+    """Outcome of one analyzer run over one or more files."""
+
+    findings: list = field(default_factory=list)  # unsuppressed
+    suppressed: int = 0
+    parse_errors: list = field(default_factory=list)  # (path, message)
+    files_checked: int = 0
+
+    def sorted_findings(self):
+        return sorted(
+            self.findings,
+            key=lambda f: (_SEVERITY_ORDER.get(f.severity, 9), f.path, f.line, f.rule_id),
+        )
+
+
+class Analyzer:
+    """Runs a rule set over files/directories/sources."""
+
+    def __init__(self, rules=None):
+        if rules is None:
+            from .rules import all_rules
+
+            rules = all_rules()
+        self.rules = list(rules)
+
+    def check_source(self, source: str, path: str = "<string>") -> AnalysisResult:
+        result = AnalysisResult()
+        self._check_ctx_into(ModuleContext.from_source(source, path=path), result)
+        result.files_checked = 1
+        return result
+
+    def check_paths(self, paths) -> AnalysisResult:
+        result = AnalysisResult()
+        for filename in iter_python_files(paths):
+            try:
+                ctx = ModuleContext.from_file(filename)
+            except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+                result.parse_errors.append((filename, str(exc)))
+                continue
+            result.files_checked += 1
+            self._check_ctx_into(ctx, result)
+        result.findings = result.sorted_findings()
+        return result
+
+    def _check_ctx_into(self, ctx: ModuleContext, result: AnalysisResult):
+        for rule in self.rules:
+            for finding in rule.check(ctx):
+                if ctx.is_suppressed(finding):
+                    result.suppressed += 1
+                else:
+                    result.findings.append(finding)
+
+
+def iter_python_files(paths):
+    """Expand files/dirs into a deterministic .py file list (skips hidden
+    dirs and __pycache__)."""
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if not d.startswith(".") and d != "__pycache__"
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+# -- shared AST helpers used by several rules ---------------------------
+
+def dotted_name(node) -> str:
+    """'jax.experimental.pjit.pjit' for nested Attribute/Name chains, ''
+    when the node is not a plain dotted chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node) -> str:
+    """Last path component of a dotted chain ('pjit'), or '' if not one."""
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""

@@ -1,0 +1,268 @@
+"""Module-level index of jit-compiled contexts.
+
+Several rules need the same question answered: *which function bodies in
+this module execute under trace* (``jax.jit`` / ``pjit`` / ``shard_map``)?
+This walks the tree once per module and records:
+
+- functions carrying a jit-ish decorator (``@jax.jit``, ``@pjit``,
+  ``@partial(jax.jit, static_argnums=...)``, ``@shard_map(...)``)
+- lambdas passed directly to a jit-ish call (``jax.jit(lambda p, t: ...)``)
+- named functions wrapped by a jit-ish call in the same module
+  (``fast = jax.jit(slow)``)
+
+plus, per context, the *static* argument names (``static_argnums`` /
+``static_argnames``) — values Python may branch on without retracing — and
+any ``donate_argnums`` declared at the wrap site.
+"""
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import terminal_name
+
+JIT_WRAPPER_NAMES = {"jit", "pjit", "shard_map"}
+PARTIAL_NAMES = {"partial"}
+
+
+@dataclass
+class JitContext:
+    """One function/lambda whose body runs under trace."""
+
+    node: object  # ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    name: str  # '' for lambdas
+    wrapper: str  # 'jit' | 'pjit' | 'shard_map'
+    static_argnames: set = field(default_factory=set)
+    donate_argnums: tuple = ()
+    # names of enclosing-function locals visible to this context (closure
+    # candidates), mapped to the value node they were last assigned
+    enclosing_locals: dict = field(default_factory=dict)
+
+    def param_names(self):
+        args = self.node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return names
+
+    def traced_param_names(self):
+        """Parameters whose values are traced (non-static) inside the body."""
+        names = self.param_names()
+        static = set(self.static_argnames)
+        # static_argnums indexes positional params (self-style first args
+        # included — jit'd methods are rare here but handled)
+        pos = self.node.args.posonlyargs + self.node.args.args
+        for i in getattr(self, "_static_argnums", ()):  # set by the builder
+            if 0 <= i < len(pos):
+                static.add(pos[i].arg)
+        return [n for n in names if n not in static]
+
+
+def _jit_wrapper_of(call_or_deco):
+    """'jit'/'pjit'/'shard_map' when the node is a jit-ish reference or a
+    call resolving to one (directly or through functools.partial)."""
+    node = call_or_deco
+    if isinstance(node, ast.Call):
+        head = terminal_name(node.func)
+        if head in JIT_WRAPPER_NAMES:
+            return head
+        if head in PARTIAL_NAMES and node.args:
+            inner = terminal_name(node.args[0])
+            if inner in JIT_WRAPPER_NAMES:
+                return inner
+        return None
+    head = terminal_name(node)
+    return head if head in JIT_WRAPPER_NAMES else None
+
+
+def _literal_int_tuple(node):
+    """(1, 2) / [0] / 0 -> tuple of ints, else ()."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_str_set(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _static_info(call):
+    """(static_argnums, static_argnames, donate_argnums) from a jit call's
+    keywords — looking through functools.partial."""
+    nums, names, donate = (), set(), ()
+    if not isinstance(call, ast.Call):
+        return nums, names, donate
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _literal_int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _literal_str_set(kw.value)
+        elif kw.arg == "donate_argnums":
+            donate = _literal_int_tuple(kw.value)
+    return nums, names, donate
+
+
+class _MutableLocalTracker(ast.NodeVisitor):
+    """Records, for each function scope, locals assigned unhashable values
+    (list/dict/set literals or constructors) — closure-capture candidates."""
+
+    MUTABLE_CTORS = {"list", "dict", "set", "defaultdict", "OrderedDict", "Counter"}
+
+    @classmethod
+    def is_mutable_value(cls, node):
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and terminal_name(node.func) in cls.MUTABLE_CTORS:
+            return True
+        return False
+
+
+@dataclass
+class JitIndex:
+    contexts: list = field(default_factory=list)
+    # function name -> donated positions, for call-site donation analysis
+    donating_callables: dict = field(default_factory=dict)
+
+    def context_nodes(self):
+        return {id(ctx.node): ctx for ctx in self.contexts}
+
+
+def build_jit_index(ctx) -> JitIndex:
+    """Build (and cache) the JitIndex for a ModuleContext."""
+    return ctx.cached("jit_index", lambda c: _build(c.tree))
+
+
+def _build(tree) -> JitIndex:
+    index = JitIndex()
+    funcs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs_by_name.setdefault(node.name, node)
+
+    # enclosing-scope mutable locals: map each function node -> {name: value}.
+    # Scoped walk — a nested function's own locals must not leak into the
+    # enclosing function's table (they'd self-report as closures).
+    def _own_statements(fn):
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                continue  # nested scope owns its locals
+            stack.extend(ast.iter_child_nodes(node))
+
+    mutable_locals = {}
+    for fn in funcs_by_name.values():
+        found = {}
+        for stmt in _own_statements(fn):
+            if isinstance(stmt, ast.Assign) and _MutableLocalTracker.is_mutable_value(stmt.value):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        found[target.id] = stmt.value
+        mutable_locals[id(fn)] = found
+
+    def enclosing_mutables(parents):
+        merged = {}
+        for p in parents:
+            merged.update(mutable_locals.get(id(p), {}))
+        return merged
+
+    # Pass 1: decorated defs. Track the stack of enclosing function defs so
+    # nested jit'd helpers know their closure candidates.
+    def visit(node, parents):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                wrapper = _jit_wrapper_of(deco)
+                if wrapper:
+                    nums, names, donate = _static_info(deco)
+                    jc = JitContext(
+                        node=node,
+                        name=node.name,
+                        wrapper=wrapper,
+                        static_argnames=names,
+                        donate_argnums=donate,
+                        enclosing_locals=enclosing_mutables(parents),
+                    )
+                    jc._static_argnums = nums
+                    index.contexts.append(jc)
+                    if donate:
+                        index.donating_callables[node.name] = donate
+                    break
+            parents = parents + [node]
+        for child in ast.iter_child_nodes(node):
+            visit(child, parents)
+
+    visit(tree, [])
+
+    # Pass 2: wrap calls — jax.jit(fn, ...), jax.jit(lambda: ...), and
+    # assignments like `fast = jax.jit(step, donate_argnums=(1,))`.
+    class WrapVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self._parents = []
+
+        def visit_FunctionDef(self, node):
+            self._parents.append(node)
+            self.generic_visit(node)
+            self._parents.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            wrapper = _jit_wrapper_of(node)
+            if wrapper and node.args:
+                target = node.args[0]
+                nums, names, donate = _static_info(node)
+                wrapped = None
+                wrapped_name = ""
+                if isinstance(target, ast.Lambda):
+                    wrapped = target
+                elif isinstance(target, ast.Name) and target.id in funcs_by_name:
+                    wrapped = funcs_by_name[target.id]
+                    wrapped_name = target.id
+                if wrapped is not None and id(wrapped) not in index.context_nodes():
+                    jc = JitContext(
+                        node=wrapped,
+                        name=wrapped_name,
+                        wrapper=wrapper,
+                        static_argnames=names,
+                        donate_argnums=donate,
+                        enclosing_locals=enclosing_mutables(self._parents),
+                    )
+                    jc._static_argnums = nums
+                    index.contexts.append(jc)
+                if donate and wrapped_name:
+                    index.donating_callables[wrapped_name] = donate
+            self.generic_visit(node)
+
+    WrapVisitor().visit(tree)
+
+    # Pass 3: names bound to donating jit calls — `f = jax.jit(g, donate_argnums=...)`
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            wrapper = _jit_wrapper_of(node.value)
+            if not wrapper:
+                continue
+            _, _, donate = _static_info(node.value)
+            if not donate:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    index.donating_callables[target.id] = donate
+                elif isinstance(target, ast.Attribute):
+                    index.donating_callables[terminal_name(target)] = donate
+    return index

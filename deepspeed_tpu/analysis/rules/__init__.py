@@ -1,0 +1,44 @@
+"""Rule registry. Adding a rule = subclass :class:`~..core.Rule` in a
+module here and append it to ``_RULE_CLASSES`` (docs/static_analysis.md
+walks through it)."""
+
+from .donation import DonatedBufferReuseRule
+from .host_sync import HostSyncInJitRule
+from .module_state import ModuleMutableStateRule
+from .partition_spec import PartitionSpecAxisRule
+from .pyhygiene import BareExceptRule, MutableDefaultArgRule
+from .recompile import RecompileHazardRule
+from .timing import UnsyncedTimingRule
+
+_RULE_CLASSES = [
+    HostSyncInJitRule,
+    UnsyncedTimingRule,
+    RecompileHazardRule,
+    PartitionSpecAxisRule,
+    DonatedBufferReuseRule,
+    MutableDefaultArgRule,
+    BareExceptRule,
+    ModuleMutableStateRule,
+]
+
+
+def all_rules():
+    """Fresh instances of every registered rule."""
+    return [cls() for cls in _RULE_CLASSES]
+
+
+def rules_by_id():
+    return {cls.id: cls for cls in _RULE_CLASSES}
+
+
+def make_rules(only=None):
+    """Instances filtered to ``only`` ids (iterable of slugs); unknown ids
+    raise ValueError with the known set in the message."""
+    if not only:
+        return all_rules()
+    table = rules_by_id()
+    unknown = [rid for rid in only if rid not in table]
+    if unknown:
+        known = ", ".join(sorted(table))
+        raise ValueError(f"unknown rule id(s) {unknown}; known: {known}")
+    return [table[rid]() for rid in only]

@@ -1,0 +1,128 @@
+"""donated-buffer-reuse: reading an argument after jit donated its buffer.
+
+``jax.jit(f, donate_argnums=(0,))`` lets XLA alias the input buffer into
+the output — after the call, the Python array object still exists but its
+buffer is deleted; touching it raises "Array has been deleted" (and only
+at run time, often on a different line than the mistake). This rule does a
+linear scan per function: at each call to a known donating callable it
+records which local names were passed in donated positions, then flags any
+later *read* of those names before they are rebound.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_ERROR, terminal_name
+from ..jit_index import build_jit_index
+
+
+class DonatedBufferReuseRule(Rule):
+    id = "donated-buffer-reuse"
+    severity = SEVERITY_ERROR
+    description = (
+        "variable passed in a donate_argnums position is read again after "
+        "the call — its buffer was donated and is deleted"
+    )
+
+    def check(self, ctx):
+        index = build_jit_index(ctx)
+        if not index.donating_callables:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, node, index.donating_callables)
+
+    def _check_function(self, ctx, func, donating):
+        # linear scan over (expressions, rebound names) events in source
+        # order; tracks name -> (donation line, callee)
+        donated = {}
+        for exprs, assigned_targets in _scoped_events(func):
+            # 1) reads of already-donated names in this event
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if (
+                        isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in donated
+                    ):
+                        line, callee = donated[node.id]
+                        yield self.finding(
+                            ctx, node,
+                            f"'{node.id}' was donated to '{callee}' on line {line} "
+                            f"— its device buffer is deleted; rebind the result "
+                            f"instead of reusing the input",
+                        )
+                        # report once per donation
+                        donated.pop(node.id, None)
+            # 2) new donations from calls in this event
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = terminal_name(node.func)
+                    positions = donating.get(callee)
+                    if not positions:
+                        continue
+                    for pos in positions:
+                        if 0 <= pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                            name = node.args[pos].id
+                            if name not in assigned_targets:  # x = f(x) rebinds
+                                donated[name] = (node.lineno, callee)
+            # 3) rebinding clears tracking
+            for name in assigned_targets:
+                donated.pop(name, None)
+
+
+def _names_in(target):
+    return {
+        node.id for node in ast.walk(target) if isinstance(node, ast.Name)
+    } if target is not None else set()
+
+
+def _scoped_events(func):
+    """Yield (expressions, rebound-name set) per executable event in source
+    order — simple statements whole, compound statements *header only* (the
+    body statements become their own events), nested scopes excluded."""
+    events = []
+
+    def collect(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scope: analyzed separately
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                events.append((stmt.lineno, [stmt.iter], _names_in(stmt.target)))
+                collect(stmt.body)
+                collect(stmt.orelse)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                events.append((stmt.lineno, [stmt.test], set()))
+                collect(stmt.body)
+                collect(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                assigned = set()
+                exprs = []
+                for item in stmt.items:
+                    exprs.append(item.context_expr)
+                    assigned |= _names_in(item.optional_vars)
+                events.append((stmt.lineno, exprs, assigned))
+                collect(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                collect(stmt.body)
+                for handler in stmt.handlers:
+                    collect(handler.body)
+                collect(stmt.orelse)
+                collect(stmt.finalbody)
+            else:
+                assigned = set()
+                if isinstance(stmt, ast.Assign):
+                    for target in stmt.targets:
+                        assigned |= _names_in(target)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    assigned |= _names_in(stmt.target)
+                events.append((stmt.lineno, list(ast.iter_child_nodes(stmt)), assigned))
+            # del x also ends the name's life — treat as rebinding
+            if isinstance(stmt, ast.Delete):
+                events.append((stmt.lineno, [], set().union(*map(_names_in, stmt.targets))))
+
+    collect(func.body)
+    events.sort(key=lambda e: e[0])
+    for _, exprs, assigned in events:
+        yield exprs, assigned

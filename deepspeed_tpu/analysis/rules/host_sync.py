@@ -1,0 +1,80 @@
+"""host-sync-in-jit: host synchronization reachable inside a traced body.
+
+Inside ``jit``/``pjit``/``shard_map`` context, each of these forces the
+trace to materialize a concrete value (a ConcretizationTypeError at best, a
+silent per-call device->host round-trip at worst):
+
+- ``x.item()``
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` on a non-literal argument
+- ``np.asarray(x)`` / ``np.array(x)``
+- ``jax.device_get(x)``
+- ``x.block_until_ready()``
+- ``print(...)`` (runs at trace time, not per step — use ``jax.debug.print``)
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_ERROR, dotted_name, terminal_name
+from ..jit_index import build_jit_index
+
+_CAST_NAMES = {"float", "int", "bool"}
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_NUMPY_FUNCS = {"asarray", "array"}
+
+
+class HostSyncInJitRule(Rule):
+    id = "host-sync-in-jit"
+    severity = SEVERITY_ERROR
+    description = (
+        "host-synchronizing call (.item(), float()/int()/bool() cast, "
+        "np.asarray, jax.device_get, block_until_ready, print) inside a "
+        "jit/pjit/shard_map-traced function"
+    )
+
+    def check(self, ctx):
+        index = build_jit_index(ctx)
+        seen_lines = set()
+        for jc in index.contexts:
+            body = jc.node.body if isinstance(jc.node.body, list) else [jc.node.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    hit = self._host_sync_call(node)
+                    if hit is None:
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in seen_lines:
+                        continue
+                    seen_lines.add(key)
+                    where = jc.name or "<lambda>"
+                    yield self.finding(
+                        ctx, node,
+                        f"{hit} inside {jc.wrapper}-compiled '{where}' forces a "
+                        f"host sync at trace/run time",
+                    )
+
+    @staticmethod
+    def _host_sync_call(node):
+        """Short description when ``node`` is a host-syncing Call, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "item" and not node.args:
+                return ".item()"
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            dn = dotted_name(func)
+            if dn in ("jax.device_get",):
+                return "jax.device_get()"
+            head = dn.split(".")[0] if dn else ""
+            if head in _NUMPY_MODULES and func.attr in _NUMPY_FUNCS:
+                return f"{head}.{func.attr}()"
+            return None
+        name = terminal_name(func)
+        if name == "print":
+            return "print()"
+        if name in _CAST_NAMES and len(node.args) == 1:
+            arg = node.args[0]
+            if not isinstance(arg, ast.Constant):
+                return f"{name}() cast"
+        return None

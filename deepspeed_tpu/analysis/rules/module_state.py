@@ -1,0 +1,90 @@
+"""module-mutable-state: module-level containers mutated from functions.
+
+A module-level ``_CACHE = {}`` mutated from inside engine code is process-
+global state: it aliases across engine instances, leaks across tests, and
+under ``jax.jit`` can be captured at trace time while being mutated at run
+time. Registries populated at import time (decorator-style ``register``)
+are the common legitimate case — suppress those with
+``# ds-lint: disable=module-mutable-state`` or the baseline.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_WARNING
+
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear",
+}
+
+
+class ModuleMutableStateRule(Rule):
+    id = "module-mutable-state"
+    severity = SEVERITY_WARNING
+    description = (
+        "module-level list/dict/set mutated from function code — process-"
+        "global state shared across engines and tests"
+    )
+
+    def check(self, ctx):
+        module_mutables = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set)
+            ):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        module_mutables.add(target.id)
+            elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                from ..core import terminal_name
+
+                if terminal_name(stmt.value.func) in ("list", "dict", "set"):
+                    for target in stmt.targets:
+                        if isinstance(target, ast.Name):
+                            module_mutables.add(target.id)
+        if not module_mutables:
+            return
+
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # locals shadow the module global — collect names bound in this
+            # function (params + assignment targets) and skip them
+            shadowed = {
+                a.arg
+                for a in func.args.posonlyargs + func.args.args + func.args.kwonlyargs
+            }
+            declared_global = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            shadowed.add(target.id)
+            shadowed -= declared_global
+
+            for node in ast.walk(func):
+                hit = None
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MUTATING_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                ):
+                    hit = node.func.value.id
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for target in targets:
+                        if (
+                            isinstance(target, ast.Subscript)
+                            and isinstance(target.value, ast.Name)
+                        ):
+                            hit = target.value.id
+                if hit and hit in module_mutables and hit not in shadowed:
+                    yield self.finding(
+                        ctx, node,
+                        f"module-level mutable '{hit}' mutated inside "
+                        f"'{func.name}' — pass it explicitly or move it onto "
+                        f"an object whose lifetime you control",
+                    )

@@ -1,0 +1,96 @@
+"""partition-spec-axis: PartitionSpec axes that don't exist on the mesh.
+
+``PartitionSpec('modle')`` against a mesh declared with axes
+``('data', 'model')`` is not an error at construction — jax only fails (or
+worse, silently fully replicates under some APIs) when the spec meets the
+mesh. This rule cross-checks every string axis in a ``PartitionSpec``/``P``
+call against the union of mesh axis names *declared as literals in the same
+module*:
+
+- ``Mesh(devices, ('data', 'model'))`` / ``Mesh(..., axis_names=(...))``
+- ``jax.make_mesh((..,), ('data', 'model'))``
+- ``mesh_shape={'data': 1, 'fsdp': -1}`` dict literals (this repo's
+  ``comm.init_distributed`` convention)
+
+Modules that declare no mesh literally are skipped — the mesh arrives from
+another layer and the check would only guess.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_ERROR, terminal_name
+
+_SPEC_NAMES = {"PartitionSpec", "P"}
+_MESH_CTORS = {"Mesh", "make_mesh", "AbstractMesh"}
+
+
+def _str_elts(node):
+    """String constants inside a tuple/list/single-constant node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_str_elts(elt))
+        return out
+    return []
+
+
+class PartitionSpecAxisRule(Rule):
+    id = "partition-spec-axis"
+    severity = SEVERITY_ERROR
+    description = (
+        "PartitionSpec names a mesh axis not declared by any mesh in this "
+        "module"
+    )
+
+    def check(self, ctx):
+        declared = self._declared_axes(ctx.tree)
+        if not declared:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in _SPEC_NAMES:
+                continue
+            for arg in node.args:
+                for axis in _str_elts(arg):
+                    if axis not in declared:
+                        yield self.finding(
+                            ctx, node,
+                            f"PartitionSpec axis '{axis}' is not among mesh "
+                            f"axes declared in this module "
+                            f"({', '.join(sorted(declared))})",
+                        )
+
+    @staticmethod
+    def _declared_axes(tree):
+        axes = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name in _MESH_CTORS:
+                    # positional axis-names arg (2nd for Mesh/make_mesh)
+                    if len(node.args) >= 2:
+                        axes.update(_str_elts(node.args[1]))
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            axes.update(_str_elts(kw.value))
+                for kw in node.keywords:
+                    if kw.arg == "mesh_shape" and isinstance(kw.value, ast.Dict):
+                        for key in kw.value.keys:
+                            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                                axes.add(key.value)
+            elif isinstance(node, ast.Assign):
+                # mesh_shape = {'data': 1, ...} bound then passed by name
+                if (
+                    isinstance(node.value, ast.Dict)
+                    and any(
+                        isinstance(t, ast.Name) and "mesh" in t.id.lower()
+                        for t in node.targets
+                    )
+                ):
+                    for key in node.value.keys:
+                        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                            axes.add(key.value)
+        return axes

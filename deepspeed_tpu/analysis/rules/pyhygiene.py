@@ -1,0 +1,79 @@
+"""General Python hygiene rules: mutable-default-arg and bare-except.
+
+Not TPU-specific, but both have bitten distributed-training codebases in
+exactly the places this repo exercises: a mutable default on an engine
+entry point shares state across engine instances; a bare ``except:``
+swallows ``KeyboardInterrupt``/``SystemExit`` — on a pod that means a
+worker that cannot be ctrl-C'd or cleanly preempted.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_ERROR, SEVERITY_WARNING, terminal_name
+
+_MUTABLE_CTORS = {"list", "dict", "set", "defaultdict", "OrderedDict", "Counter"}
+
+
+class MutableDefaultArgRule(Rule):
+    id = "mutable-default-arg"
+    severity = SEVERITY_WARNING
+    description = (
+        "mutable default argument (list/dict/set) — shared across every "
+        "call and every engine instance"
+    )
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            args = node.args
+            defaults = list(args.defaults) + [d for d in args.kw_defaults if d is not None]
+            for default in defaults:
+                if self._is_mutable(default):
+                    name = getattr(node, "name", "<lambda>")
+                    yield self.finding(
+                        ctx, default,
+                        f"mutable default in '{name}' — use None and create "
+                        f"the container inside the function",
+                    )
+
+    @staticmethod
+    def _is_mutable(node):
+        if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+            return True
+        if isinstance(node, ast.Call) and terminal_name(node.func) in _MUTABLE_CTORS:
+            return True
+        return False
+
+
+class BareExceptRule(Rule):
+    id = "bare-except"
+    severity = SEVERITY_ERROR
+    description = (
+        "bare 'except:' (or 'except BaseException' without re-raise) — "
+        "swallows KeyboardInterrupt/SystemExit; catch Exception instead"
+    )
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare 'except:' catches KeyboardInterrupt and SystemExit "
+                    "— use 'except Exception' (or narrower)",
+                )
+            elif terminal_name(node.type) == "BaseException" and not _reraises(node):
+                yield self.finding(
+                    ctx, node,
+                    "'except BaseException' without re-raise — swallows "
+                    "interpreter-exit signals",
+                    severity=SEVERITY_WARNING,
+                )
+
+
+def _reraises(handler):
+    return any(
+        isinstance(n, ast.Raise) for n in ast.walk(handler)
+    )

@@ -1,0 +1,83 @@
+"""recompile-hazard: Python control flow / closures that retrace or fail.
+
+Two shapes:
+
+- a ``jit``-compiled function branching (``if``/``while``) on a traced
+  parameter — either a ConcretizationTypeError at trace time, or (if the
+  value is effectively static per call) one silent recompile per distinct
+  value. Parameters declared in ``static_argnums``/``static_argnames`` are
+  exempt.
+- a jitted function/lambda closing over an enclosing function's *mutable*
+  local (list/dict/set) — unhashable, so it can't be a static argument,
+  and mutating it after trace silently does nothing to the compiled
+  program.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_WARNING
+from ..jit_index import build_jit_index
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = SEVERITY_WARNING
+    description = (
+        "traced-value-dependent Python branch or mutable closure captured "
+        "by a jit-compiled function — retraces or fails at trace time"
+    )
+
+    def check(self, ctx):
+        index = build_jit_index(ctx)
+        for jc in index.contexts:
+            yield from self._check_branches(ctx, jc)
+            yield from self._check_closures(ctx, jc)
+
+    def _check_branches(self, ctx, jc):
+        if isinstance(jc.node, ast.Lambda):
+            return  # lambdas cannot contain statements
+        traced = set(jc.traced_param_names())
+        traced.discard("self")
+        if not traced:
+            return
+        for node in ast.walk(jc.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            used = {
+                n.id
+                for n in ast.walk(node.test)
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+            }
+            hits = sorted(used & traced)
+            if hits:
+                name = jc.name or "<lambda>"
+                yield self.finding(
+                    ctx, node,
+                    f"Python branch on traced argument(s) {', '.join(hits)} "
+                    f"inside {jc.wrapper}-compiled '{name}' — use jnp.where/"
+                    f"lax.cond, or mark static via static_argnums/static_argnames",
+                )
+
+    def _check_closures(self, ctx, jc):
+        if not jc.enclosing_locals:
+            return
+        body = jc.node.body if isinstance(jc.node.body, list) else [jc.node.body]
+        own_names = set(jc.param_names())
+        reported = set()
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+                    continue
+                name = node.id
+                if name in own_names or name in reported:
+                    continue
+                if name in jc.enclosing_locals:
+                    reported.add(name)
+                    where = jc.name or "<lambda>"
+                    yield self.finding(
+                        ctx, node,
+                        f"{jc.wrapper}-compiled '{where}' closes over mutable "
+                        f"local '{name}' (list/dict/set) — captured by value at "
+                        f"trace time; later mutations are invisible to the "
+                        f"compiled program",
+                    )

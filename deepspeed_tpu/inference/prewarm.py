@@ -102,7 +102,9 @@ def main(argv=None):
 
     def tick(name, fn):
         t0 = time.time()
-        fn()
+        # drain the dispatch: without this the "ready in" time would report
+        # enqueue latency while the compile/run still executes
+        jax.block_until_ready(fn())
         print(f"prewarm: {name} ready in {time.time() - t0:.1f}s", flush=True)
 
     toks = rs.randint(0, model.cfg.vocab_size,

@@ -23,6 +23,9 @@ _CACHE = os.environ.get(
 
 CXX_FLAGS = ["-O3", "-march=native", "-fopenmp-simd", "-fPIC", "-shared", "-std=c++17", "-pthread"]
 
+# process-wide dlopen memo: a .so must be loaded once per process, so this
+# cache's lifetime is intentionally the process, not an engine instance
+# ds-lint: disable-file=module-mutable-state
 _loaded = {}
 
 

@@ -90,11 +90,17 @@ class FlopsProfiler:
         self.duration: float = 0.0
 
     def start_profile(self, ignore_list=None):
+        from deepspeed_tpu.utils.timer import _sync
+
         self.started = True
+        _sync()  # don't charge previously queued work to this profile
         self._t0 = time.time()
 
     def stop_profile(self):
+        from deepspeed_tpu.utils.timer import _sync
+
         if self.started:
+            _sync()  # drain async dispatch so duration is device compute
             self.duration = time.time() - self._t0
             self.started = False
 

@@ -419,7 +419,8 @@ class TpuEngine:
         # --- timers / monitor
         self.timers = EngineTimers(enable=config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size, steps_per_output=config.steps_per_print
+            batch_size=self.train_batch_size, steps_per_output=config.steps_per_print,
+            synchronize=config.telemetry.enabled and config.telemetry.sync_timers,
         )
         from deepspeed_tpu.monitor.monitor import MonitorMaster
 
@@ -1081,6 +1082,13 @@ class TpuEngine:
                     g.copy_to_host_async()
         self.timers(EngineTimers.BACKWARD).stop()
         if self.telemetry.enabled:
+            if self.config.telemetry.sync_timers:
+                try:
+                    # drain the accumulated grads (and the bf16 wire cast /
+                    # D2H kick above) so bwd_ms is compute, not dispatch
+                    jax.block_until_ready(self.grad_acc)
+                except Exception:
+                    pass
             self._tele_window["bwd_ms"] += (time.time() - t0) * 1000.0
         return loss if loss is not None else self._pending_loss
 
@@ -1264,6 +1272,8 @@ class TpuEngine:
         schema): phase wall-times, throughput, MFU, loss/grad-norm/scale,
         and comm-volume deltas since the previous step."""
         now = time.time()
+        # step() drains device work (sync_timers) before calling here, so the
+        # iteration span is already compute-accurate  # ds-lint: disable=unsynced-timing
         iter_ms = (now - self._iter_t0) * 1000.0 if self._iter_t0 is not None else step_ms
         iter_s = iter_ms / 1000.0
         comm_delta = {}

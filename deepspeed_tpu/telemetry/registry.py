@@ -107,6 +107,9 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
+        # host-side span primitive: callers timing device work own the sync
+        # (engines block_until_ready under telemetry.sync_timers before the
+        # span closes)  # ds-lint: disable=unsynced-timing
         self.elapsed_ms = (time.perf_counter() - self._t0) * 1000.0
         self._registry.histogram(self._name, self._labels).observe(self.elapsed_ms)
         return False

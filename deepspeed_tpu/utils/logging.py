@@ -50,7 +50,8 @@ def print_rank_0(message: str) -> None:
         logger.info(message)
 
 
-def warning_once(message: str, _seen=set()) -> None:
+def warning_once(message: str, _seen=set()) -> None:  # ds-lint: disable=mutable-default-arg
+    # the mutable default IS the point: one process-wide memo of messages
     if message not in _seen:
         _seen.add(message)
         logger.warning(message)

@@ -120,11 +120,16 @@ class SynchronizedWallClockTimer:
 class ThroughputTimer:
     """Samples/sec (+ optional TFLOPs) over training steps, skipping warmup."""
 
-    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False):
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False,
+                 synchronize: bool = False):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory and _PSUTIL
+        # sync at span edges so durations measure device compute, not async
+        # dispatch (engine wires telemetry.sync_timers here); off by default
+        # because the drain itself costs a host round-trip per micro step
+        self.synchronize = synchronize
         self.epoch_count = 0
         self.micro_step_count = 0
         self.global_step_count = 0
@@ -140,6 +145,8 @@ class ThroughputTimer:
 
     def start(self):
         self._started = True
+        if self.synchronize:
+            _sync()
         self._start_time = time.time()
 
     def stop(self, global_step: bool, report_speed: bool = True):
@@ -149,6 +156,8 @@ class ThroughputTimer:
         self.micro_step_count += 1
         if global_step:
             self.global_step_count += 1
+        if self.synchronize:
+            _sync()
         duration = time.time() - self._start_time
         self.last_duration = duration
         if self.global_step_count >= self.start_step:

@@ -28,11 +28,28 @@ except AttributeError:
 # clear_caches() below AND rerun invocations (measured ~2x on warm,
 # compile-heavy modules; the build host has one CPU core, so compiles
 # dominate the suite). ~MBs of machine-local artifacts; gitignored.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".pytest_jax_cache"),
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".pytest_jax_cache"
 )
+# A cache written by a different jaxlib/CPU hard-aborts (SIGABRT, no
+# traceback) on entry deserialization mid-suite — wipe on stamp mismatch.
+import jaxlib  # noqa: E402
+import platform  # noqa: E402
+import shutil  # noqa: E402
+
+_STAMP = f"{jax.__version__}|{jaxlib.__version__}|{platform.machine()}"
+_stamp_file = os.path.join(_CACHE_DIR, ".stamp")
+try:
+    with open(_stamp_file) as _fh:
+        _cache_ok = _fh.read() == _STAMP
+except OSError:
+    _cache_ok = not os.path.isdir(_CACHE_DIR)  # missing dir = fresh start
+if not _cache_ok:
+    shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+os.makedirs(_CACHE_DIR, exist_ok=True)
+with open(_stamp_file, "w") as _fh:
+    _fh.write(_STAMP)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

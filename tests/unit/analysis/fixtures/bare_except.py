@@ -1,0 +1,31 @@
+"""Fixture: bare/overbroad except. Expected findings (line): 8 bare
+except, 15 BaseException without re-raise."""
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:
+        return None
+
+
+def swallow_exits(fn):
+    try:
+        return fn()
+    except BaseException:
+        return None
+
+
+def acceptable(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def reraise_is_fine(fn):
+    try:
+        return fn()
+    except BaseException:
+        cleanup = True
+        raise

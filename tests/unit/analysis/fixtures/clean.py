@@ -1,0 +1,34 @@
+"""Fixture: idiomatic code none of the rules may flag."""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(jax.devices(), ("data",))
+spec = P("data")
+
+
+@jax.jit
+def traced(x):
+    return jnp.sum(x * 2)
+
+
+def timed(fn, x):
+    t0 = time.time()
+    out = fn(x)
+    jax.block_until_ready(out)
+    return out, time.time() - t0
+
+
+def safe_defaults(item, bucket=None):
+    bucket = [] if bucket is None else bucket
+    bucket.append(item)
+    return bucket
+
+
+def narrow_except(fn):
+    try:
+        return fn()
+    except (ValueError, TypeError):
+        return None

@@ -1,0 +1,24 @@
+"""Fixture: donated buffer reuse. Expected finding (line): 16 read of
+donated 'cache'."""
+import jax
+
+
+def decode(params, tokens, cache):
+    return tokens, cache
+
+
+step = jax.jit(decode, donate_argnums=(2,))
+
+
+def bad_loop(params, tokens, cache):
+    logits, new_cache = step(params, tokens, cache)
+    # 'cache' was donated above: this read hits a deleted buffer
+    stale = cache.sum()
+    return logits, stale
+
+
+def good_loop(params, tokens, cache):
+    for _ in range(4):
+        # rebinding the donated name is the supported pattern
+        tokens, cache = step(params, tokens, cache)
+    return tokens

@@ -1,0 +1,38 @@
+"""Fixture: host syncs inside traced bodies. Expected findings (line, hit):
+11 .item(), 12 float cast, 13 np.asarray, 19 print, 24 device_get,
+31 block_until_ready."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    v = x.item()
+    f = float(x)
+    a = np.asarray(x)
+    return v + f + a.sum()
+
+
+@jax.jit
+def printer(x):
+    print("step", x)
+    return x * 2
+
+
+def plain_fn(x):
+    host = jax.device_get(x)
+    return host
+
+
+fast = jax.jit(plain_fn)
+
+
+wrapped_lambda = jax.jit(lambda x: x.block_until_ready())
+
+
+def not_jitted(x):
+    # identical calls outside jit context: must NOT be flagged
+    v = x.item()
+    print("ok", float(x), np.asarray(x))
+    return v

@@ -1,0 +1,21 @@
+"""Fixture: module-level mutable state. Expected findings (line): 10
+dict-subscript write, 15 list append."""
+
+_REGISTRY = {}
+_EVENTS = []
+_FROZEN = ("a", "b")
+
+
+def register(name, fn):
+    _REGISTRY[name] = fn
+    return fn
+
+
+def record(event):
+    _EVENTS.append(event)
+
+
+def local_shadow_ok(event):
+    _EVENTS = []
+    _EVENTS.append(event)
+    return _EVENTS

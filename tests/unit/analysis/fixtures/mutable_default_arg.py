@@ -1,0 +1,16 @@
+"""Fixture: mutable default args. Expected findings (line): 5 list
+default, 10 dict-call default."""
+
+
+def collect(item, bucket=[]):
+    bucket.append(item)
+    return bucket
+
+
+def configure(name, overrides=dict()):
+    overrides[name] = True
+    return overrides
+
+
+def fine(item, bucket=None, count=0, label=""):
+    return item

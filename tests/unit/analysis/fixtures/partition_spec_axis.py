@@ -1,0 +1,18 @@
+"""Fixture: PartitionSpec axis typos. Expected findings (line): 13 'modle'
+typo, 17 'tensor' not on this mesh."""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devices = np.array(jax.devices()).reshape(-1, 1)
+mesh = Mesh(devices, ("data", "model"))
+
+good = P("data", "model")
+also_good = P(("data", "model"), None)
+
+typo = P("data", "modle")
+
+
+def shard(arr):
+    spec = PartitionSpec("tensor")
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,32 @@
+"""Fixture: recompile hazards. Expected findings (line): 10 branch on
+traced arg, 23 mutable closure."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@jax.jit
+def branchy(x, flag):
+    if flag:
+        return x * 2
+    return x
+
+
+def make_step(scale):
+    # table is a mutable local captured by the jitted lambda below: frozen
+    # at trace time, later .append()s are invisible
+    table = [1.0, 2.0]
+
+    def helper(v):
+        return v
+
+    step = jax.jit(lambda x: x * table[0] * scale)
+    return step
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_branch_ok(x, mode):
+    # mode is static: Python branching on it is the supported pattern
+    if mode == "train":
+        return x * 2
+    return x

@@ -1,0 +1,20 @@
+"""Fixture: every violation here carries a suppression — the analyzer must
+report zero findings and count 3 suppressed."""
+
+
+def memo(item, bucket=[]):  # ds-lint: disable=mutable-default-arg
+    bucket.append(item)
+    return bucket
+
+
+def swallow(fn):
+    try:
+        return fn()
+    # tolerated here: fixture demonstrates the standalone-comment form
+    # ds-lint: disable=bare-except
+    except:
+        return None
+
+
+def both(fn, item, bucket={}):  # ds-lint: disable=all
+    return bucket

@@ -1,0 +1,56 @@
+"""Fixture: timing spans. Expected findings (line): 12 local span,
+26 cross-method attr span, 32 caller-provided t0 param span."""
+import time
+
+import jax
+
+
+def local_span_bad(fn, x):
+    t0 = time.time()
+    out = fn(x)
+    # no sync before the stop timestamp: measures dispatch only
+    elapsed = time.time() - t0
+    return out, elapsed
+
+
+class Timer:
+    def start(self):
+        self._start_time = time.time()
+
+    def run(self, fn, x):
+        return fn(x)
+
+    def stop(self):
+        # the measured region lives between start() and stop() calls; no
+        # sync here means the reading is dispatch latency
+        self.duration = time.time() - self._start_time
+        return self.duration
+
+
+def finish_request(result, t0):
+    # t0 arrives from the caller; stop must drain the device first
+    total = time.time() - t0
+    return total
+
+
+def local_span_good(fn, x):
+    t0 = time.time()
+    out = fn(x)
+    jax.block_until_ready(out)
+    elapsed = time.time() - t0
+    return out, elapsed
+
+
+def host_fetch_is_a_sync(fn, x):
+    t0 = time.perf_counter()
+    out = fn(x)
+    total = float(out.sum())  # host fetch forces completion
+    return total, time.perf_counter() - t0
+
+
+def pure_host_span():
+    t0 = time.time()
+    acc = 0
+    for i in range(10):
+        acc += i
+    return time.time() - t0  # no device work between: not flagged

@@ -1,0 +1,147 @@
+"""Subprocess smoke tests for the ds-lint CLI (mirrors the
+ds_trace_report.py CLI test pattern): exit codes, --format json, --rule
+filtering, --write-baseline, and the no-jax standalone loader."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+CLI = os.path.join(REPO, "tools", "ds_lint.py")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+BAD = os.path.join(FIXTURES, "mutable_default_arg.py")
+CLEAN = os.path.join(FIXTURES, "clean.py")
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, CLI, *args], capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_clean_file_exits_zero():
+    proc = run_cli(CLEAN, "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout
+    assert "clean" in proc.stdout
+
+
+def test_findings_exit_one_text_format():
+    proc = run_cli(BAD, "--no-baseline")
+    assert proc.returncode == 1
+    assert "mutable-default-arg" in proc.stdout
+    assert ":5:" in proc.stdout  # file:line:col location
+
+
+def test_json_format():
+    proc = run_cli(BAD, "--no-baseline", "--format", "json")
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    assert report["version"] == 1
+    assert report["summary"]["new"] == 2
+    assert report["summary"]["by_rule"] == {"mutable-default-arg": 2}
+    rules = {f["rule"] for f in report["findings"]}
+    assert rules == {"mutable-default-arg"}
+    assert all(f["code"] for f in report["findings"])
+
+
+def test_rule_filter():
+    proc = run_cli(
+        os.path.join(FIXTURES, "host_sync_in_jit.py"),
+        "--no-baseline", "--format", "json", "--rule", "bare-except",
+    )
+    assert proc.returncode == 0  # other rules' findings filtered out
+    assert json.loads(proc.stdout)["summary"]["new"] == 0
+
+
+def test_unknown_rule_exits_two():
+    proc = run_cli(BAD, "--rule", "no-such-rule")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_missing_path_exits_two():
+    proc = run_cli("/nonexistent/dir")
+    assert proc.returncode == 2
+
+
+def test_list_rules():
+    proc = run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in (
+        "host-sync-in-jit", "unsynced-timing", "recompile-hazard",
+        "partition-spec-axis", "donated-buffer-reuse", "mutable-default-arg",
+        "bare-except", "module-mutable-state",
+    ):
+        assert rule_id in proc.stdout
+
+
+def test_write_baseline_then_clean(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    proc = run_cli(BAD, "--baseline", str(baseline), "--write-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert baseline.exists()
+    proc = run_cli(BAD, "--baseline", str(baseline))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 baselined" in proc.stdout
+
+
+def test_deep_single_file_finds_repo_baseline():
+    """Linting one deep file must still discover the repo-root baseline
+    (root inference walks up to pyproject/.git/baseline markers), so
+    already-accepted findings don't re-fail."""
+    orbax = os.path.join(
+        REPO, "deepspeed_tpu", "runtime", "checkpoint_engine",
+        "orbax_checkpoint_engine.py",
+    )
+    proc = run_cli(orbax)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "3 baselined" in proc.stdout
+
+
+def test_write_baseline_refuses_rule_filter(tmp_path):
+    proc = run_cli(BAD, "--rule", "bare-except", "--write-baseline",
+                   "--baseline", str(tmp_path / "b.json"))
+    assert proc.returncode == 2
+    assert "--rule" in proc.stderr
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_write_baseline_merges_out_of_scope_entries(tmp_path):
+    """Rewriting the baseline from a subset path must preserve entries for
+    files outside that subset."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("def f(x, y=[]):\n    return y\n")
+    b.write_text("def g(x, y={}):\n    return y\n")
+    baseline = tmp_path / "baseline.json"
+    proc = run_cli(str(a), str(b), "--baseline", str(baseline),
+                   "--write-baseline", "--root", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # rewrite from only a.py: b.py's entry must survive
+    proc = run_cli(str(a), "--baseline", str(baseline), "--write-baseline",
+                   "--root", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.loads(baseline.read_text())["findings"]
+    assert {e["path"] for e in entries} == {"a.py", "b.py"}
+    proc = run_cli(str(a), str(b), "--baseline", str(baseline), "--root", str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_loader_does_not_import_jax_or_package():
+    """tools/ds_lint.py must work where jax is unavailable: assert the
+    subprocess finished without importing jax or deepspeed_tpu."""
+    probe = (
+        "import sys; sys.argv=['ds_lint', %r, '--no-baseline'];"
+        "import runpy; ctx=runpy.run_path(%r, run_name='not_main');"
+        "rc=ctx['main'](sys.argv[1:]);"
+        "assert 'jax' not in sys.modules, 'jax was imported';"
+        "assert 'deepspeed_tpu' not in sys.modules, 'package was imported';"
+        "sys.exit(rc)"
+    ) % (CLEAN, CLI)
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,140 @@
+"""Suppression comments and baseline round-trip semantics."""
+
+import json
+import os
+import textwrap
+
+from deepspeed_tpu.analysis import Analyzer, Baseline, ModuleContext, make_rules
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+# -- suppressions -------------------------------------------------------
+
+def test_suppressed_fixture_reports_zero():
+    result = Analyzer().check_paths([os.path.join(FIXTURES, "suppressed.py")])
+    assert result.findings == []
+    assert result.suppressed == 3
+
+
+def test_trailing_and_standalone_comment_forms():
+    src = textwrap.dedent("""
+        def a(x, b=[]):  # ds-lint: disable=mutable-default-arg
+            return b
+
+        # ds-lint: disable=mutable-default-arg
+        def c(x, d={}):
+            return d
+
+        def e(x, f=set()):
+            return f
+    """)
+    result = Analyzer(make_rules(["mutable-default-arg"])).check_source(src)
+    assert [f.line for f in result.findings] == [9]  # only the unsuppressed one
+    assert result.suppressed == 2
+
+
+def test_disable_all_and_disable_file():
+    src = textwrap.dedent("""
+        # ds-lint: disable-file=bare-except
+        def a(x, b=[]):  # ds-lint: disable=all
+            try:
+                return b
+            except:
+                return None
+    """)
+    result = Analyzer().check_source(src)
+    assert result.findings == []
+    assert result.suppressed == 2
+
+
+def test_suppression_is_rule_specific():
+    src = textwrap.dedent("""
+        def a(x, b=[]):  # ds-lint: disable=bare-except
+            return b
+    """)
+    result = Analyzer(make_rules(["mutable-default-arg"])).check_source(src)
+    assert len(result.findings) == 1  # wrong rule id: not suppressed
+
+
+# -- baseline -----------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    """write-baseline then re-check must report zero new findings; a new
+    violation must surface as exactly one new finding."""
+    target = tmp_path / "victim.py"
+    target.write_text("def a(x, b=[]):\n    return b\n")
+    result = Analyzer().check_paths([str(target)])
+    assert len(result.findings) == 1
+
+    baseline_file = tmp_path / "baseline.json"
+    Baseline.from_findings(result.findings, root=str(tmp_path)).save(str(baseline_file))
+
+    reloaded = Baseline.load(str(baseline_file))
+    new, baselined = reloaded.split_new(
+        Analyzer().check_paths([str(target)]).findings, root=str(tmp_path)
+    )
+    assert new == [] and len(baselined) == 1
+
+    # append a second violation: only IT is new
+    target.write_text("def a(x, b=[]):\n    return b\n\n\ndef c(x, d={}):\n    return d\n")
+    new, baselined = reloaded.split_new(
+        Analyzer().check_paths([str(target)]).findings, root=str(tmp_path)
+    )
+    assert len(baselined) == 1
+    assert [f.line for f in new] == [5]
+
+
+def test_baseline_survives_line_shift(tmp_path):
+    """Inserting unrelated lines above the offense must not invalidate the
+    baseline (matching is by code text, not line number)."""
+    target = tmp_path / "victim.py"
+    target.write_text("def a(x, b=[]):\n    return b\n")
+    baseline = Baseline.from_findings(
+        Analyzer().check_paths([str(target)]).findings, root=str(tmp_path)
+    )
+    target.write_text("import os\nimport sys\n\n\ndef a(x, b=[]):\n    return b\n")
+    new, baselined = baseline.split_new(
+        Analyzer().check_paths([str(target)]).findings, root=str(tmp_path)
+    )
+    assert new == [] and len(baselined) == 1
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    """Two identical offending lines need two entries — one baselined copy
+    must not absolve both."""
+    target = tmp_path / "victim.py"
+    target.write_text("def a(x, b=[]):\n    return b\n\n\ndef c(x, b=[]):\n    return b\n")
+    findings = Analyzer().check_paths([str(target)]).findings
+    assert len(findings) == 2
+    one_entry = Baseline.from_findings(findings[:1], root=str(tmp_path))
+    new, baselined = one_entry.split_new(findings, root=str(tmp_path))
+    assert len(new) == 1 and len(baselined) == 1
+
+
+def test_baseline_version_check(tmp_path):
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps({"version": 99, "findings": []}))
+    try:
+        Baseline.load(str(bad))
+    except ValueError as exc:
+        assert "version" in str(exc)
+    else:
+        raise AssertionError("version 99 should be rejected")
+
+
+def test_parse_error_reported_not_fatal(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def oops(:\n")
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    result = Analyzer().check_paths([str(tmp_path)])
+    assert result.files_checked == 1
+    assert len(result.parse_errors) == 1
+    assert "broken.py" in result.parse_errors[0][0]
+
+
+def test_context_from_source_helpers():
+    ctx = ModuleContext.from_source("x = 1  # ds-lint: disable=bare-except\n")
+    assert ctx.code_at(1).startswith("x = 1")
+    assert "bare-except" in ctx.suppressed_rules_for_line(1)

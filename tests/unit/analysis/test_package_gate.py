@@ -1,0 +1,49 @@
+"""Tier-1 gate: ds-lint over the whole ``deepspeed_tpu/`` package with the
+checked-in baseline must report ZERO unsuppressed, non-baselined findings.
+
+This is the test that makes the linter load-bearing: any PR that introduces
+a host-sync-in-jit, an unsynced timing span, a donated-buffer reuse, etc.
+fails tier-1 unless the author either fixes it, suppresses it with an
+intent comment, or explicitly adds it to tools/ds_lint_baseline.json (all
+three are visible in review). See docs/static_analysis.md.
+"""
+
+import os
+
+from deepspeed_tpu.analysis import Analyzer, Baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+PACKAGE = os.path.join(REPO, "deepspeed_tpu")
+BASELINE = os.path.join(REPO, "tools", "ds_lint_baseline.json")
+
+
+def _format(findings):
+    return "\n".join(
+        f"  {f.location()}: [{f.severity}] {f.rule_id}: {f.message}" for f in findings
+    )
+
+
+def test_package_has_no_new_findings():
+    result = Analyzer().check_paths([PACKAGE])
+    assert result.files_checked > 100  # the whole package, not a subdir
+    assert result.parse_errors == [], result.parse_errors
+    baseline = Baseline.load(BASELINE)
+    new, _ = baseline.split_new(result.findings, root=REPO)
+    assert new == [], (
+        f"{len(new)} new ds-lint finding(s) — fix, suppress with "
+        f"'# ds-lint: disable=<rule>', or add to tools/ds_lint_baseline.json:\n"
+        f"{_format(new)}"
+    )
+
+
+def test_baseline_entries_still_exist():
+    """Baseline hygiene: every entry must still match a real finding —
+    stale entries mean the debt was paid and the file should shrink."""
+    result = Analyzer().check_paths([PACKAGE])
+    baseline = Baseline.load(BASELINE)
+    _, baselined = baseline.split_new(result.findings, root=REPO)
+    assert len(baselined) == len(baseline.entries), (
+        f"{len(baseline.entries) - len(baselined)} stale baseline entr(y|ies) "
+        f"in {BASELINE}: remove entries whose findings no longer occur"
+    )

@@ -1,0 +1,125 @@
+"""One test per ds-lint rule: run the analyzer over the known-bad fixture
+and assert the exact (rule_id, line) set — no more, no less."""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.analysis import Analyzer, all_rules, make_rules
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def findings_for(fixture, rule=None):
+    rules = make_rules([rule]) if rule else all_rules()
+    result = Analyzer(rules).check_paths([os.path.join(FIXTURES, fixture)])
+    return result
+
+
+def lines(result, rule_id):
+    return sorted(f.line for f in result.findings if f.rule_id == rule_id)
+
+
+def test_host_sync_in_jit():
+    result = findings_for("host_sync_in_jit.py", "host-sync-in-jit")
+    assert lines(result, "host-sync-in-jit") == [11, 12, 13, 19, 24, 31]
+    by_line = {f.line: f for f in result.findings}
+    assert ".item()" in by_line[11].message
+    assert "float() cast" in by_line[12].message
+    assert "np.asarray" in by_line[13].message
+    assert "print()" in by_line[19].message
+    assert "plain_fn" in by_line[24].message  # wrapped-by-name context
+    assert "<lambda>" in by_line[31].message
+    assert all(f.severity == "error" for f in result.findings)
+
+
+def test_unsynced_timing():
+    result = findings_for("unsynced_timing.py", "unsynced-timing")
+    assert lines(result, "unsynced-timing") == [12, 26, 32]
+    by_line = {f.line: f for f in result.findings}
+    assert "span starts line 9" in by_line[12].message
+    assert "another method" in by_line[26].message
+    assert "caller-provided" in by_line[32].message
+
+
+def test_recompile_hazard():
+    result = findings_for("recompile_hazard.py", "recompile-hazard")
+    assert lines(result, "recompile-hazard") == [10, 23]
+    by_line = {f.line: f for f in result.findings}
+    assert "flag" in by_line[10].message
+    assert "table" in by_line[23].message
+
+
+def test_partition_spec_axis():
+    result = findings_for("partition_spec_axis.py", "partition-spec-axis")
+    assert lines(result, "partition-spec-axis") == [13, 17]
+    by_line = {f.line: f for f in result.findings}
+    assert "'modle'" in by_line[13].message
+    assert "data, model" in by_line[13].message  # declared axes listed
+    assert "'tensor'" in by_line[17].message
+
+
+def test_donated_buffer_reuse():
+    result = findings_for("donated_buffer_reuse.py", "donated-buffer-reuse")
+    assert lines(result, "donated-buffer-reuse") == [16]
+    (finding,) = result.findings
+    assert "'cache'" in finding.message and "'step'" in finding.message
+    assert finding.severity == "error"
+
+
+def test_mutable_default_arg():
+    result = findings_for("mutable_default_arg.py", "mutable-default-arg")
+    assert lines(result, "mutable-default-arg") == [5, 10]
+
+
+def test_bare_except():
+    result = findings_for("bare_except.py", "bare-except")
+    assert lines(result, "bare-except") == [8, 15]
+    by_line = {f.line: f for f in result.findings}
+    assert by_line[8].severity == "error"
+    assert by_line[15].severity == "warning"  # BaseException w/o re-raise
+
+
+def test_module_mutable_state():
+    result = findings_for("module_mutable_state.py", "module-mutable-state")
+    assert lines(result, "module-mutable-state") == [10, 15]
+    by_line = {f.line: f for f in result.findings}
+    assert "_REGISTRY" in by_line[10].message
+    assert "_EVENTS" in by_line[15].message
+
+
+def test_clean_fixture_is_clean():
+    result = findings_for("clean.py")
+    assert result.findings == []
+    assert result.suppressed == 0
+
+
+def test_every_rule_has_a_fixture_hit():
+    """Meta-test: each registered rule fires on at least one fixture — a
+    rule that can't fire anywhere is dead code or a broken fixture."""
+    result = Analyzer().check_paths([FIXTURES])
+    fired = {f.rule_id for f in result.findings}
+    registered = {r.id for r in all_rules()}
+    assert registered <= fired, f"rules with no fixture hit: {registered - fired}"
+
+
+def test_timestamp_param_name_arithmetic_not_flagged():
+    """A parameter merely NAMED like a timestamp ('start', 't0') used in
+    ordinary arithmetic is not a timing span — the stop side must read a
+    clock (or a local assigned from one)."""
+    import textwrap
+
+    from deepspeed_tpu.analysis import Analyzer
+
+    src = textwrap.dedent("""
+        def slice_len(tokens, start):
+            x = compute(tokens)
+            return len(tokens) - start
+    """)
+    result = Analyzer(make_rules(["unsynced-timing"])).check_source(src)
+    assert result.findings == []
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        make_rules(["no-such-rule"])

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""ds-lint launcher — runs the ``deepspeed_tpu.analysis`` engine without
+importing ``deepspeed_tpu`` itself.
+
+The analysis package is stdlib-only and uses relative imports exclusively,
+so it can be loaded under an alias package name here. That keeps this tool
+runnable on machines with no jax installed (the package ``__init__`` pulls
+in jax at import time) — same portability contract as ds_trace_report.py.
+
+Usage (see ``--help`` / docs/static_analysis.md):
+    python tools/ds_lint.py                          # lint deepspeed_tpu/
+    python tools/ds_lint.py --format json path/      # machine-readable
+    python tools/ds_lint.py --write-baseline         # accept current debt
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_DIR = os.path.join(REPO, "deepspeed_tpu", "analysis")
+_ALIAS = "_ds_lint_analysis"
+
+
+def _load_analysis():
+    if _ALIAS in sys.modules:
+        return sys.modules[_ALIAS]
+    spec = importlib.util.spec_from_file_location(
+        _ALIAS,
+        os.path.join(_PKG_DIR, "__init__.py"),
+        submodule_search_locations=[_PKG_DIR],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[_ALIAS] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def main(argv=None) -> int:
+    return _load_analysis().cli_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
