@@ -422,8 +422,52 @@ def _decode_window(engine, tokens, new_tokens):
     return max(time.time() - t0 - t_prefill, 1e-9)
 
 
+def _decode_winner_key(device_kind):
+    return f"decode/{device_kind}/n{jax.device_count()}"
+
+
+def _cached_decode_winner(device_kind):
+    try:
+        with open(_WINNER_CACHE) as f:
+            cache = json.load(f)
+        entry = cache.get(_decode_winner_key(device_kind))
+        if entry and entry.get("digest") == _bench_digest():
+            return entry["kv_cache_dtype"], entry["tight"], entry["bounded"]
+    except Exception:
+        pass
+    return None
+
+
+def _save_decode_winner(device_kind, kv_cache_dtype, tight, bounded):
+    try:
+        cache = {}
+        if os.path.exists(_WINNER_CACHE):
+            with open(_WINNER_CACHE) as f:
+                cache = json.load(f)
+        cache[_decode_winner_key(device_kind)] = {
+            "kv_cache_dtype": kv_cache_dtype, "tight": tight,
+            "bounded": bounded, "digest": _bench_digest()}
+        with open(_WINNER_CACHE, "w") as f:
+            json.dump(cache, f)
+    except Exception:
+        pass
+
+
 def bench_decode():
+    """Decode throughput, SELF-TUNING over KV-cache geometry. The three
+    probes are genuinely distinct read programs: (a) the historical
+    baseline — cache manually right-sized to the request via
+    max_out_tokens, full-length reads; (b) tight reads at the DEFAULT
+    allocation (max_seq_len) — the geometry the overhaul fixes: no manual
+    sizing, bucket-staged reads stream the active length out of the 4x-
+    oversized cache; (c) int8 KV on the right-sized cache — halves the
+    bytes per slot. Winner measured and persisted per device kind like the
+    train bench (probe list bounded at 3). Decode on TPU is an HBM
+    roofline — weight bytes + KV-cache bytes per token — so ``extra``
+    reports ``kv_bytes_per_token`` and roofline utilization including
+    cache traffic for every probe, not just wall clock."""
     import deepspeed_tpu
+    from deepspeed_tpu.inference.decoding import decode_kv_bytes
     from deepspeed_tpu.models.transformer import TransformerModel
 
     B, prompt_len, new_tokens = (2, 8, 8) if _SMOKE else (8, 128, 128)
@@ -431,30 +475,87 @@ def bench_decode():
         model = _smoke_model(64)
     else:
         model = TransformerModel.from_preset("gpt2-350m", dtype="bfloat16", max_seq_len=1024)
-    # right-size the KV cache to the request (prompt + new tokens): without
-    # max_out_tokens the cache allocates at max_seq_len (1024), and every
-    # decode step streams 4x the needed cache bytes — serving stacks size
-    # the cache to the admitted request, so the bench should too
-    engine = deepspeed_tpu.init_inference(
-        model, config={"dtype": "bfloat16",
-                       "max_out_tokens": prompt_len + new_tokens})
-    rs = np.random.RandomState(0)
-    tokens = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (B, prompt_len)), jnp.int32)
-    dt = _decode_window(engine, tokens, new_tokens)
-    decoded = new_tokens - 1
-    tok_s = B * decoded / dt
-    # bandwidth roofline: every decoded token reads all weights once
+    decoded = max(new_tokens - 1, 1)
+    # right-sized KV cache (prompt + new tokens): the bounded variants pass
+    # it as max_out_tokens; the tight-read variant deliberately does NOT —
+    # it serves from the default max_seq_len allocation to show bucketed
+    # reads recover the right-sized bytes without per-request sizing
+    cache_len = prompt_len + new_tokens
     weight_bytes = model.cfg.num_params() * 2  # bf16
-    achieved_bw = (tok_s / B) * weight_bytes  # per-sequence steps are the bound
+    rs = np.random.RandomState(0)
+    # host-side prompt: _release_device_memory between probes deletes every
+    # live device array, so each probe materializes its own device copy
+    tokens_np = rs.randint(0, model.cfg.vocab_size, (B, prompt_len)).astype(np.int32)
 
-    # A/B: REAL-int8 weight storage (W8A8 MXU path) — decode is bandwidth-
-    # bound, so int8 weights should push tokens/s toward 2x
+    def measure(kv_dtype, tight, bounded):
+        config = {"dtype": "bfloat16", "kv_cache_dtype": kv_dtype,
+                  "kv_tight_read": tight}
+        if bounded:
+            config["max_out_tokens"] = cache_len
+        engine = deepspeed_tpu.init_inference(model, config=config)
+        alloc = cache_len if bounded else model.cfg.max_seq_len
+        dt = _decode_window(engine, jnp.asarray(tokens_np), new_tokens)
+        kv_per_tok = decode_kv_bytes(
+            engine.cfg, prompt_len, new_tokens, alloc,
+            engine.config.kv_read_floor if tight else None) / decoded
+        return dt, kv_per_tok, alloc
+
+    device_kind = jax.devices()[0].device_kind
+    variants = [("model", False, True), ("model", True, False),
+                ("int8", True, True)]
+    cached = None if (_SMOKE or os.environ.get("DSTPU_BENCH_NOCACHE") == "1") \
+        else _cached_decode_winner(device_kind)
+    candidates = [cached] if cached is not None else variants
+    probes, best = {}, None
+
+    def _probe(cand_list):
+        nonlocal best
+        for kv_dtype, tight, bounded in cand_list:
+            key = (f"kv-{kv_dtype}{'+tight' if tight else ''}"
+                   f"@{cache_len if bounded else model.cfg.max_seq_len}")
+            if key in probes:
+                continue  # the failed cached winner is already recorded
+            try:
+                dt, kv_per_tok, _ = measure(kv_dtype, tight, bounded)
+                tok_s = B * decoded / dt
+                bw = (tok_s / B) * (weight_bytes + kv_per_tok)
+                probes[key] = {
+                    "tokens_per_sec": round(tok_s, 1),
+                    "kv_bytes_per_token": round(kv_per_tok, 1),
+                    "roofline_util": round(bw / peak_bw(), 4),
+                }
+                if best is None or tok_s > best[0]:
+                    best = (tok_s, dt, kv_per_tok, kv_dtype, tight, bounded)
+            except Exception as e:
+                probes[key] = f"{type(e).__name__}: {e}"[:200]
+            _release_device_memory()
+
+    _probe(candidates)
+    if best is None and cached is not None:
+        # the cached winner failed (code drift the digest missed a
+        # dependency of, OOM after topology change): re-probe from scratch
+        _probe(variants)
+        candidates = variants
+    assert best is not None, f"every decode cache config failed: {probes}"
+    tok_s, dt, kv_per_tok, kv_dtype, tight, bounded = best
+    if len(candidates) > 1 and not _SMOKE:
+        _save_decode_winner(device_kind, kv_dtype, tight, bounded)
+
+    # bandwidth roofline: every decoded token streams all weights once plus
+    # its KV-cache read; vs_baseline stays the weights-only utilization for
+    # trend continuity with earlier rounds
+    achieved_bw = (tok_s / B) * weight_bytes
+
+    # A/B: REAL-int8 weight storage (W8A8 MXU path) on the winning cache
+    # config — decode is bandwidth-bound, so int8 weights push toward 2x
     extra_int8 = {}
     try:
-        eng8 = deepspeed_tpu.init_inference(
-            model, config={"dtype": "int8",
-                           "max_out_tokens": prompt_len + new_tokens})
-        dt8 = _decode_window(eng8, tokens, new_tokens)
+        cfg8 = {"dtype": "int8", "kv_cache_dtype": kv_dtype,
+                "kv_tight_read": tight}
+        if bounded:
+            cfg8["max_out_tokens"] = cache_len
+        eng8 = deepspeed_tpu.init_inference(model, config=cfg8)
+        dt8 = _decode_window(eng8, jnp.asarray(tokens_np), new_tokens)
         extra_int8 = {
             "int8_tokens_per_sec": round(B * decoded / dt8, 1),
             "int8_speedup": round(dt / dt8, 3),
@@ -471,8 +572,15 @@ def bench_decode():
             "batch": B,
             "prompt_len": prompt_len,
             "new_tokens": new_tokens,
-            "ms_per_step": round(dt / max(new_tokens - 1, 1) * 1e3, 2),
+            "ms_per_step": round(dt / decoded * 1e3, 2),
             "roofline_gbps": round(achieved_bw / 1e9, 1),
+            "roofline_util_with_kv": round(
+                ((tok_s / B) * (weight_bytes + kv_per_tok)) / peak_bw(), 4),
+            "kv_cache_dtype": kv_dtype,
+            "kv_tight_read": tight,
+            "cache_len": cache_len if bounded else model.cfg.max_seq_len,
+            "kv_bytes_per_token": round(kv_per_tok, 1),
+            "probes": probes,
             **extra_int8,
         },
     }

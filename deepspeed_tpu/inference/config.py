@@ -45,6 +45,21 @@ class InferenceConfig:
     # bytes that bound decode at long context and doubles servable context;
     # compute dequantizes at the attention read)
     kv_cache_dtype: str = "model"
+    # tight-read cache geometry (default ON): decode/segment steps attend a
+    # bucketed ACTIVE length (power-of-2 from kv_read_floor, block-granular
+    # static slices over the cache time axis with the tail masked) instead
+    # of the full allocated cache_len, and the per-token decode loop grows
+    # its cache by bucket migration instead of allocating max_len upfront.
+    # Decode is an HBM-bandwidth roofline — cache bytes streamed per token
+    # are the cost — so this is a direct throughput lever at long
+    # allocations (docs/inference.md "Cache geometry"). Token streams are
+    # identical (the masked tail contributes exact zeros). Rolling (ring)
+    # caches and speculative decoding keep their own geometry.
+    kv_tight_read: bool = True
+    # smallest tight-read bucket / initial migrated-cache allocation; each
+    # growth doubles it. Keep a multiple of 128 on real TPUs (lane-aligned
+    # slices); tests shrink it to exercise migration on tiny models.
+    kv_read_floor: int = 128
     tensor_parallel: TensorParallelConfig = field(default_factory=TensorParallelConfig)
     moe: MoEInferenceConfig = field(default_factory=MoEInferenceConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)

@@ -37,6 +37,15 @@ the footprint for both layouts.
 scan (k× fewer host dispatches per token — the dominant serving cost on
 remote-dispatch links); admission then happens between bursts, adding up
 to k tokens of admission latency. Greedy output is identical to k=1.
+
+Tight-read ticks (engine config ``kv_tight_read``, default on): every
+decode tick attends a bucketed ACTIVE length — the power-of-2 window
+covering the live rows' cached extents — instead of the full pool length,
+so young requests in a long pool stream a fraction of the cache bytes
+(decode is an HBM roofline; docs/inference.md "Cache geometry"). Finished
+requests emit an ``inference_request`` event with ``kv_bytes_read`` /
+``kv_bytes_per_token`` / ``kv_dtype`` / ``cache_utilization``, and
+``step()`` maintains a ``cache_utilization`` gauge for dashboards.
 """
 
 from dataclasses import dataclass, field
@@ -50,8 +59,13 @@ from deepspeed_tpu.inference.decoding import (
     cached_fn,
     compile_ragged_prefill_fn,
     compile_segment_fn,
+    read_bucket,
     select_token,
 )
+
+# admission/bucket sizing shares the ONE bucketing rule with the tight-read
+# geometry (decoding.read_bucket); the old local name stays importable
+_bucket = read_bucket
 
 
 @dataclass
@@ -66,13 +80,10 @@ class _Request:
     # snapshot of the registered prefix entry (tokens/cache/bucket), taken
     # at submit time so unregister_prefix cannot strand a queued request
     prefix: Optional[dict] = None
-
-
-def _bucket(n: int, cap: int, floor: int = 16) -> int:
-    b = floor
-    while b < n:
-        b *= 2
-    return min(b, cap)
+    # KV-cache bytes this request's row streamed across its decode ticks
+    # (deterministic host accounting — models.transformer.
+    # kv_read_bytes_per_row at each tick's read length)
+    kv_bytes_read: int = 0
 
 
 class _Pool:
@@ -92,10 +103,13 @@ class _Pool:
         self.active: Dict[int, _Request] = {}       # slot -> request
         self.pos = np.zeros(n_slots, np.int32)      # next write position
         self.last_tok = np.zeros(n_slots, np.int32)
-        # burst program (tokens_per_tick > 1): shape/sampling are fixed for
-        # the engine's lifetime, so it lives on the pool — built on first
-        # burst tick, never evicted (an LRU here could recompile per tick)
-        self.burst_fn = None
+        # tick programs keyed by tight-read length (None = full pool
+        # length): shape/sampling are fixed for the engine's lifetime, so
+        # they live on the pool — bounded by the power-of-2 bucket count,
+        # never evicted (an LRU consulted per tick could recompile, and a
+        # shared-cache lookup per tick would churn its recency bookkeeping)
+        self.segment_fns: Dict[Optional[int], object] = {None: self.segment_fn}
+        self.burst_fns: Dict[Optional[int], object] = {}
 
     def free_slots(self) -> List[int]:
         return [s for s in range(self.n_slots) if s not in self.active]
@@ -310,50 +324,101 @@ class ContinuousBatchingEngine:
             emitted[req.rid] = [self._admit(req, pi, slot)]
         self._pending = still_pending
 
-        for pi, pool in enumerate(self._pools):
+        for pool in self._pools:
             if not pool.active:
                 continue
             if self.tokens_per_tick > 1:
                 self._burst_tick(pool, emitted)
                 continue
+            read_len = self._tick_read_len(pool, 1)
             toks = jnp.asarray(pool.last_tok[:, None])
             pos = jnp.asarray(pool.pos)
             self._rng, sub = jax.random.split(self._rng)
-            logits, pool.cache = pool.segment_fn(
+            logits, pool.cache = self._segment_for(pool, read_len)(
                 self._eng.params, toks, pool.cache, pos
             )
+            row_bytes = self._row_read_bytes(pool, read_len)
             nxt = np.asarray(select_token(
                 logits[:, 0], self.temperature, self.top_k, sub, self.top_p
             ))
             for slot, req in list(pool.active.items()):
+                req.kv_bytes_read += row_bytes
                 tok = int(nxt[slot])
                 self._record(req, pool, slot, tok)
                 emitted.setdefault(req.rid, []).append(tok)
             pool.pos[[s for s in pool.active]] += 1
             for slot in [s for s, r in pool.active.items() if r.done]:
                 self._finish(pool, slot)
+        if self._eng.telemetry.enabled:
+            # serving dashboards read pool pressure off this gauge: cached
+            # tokens across live slots / total reserved slot capacity
+            self._eng.telemetry.registry.gauge("cache_utilization").set(
+                self.cache_utilization())
         return emitted
+
+    def cache_utilization(self) -> float:
+        """Fraction of the reserved slot-pool KV capacity holding live
+        tokens (active rows' cached extents / sum of slots × length)."""
+        used = sum(int(p.pos[s]) for p in self._pools for s in p.active)
+        cap = sum(p.n_slots * p.length for p in self._pools)
+        return used / cap if cap else 0.0
+
+    def _tick_read_len(self, pool: _Pool, n_tokens: int) -> Optional[int]:
+        """Tight-read length for a decode tick over ``pool``: the bucket
+        covering every ACTIVE row's extent after ``n_tokens`` more steps
+        (inactive rows compute garbage that is discarded either way).
+        None = read the full pool length (tight reads off, or the bucket
+        reached it)."""
+        if not self._eng.config.kv_tight_read or not pool.active:
+            return None
+        floor = self._eng.config.kv_read_floor
+        extent = max(int(pool.pos[s]) for s in pool.active) + n_tokens
+        r = read_bucket(extent, pool.length, floor)
+        return None if r >= pool.length else r
+
+    def _row_read_bytes(self, pool: _Pool, read_len: Optional[int]) -> int:
+        from deepspeed_tpu.models.transformer import kv_read_bytes_per_row
+
+        return kv_read_bytes_per_row(
+            self.cfg, read_len if read_len is not None else pool.length)
+
+    def _segment_for(self, pool: _Pool, read_len: Optional[int]):
+        """The pool's decode-tick segment program at a tight-read length
+        (None = the full-length program the pool was built with). Pool-
+        resident, like the burst programs — bounded by the bucket count."""
+        if read_len not in pool.segment_fns:
+            pool.segment_fns[read_len] = compile_segment_fn(
+                self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
+                pool.length, read_len=read_len)[0]
+        return pool.segment_fns[read_len]
 
     def _burst_tick(self, pool: _Pool, emitted: Dict[int, List[int]]):
         """One k-token burst for a pool: a single dispatch of the compiled
         burst program, then host-side acceptance (truncate each row at
         done). Greedy streams are identical to tokens_per_tick=1; sampled
         streams are equally-distributed but consume the rng in a different
-        order."""
+        order. The whole burst reads one tight-read bucket sized to cover
+        max(active pos) + k."""
         from deepspeed_tpu.inference.decoding import compile_burst_segment_fn
 
         k = self.tokens_per_tick
-        if pool.burst_fn is None:
-            pool.burst_fn = compile_burst_segment_fn(
+        read_len = self._tick_read_len(pool, k)
+        if read_len not in pool.burst_fns:
+            pool.burst_fns[read_len] = compile_burst_segment_fn(
                 self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
-                pool.length, k, self.temperature, self.top_k, self.top_p)[0]
-        burst_fn = pool.burst_fn
+                pool.length, k, self.temperature, self.top_k, self.top_p,
+                read_len=read_len)[0]
+        burst_fn = pool.burst_fns[read_len]
         toks = jnp.asarray(pool.last_tok[:, None])
         pos = jnp.asarray(pool.pos)
         self._rng, sub = jax.random.split(self._rng)
         out, pool.cache = burst_fn(self._eng.params, toks, pool.cache, pos, sub)
+        row_bytes = k * self._row_read_bytes(pool, read_len)
         out = np.asarray(out)  # (n_slots, k)
         for slot, req in list(pool.active.items()):
+            # the burst streams k read windows for every row it carries,
+            # whether or not the request accepts all k tokens
+            req.kv_bytes_read += row_bytes
             accepted = 0
             for j in range(k):
                 if req.done:
@@ -474,7 +539,28 @@ class ContinuousBatchingEngine:
             req.done = True
 
     def _finish(self, pool: _Pool, slot: int):
+        # pool pressure BEFORE the pop: the event describes the state this
+        # request served under (popping first reads 0.0 for the last one)
+        util = self.cache_utilization()
         req = pool.active.pop(slot)
         self._results[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)]
         )
+        tele = self._eng.telemetry
+        if tele.enabled:
+            new = len(req.generated)
+            event = {
+                "request": int(req.rid),
+                "path": "continuous",
+                "batch": 1,
+                "prompt_tokens": int(req.prompt.size),
+                "new_tokens": new,
+                "cache_len": pool.length,
+                "kv_dtype": ("int8" if self.cfg.kv_cache_dtype == "int8"
+                             else self.cfg.dtype),
+                "kv_bytes_read": int(req.kv_bytes_read),
+                "cache_utilization": round(util, 4),
+            }
+            if new > 1:  # admission emits the first token without a pool read
+                event["kv_bytes_per_token"] = round(req.kv_bytes_read / (new - 1), 1)
+            tele.emit("inference_request", event)

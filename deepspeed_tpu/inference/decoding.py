@@ -24,6 +24,58 @@ def _mark_first_token(timings: Optional[dict], token):
         timings["first_token_s"] = time.time()
 
 
+def read_bucket(n: int, cap: int, floor: int = 16) -> int:
+    """Smallest power-of-2 length >= n (starting at ``floor``), clamped to
+    ``cap``. The ONE bucketing rule for the whole decode stack: continuous-
+    batching admission buckets, tight-read lengths, and the bucket-migrated
+    cache growth all use it, so their geometries can never disagree."""
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def read_stages(prompt_len: int, n_steps: int, cache_len: int,
+                floor: Optional[int]):
+    """[(read_len_or_None, n_steps)] decode-step stages for a generation:
+    step j attends ``prompt_len + j + 1`` cached slots, so it reads the
+    bucket covering that extent. Consecutive steps sharing a bucket fuse
+    into one stage (one ``lax.scan`` in the fused program, one compiled
+    read geometry on the host-driven loop). ``floor=None`` = tight reads
+    off — a single full-length stage. A read_len of ``None`` inside a
+    stage means "the whole allocation" (bucket reached cache_len)."""
+    if n_steps <= 0:
+        return []
+    if floor is None:
+        return [(None, n_steps)]
+    stages, j = [], 0
+    while j < n_steps:
+        r = read_bucket(prompt_len + j + 1, cache_len, floor)
+        if r >= cache_len:
+            stages.append((None, n_steps - j))
+            break
+        n = min(n_steps, r - prompt_len) - j
+        stages.append((r, n))
+        j += n
+    return stages
+
+
+def decode_kv_bytes(cfg, prompt_len: int, new_tokens: int, cache_len: int,
+                    floor: Optional[int] = None) -> int:
+    """Deterministic host-side accounting: KV-cache bytes ONE sequence row
+    streams across the ``new_tokens - 1`` decode steps of a generation
+    (prefill excluded — its read is the segment itself). This mirrors the
+    read geometry the compiled programs actually execute (read_stages), so
+    telemetry's ``kv_bytes_read`` is assertable in tests and comparable
+    across tight/full configurations."""
+    from deepspeed_tpu.models.transformer import kv_read_bytes_per_row
+
+    total = 0
+    for r, n in read_stages(prompt_len, new_tokens - 1, cache_len, floor):
+        total += n * kv_read_bytes_per_row(cfg, r if r is not None else cache_len)
+    return total
+
+
 def _decode_shardings(mesh, cfg, batch_size: int):
     """(batch_sharding, cache_sharding) — the ONE sharding-selection policy
     for every cached-decode program (plain and speculative paths must place
@@ -72,7 +124,7 @@ def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: i
 
 def compile_generate_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int,
                         max_new_tokens: int, temperature: float, top_k: int,
-                        top_p: float):
+                        top_p: float, read_floor: Optional[int] = None):
     """Whole-generation jit: prefill + ``lax.scan`` over the decode steps in
     ONE compiled program — one dispatch per ``generate()`` call instead of
     one per token (the per-token host round trip dominates decode wall time
@@ -80,9 +132,17 @@ def compile_generate_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: 
     ~1 ms roofline). Token stream is bitwise-identical to ``decode_loop``:
     same rng split order, same select_token calls.
 
+    ``read_floor`` enables tight cache reads inside the fused program: the
+    decode scan splits into bucket stages (read_stages) so early steps
+    attend a power-of-2 window over the active cache prefix instead of the
+    full allocation — same token stream (the masked tail is exact zeros),
+    roughly half the cache bytes per generation at typical lengths.
+
     Returns ``(generate_fn, cache_sh, batch_sh)`` with
     ``generate_fn(params, tokens, cache, rng) -> (B, S + max_new_tokens)``.
     """
+    from functools import partial
+
     from deepspeed_tpu.models import transformer as tf
 
     batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
@@ -92,19 +152,24 @@ def compile_generate_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: 
         logits, cache = tf.forward_with_cache(params, cfg, tokens, cache, 0)
         first = select_token(logits[:, -1], temperature, top_k, rng, top_p)
 
-        def body(carry, _):
+        def body(carry, _, read_len=None):
             last, cache, rng, pos = carry
             rng, sub = jax.random.split(rng)
             step_logits, cache = tf.forward_with_cache(
-                params, cfg, last[:, None], cache, pos)
+                params, cfg, last[:, None], cache, pos, read_len=read_len)
             tok = select_token(step_logits[:, -1], temperature, top_k, sub, top_p)
             return (tok, cache, rng, pos + 1), tok
 
-        (_, cache, _, _), rest = jax.lax.scan(
-            body, (first, cache, rng, jnp.int32(S)), None,
-            length=max_new_tokens - 1)
-        seq = jnp.concatenate(
-            [tokens, first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        carry = (first, cache, rng, jnp.int32(S))
+        outs = []
+        for r, n in read_stages(S, max_new_tokens - 1, cache_len, read_floor):
+            carry, toks = jax.lax.scan(partial(body, read_len=r), carry, None,
+                                       length=n)
+            outs.append(toks)
+        cache = carry[1]
+        rest = (jnp.moveaxis(jnp.concatenate(outs, axis=0), 0, 1)
+                if outs else tokens[:, :0])
+        seq = jnp.concatenate([tokens, first[:, None], rest], axis=1)
         # the final cache is returned (and dropped by the caller) so the
         # donated input cache aliases an output instead of warning
         return seq, cache
@@ -147,15 +212,23 @@ def compile_ragged_prefill_fn(mesh, cfg, param_shardings, batch_size: int, cache
 
 def _segment_decode_tail(segment_fn, params, first_tok, cache, prompt_lens,
                          n_more: int, temperature: float, top_k: int, rng,
-                         top_p: float):
+                         top_p: float, active0: Optional[int] = None):
     """Per-row-position decode loop shared by the ragged and chunked-prefill
     generate paths: ``first_tok`` (B,) was already sampled from the prefill
-    logits; emits ``n_more`` further tokens."""
+    logits; emits ``n_more`` further tokens. ``active0`` (the longest row's
+    cached extent before the first step, host int) opts into tight reads:
+    each step passes the active extent to a read-geometry-aware
+    ``segment_fn`` dispatcher (the engine's) — plain 4-arg compiled segment
+    fns are called unchanged when it is None."""
     out = [first_tok]
     pos = jnp.asarray(prompt_lens)
-    for _ in range(n_more):
+    for i in range(n_more):
         rng, sub = jax.random.split(rng)
-        step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos)
+        if active0 is None:
+            step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos)
+        else:
+            step_logits, cache = segment_fn(params, out[-1][:, None], cache, pos,
+                                            active=active0 + i + 1)
         out.append(select_token(step_logits[:, 0], temperature, top_k, sub, top_p))
         pos = pos + 1
     return jnp.stack(out, axis=1)
@@ -164,7 +237,8 @@ def _segment_decode_tail(segment_fn, params, first_tok, cache, prompt_lens,
 def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_mask,
                        cache, cache_len: int, max_new_tokens: int, temperature: float,
                        top_k: int, rng, top_p: float = 1.0,
-                       timings: Optional[dict] = None) -> jnp.ndarray:
+                       timings: Optional[dict] = None,
+                       tight_read: bool = False) -> jnp.ndarray:
     """Generate over a PADDED prompt batch (HF attention_mask semantics,
     left or right padding): prefill once with per-row dense positions, then
     per-row-position decode. Returns (B, S + max_new_tokens) — the prompt
@@ -190,7 +264,8 @@ def ragged_decode_loop(ragged_prefill_fn, segment_fn, params, tokens, attention_
     nxt = select_token(last_logits, temperature, top_k, rng, top_p)
     _mark_first_token(timings, nxt)
     gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
-                               max_new_tokens - 1, temperature, top_k, rng, top_p)
+                               max_new_tokens - 1, temperature, top_k, rng, top_p,
+                               active0=int(prompt_lens.max()) if tight_read else None)
     return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
 
 
@@ -198,7 +273,8 @@ def chunked_generate(ragged_prefill_fn, segment_fn, params, tokens, cache,
                      cache_len: int, chunk: int, max_new_tokens: int,
                      temperature: float, top_k: int, rng,
                      top_p: float = 1.0, attention_mask=None,
-                     timings: Optional[dict] = None) -> jnp.ndarray:
+                     timings: Optional[dict] = None,
+                     tight_read: bool = False) -> jnp.ndarray:
     """Generate with CHUNKED prefill: the prompt streams through a fixed
     (B, chunk) prefill program, so ONE compiled program serves every prompt
     length (each distinct length otherwise compiles its own prefill — 20-40s
@@ -254,7 +330,8 @@ def chunked_generate(ragged_prefill_fn, segment_fn, params, tokens, cache,
     nxt = select_token(last_logits, temperature, top_k, rng, top_p)
     _mark_first_token(timings, nxt)
     gen = _segment_decode_tail(segment_fn, params, nxt, cache, prompt_lens,
-                               max_new_tokens - 1, temperature, top_k, rng, top_p)
+                               max_new_tokens - 1, temperature, top_k, rng, top_p,
+                               active0=int(prompt_lens.max()) if tight_read else None)
     return jnp.concatenate([jnp.asarray(tokens), gen], axis=1)
 
 
@@ -312,17 +389,22 @@ def decode_loop(prefill_fn, decode_fn, params, tokens, cache, max_new_tokens: in
     return jnp.concatenate([tokens, jnp.stack(out, axis=1)], axis=1)
 
 
-def compile_segment_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
+def compile_segment_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: int,
+                       read_len: Optional[int] = None):
     """Jit a cached segment forward with PER-ROW positions (``pos``: (B,)
     int32); any segment width retraces under the same jit wrapper. Used by
     speculative decoding, where rows advance by their own accepted counts.
-    Returns (segment_fn, cache_sh, batch_sh)."""
+    ``read_len`` builds the tight-read variant: attention streams only the
+    first ``read_len`` cache slots — the caller (the engine's bucket
+    dispatcher, the continuous pools' tick) guarantees every live row's
+    extent fits. Returns (segment_fn, cache_sh, batch_sh)."""
     from deepspeed_tpu.models import transformer as tf
 
     batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
 
     def segment(params, toks, cache, pos):
-        return tf.forward_with_cache(params, cfg, toks, cache, pos)
+        return tf.forward_with_cache(params, cfg, toks, cache, pos,
+                                     read_len=read_len)
 
     segment_fn = jax.jit(
         segment,
@@ -335,12 +417,15 @@ def compile_segment_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: i
 
 def compile_burst_segment_fn(mesh, cfg, param_shardings, batch_size: int,
                              cache_len: int, n_tokens: int, temperature: float,
-                             top_k: int, top_p: float):
+                             top_k: int, top_p: float,
+                             read_len: Optional[int] = None):
     """``n_tokens`` per-row-position decode steps fused into ONE compiled
     program (``lax.scan`` over the segment forward + sampling): the
     continuous-batching engine's burst tick — k× fewer host dispatches per
     generated token, at the cost of admitting new requests only between
     bursts. Row r's tokens land at positions pos[r]..pos[r]+n_tokens-1.
+    ``read_len`` tight-reads the cache across the whole burst — the caller
+    sizes it to cover max(pos) + n_tokens.
 
     Returns ``(burst_fn, cache_sh, batch_sh)`` with
     ``burst_fn(params, toks, cache, pos, rng) -> ((B, n_tokens) int32, cache)``.
@@ -353,7 +438,8 @@ def compile_burst_segment_fn(mesh, cfg, param_shardings, batch_size: int,
         def body(carry, _):
             last, cache, pos, rng = carry
             rng, sub = jax.random.split(rng)
-            logits, cache = tf.forward_with_cache(params, cfg, last, cache, pos)
+            logits, cache = tf.forward_with_cache(params, cfg, last, cache, pos,
+                                                  read_len=read_len)
             tok = select_token(logits[:, 0], temperature, top_k, sub, top_p)
             return (tok[:, None], cache, pos + 1, rng), tok
 
@@ -562,17 +648,20 @@ def speculative_decode_loop(
 
 def fused_generate_fn(holder, mesh, cfg, param_shardings, batch_size: int,
                       cache_len: int, max_new_tokens: int, temperature: float,
-                      top_k: int, top_p: float):
+                      top_k: int, top_p: float, read_floor: Optional[int] = None):
     """(generate_fn, cache_sharding) for the fused whole-generation program,
     memoized on ``holder`` and keyed by every trace-shaping argument — ONE
     wiring shared by the InferenceEngine and the RLHF hybrid engine so the
-    cache key and builder can never drift apart."""
+    cache key and builder can never drift apart. ``read_floor`` (tight-read
+    bucket floor, None = full-length reads) shapes the traced program, so
+    it is part of the key."""
     return cached_fn(
         holder, "fused_generate",
-        (batch_size, cache_len, max_new_tokens, temperature, top_k, top_p),
+        (batch_size, cache_len, max_new_tokens, temperature, top_k, top_p,
+         read_floor),
         lambda: compile_generate_fn(mesh, cfg, param_shardings, batch_size,
                                     cache_len, max_new_tokens, temperature,
-                                    top_k, top_p)[:2],
+                                    top_k, top_p, read_floor=read_floor)[:2],
     )
 
 

@@ -74,6 +74,11 @@ class InferenceEngine:
             raise ValueError(
                 f"kv_cache_dtype must be 'model' or 'int8', got {self.config.kv_cache_dtype!r}"
             )
+        floor = self.config.kv_read_floor
+        if not (isinstance(floor, int) and floor >= 1 and (floor & (floor - 1)) == 0):
+            raise ValueError(
+                f"kv_read_floor must be a positive power of 2, got {floor!r}"
+            )
         overrides = {}
         if self.config.kv_cache_dtype != cfg.kv_cache_dtype:
             overrides["kv_cache_dtype"] = self.config.kv_cache_dtype
@@ -160,6 +165,9 @@ class InferenceEngine:
         self._request_id = 0
         self._compile_hits = 0
         self._compile_misses = 0
+        # (B, max_len, alloc-bucket) shapes the migrating decode loop has
+        # already traced — compile_cache_hit accounting (see generate())
+        self._traced_geoms = set()
         log_dist(
             f"InferenceEngine ready: dtype={cfg.dtype} quant={self._weight_quant} "
             f"mesh={dict(mesh.shape)}",
@@ -230,6 +238,9 @@ class InferenceEngine:
             compile_decode_fns(self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
         )
         self._compiled_shape = (batch_size, max_len)
+        # fresh jit objects hold no traces — geoms recorded against the
+        # discarded pair must not claim their shapes are still compiled
+        self._traced_geoms = set()
 
     def _ensure_compiled(self, batch_size: int, max_len: int):
         miss = self._prefill_fn is None or self._compiled_shape != (batch_size, max_len)
@@ -264,10 +275,37 @@ class InferenceEngine:
         self._model_times = []
         return times
 
+    def _kv_fields(self, prompt_len: int, new_tokens: int, cache_len: int,
+                   floor: Optional[int], batch: int,
+                   alloc: Optional[int] = None) -> Optional[dict]:
+        """Deterministic KV-read accounting for a generate call (None when
+        telemetry is off): total cache bytes the decode steps streamed
+        (``kv_bytes_read``), the per-decoded-token rate, the cache dtype,
+        and how much of the allocation the request actually used. Pure host
+        math mirroring the compiled read geometry (decoding.read_stages),
+        so tests assert it exactly and the CPU mesh can measure the
+        tight-read win with the TPU relay down."""
+        if not self.telemetry.enabled:
+            return None
+        from deepspeed_tpu.inference.decoding import decode_kv_bytes
+
+        per_row = decode_kv_bytes(self.cfg, prompt_len, new_tokens, cache_len, floor)
+        decoded = max(new_tokens - 1, 0)
+        alloc = alloc if alloc is not None else cache_len
+        fields = {
+            "kv_dtype": "int8" if self.cfg.kv_cache_dtype == "int8" else self.cfg.dtype,
+            "kv_bytes_read": int(batch) * per_row,
+            "cache_utilization": round(min((prompt_len + new_tokens) / alloc, 1.0), 4),
+        }
+        if decoded:
+            fields["kv_bytes_per_token"] = round(per_row / decoded, 1)
+        return fields
+
     def _finish_request(self, path: str, t0: float, result, prompt_tokens: int,
                         new_tokens: int, batch: int, cache_len: Optional[int] = None,
                         timings: Optional[dict] = None,
-                        misses_before: Optional[int] = None):
+                        misses_before: Optional[int] = None,
+                        kv: Optional[dict] = None):
         """Single exit point for every forward/generate path. Preserves the
         reference's ``profile_model_time`` wall-clock list (``model_times()``
         drain semantics unchanged) and emits one structured
@@ -296,6 +334,8 @@ class InferenceEngine:
             }
             if cache_len is not None:
                 event["cache_len"] = int(cache_len)
+            if kv is not None:
+                event.update(kv)
             if misses_before is not None:
                 event["compile_cache_hit"] = self._compile_misses == misses_before
             ttft_s = (timings or {}).get("first_token_s")
@@ -377,11 +417,13 @@ class InferenceEngine:
                 prefill_fn, segment_fn, self.params, tokens, cache, max_len,
                 self.config.prefill_chunk_size, max_new_tokens, temperature,
                 top_k, rng, top_p, attention_mask=attention_mask,
-                timings=timings)
+                timings=timings, tight_read=self.config.kv_tight_read)
             result = self._finish_request(
                 "chunked_prefill", t0, result, prompt_tokens=S,
                 new_tokens=max_new_tokens, batch=B, cache_len=max_len,
-                timings=timings, misses_before=misses0)
+                timings=timings, misses_before=misses0,
+                kv=self._kv_fields(longest, max_new_tokens, max_len,
+                                   self._tight_floor(), B))
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
@@ -399,12 +441,14 @@ class InferenceEngine:
             result = ragged_decode_loop(
                 prefill_fn, segment_fn, self.params, tokens, attention_mask,
                 cache, max_len, max_new_tokens, temperature, top_k, rng, top_p,
-                timings=timings,
+                timings=timings, tight_read=self.config.kv_tight_read,
             )
             result = self._finish_request(
                 "ragged", t0, result, prompt_tokens=S,
                 new_tokens=max_new_tokens, batch=B, cache_len=max_len,
-                timings=timings, misses_before=misses0)
+                timings=timings, misses_before=misses0,
+                kv=self._kv_fields(longest, max_new_tokens, max_len,
+                                   self._tight_floor(), B))
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
@@ -429,37 +473,121 @@ class InferenceEngine:
 
         max_len = bounded_cache_len(total, self.cfg.max_seq_len, self.config.max_out_tokens)
         max_len = self._ring_cache_len(max_len, S)
+        # tight reads never apply to the ring geometry (already O(window))
+        floor = None if self.cfg.rolling_kv_cache else self._tight_floor()
         if self.config.fused_generate:
             # one dispatch for the whole generation (prefill + scan over
-            # decode steps) — identical token stream to decode_loop
+            # decode steps) — identical token stream to decode_loop; tight
+            # reads ride as bucket-staged scans inside the same program
             fused_fn, cache_sh = self._fused_generate_fn(
-                B, max_len, max_new_tokens, temperature, top_k, top_p)
+                B, max_len, max_new_tokens, temperature, top_k, top_p,
+                read_floor=floor)
             cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), cache_sh)
             t0 = time.time()
             result = fused_fn(self.params, tokens, cache, rng)
             result = self._finish_request(
                 "fused", t0, result, prompt_tokens=S,
                 new_tokens=max_new_tokens, batch=B, cache_len=max_len,
-                misses_before=misses0)
+                misses_before=misses0,
+                kv=self._kv_fields(S, max_new_tokens, max_len, floor, B))
             if eos_token_id is not None:
                 result = self._truncate_eos(result, S, eos_token_id)
             return result
         self._ensure_compiled(B, max_len)
 
-        cache = jax.device_put(tf.init_cache(self.cfg, B, max_len), self._cache_sharding)
+        from deepspeed_tpu.inference.decoding import read_bucket
+
+        # bucket-migrated allocation: the per-token loop starts its cache at
+        # the prompt's bucket and grows by migration (decode reads therefore
+        # stream the bucketed active length); tight-read off or a ring-sized
+        # cache keeps the full allocation. The final allocation stops at
+        # bucket(total-1): the LAST write lands at total-2 (the closing
+        # sampled token is never cached) — bucket(total) would overstate
+        # alloc 2x at exact boundaries and halve the reported utilization.
+        alloc = max_len if floor is None else min(read_bucket(S + 1, max_len, floor), max_len)
+        final_alloc = (max_len if floor is None else
+                       min(read_bucket(max(S + 1, total - 1), max_len, floor),
+                           max_len))
+        if floor is not None:
+            # honest compile accounting: the prefill/decode jit OBJECTS are
+            # keyed (B, max_len), but migration retraces them per allocation
+            # bucket — a request whose bucket walk meets an untraced shape
+            # pays real XLA compiles and must not be tagged a cache hit
+            geoms, b = {(B, max_len, alloc)}, alloc
+            while b < final_alloc:
+                b = min(b * 2, max_len)
+                geoms.add((B, max_len, b))
+            fresh = geoms - self._traced_geoms
+            if fresh:
+                self._compile_misses += 1
+                self._traced_geoms |= fresh
+        decode_fn = (self._decode_fn if floor is None
+                     else self._migrating_decode_fn(max_len, floor))
+        cache = jax.device_put(tf.init_cache(self.cfg, B, alloc), self._cache_sharding)
         t0 = time.time()
         result = decode_loop(
-            self._prefill_fn, self._decode_fn, self.params, tokens, cache,
+            self._prefill_fn, decode_fn, self.params, tokens, cache,
             max_new_tokens, temperature, top_k, rng, top_p=top_p,
             timings=timings,
         )
         result = self._finish_request(
             "decode_loop", t0, result, prompt_tokens=S,
             new_tokens=max_new_tokens, batch=B, cache_len=max_len,
-            timings=timings, misses_before=misses0)
+            timings=timings, misses_before=misses0,
+            kv=self._kv_fields(S, max_new_tokens, max_len, floor, B,
+                               alloc=final_alloc))
         if eos_token_id is not None:
             result = self._truncate_eos(result, S, eos_token_id)
         return result
+
+    def _tight_floor(self) -> Optional[int]:
+        """The tight-read bucket floor, or None when the knob is off."""
+        return self.config.kv_read_floor if self.config.kv_tight_read else None
+
+    def _migrating_decode_fn(self, max_len: int, floor: int):
+        """Wrap the compiled decode step with bucket-migrated cache growth:
+        when the write position reaches the current allocation, one jitted
+        pad (memoized per target length) migrates the cache to the next
+        power-of-2 bucket. Every step's read then streams the bucketed
+        active length — the tight-read geometry — without any per-step
+        slicing in the compiled program."""
+        from deepspeed_tpu.inference.decoding import read_bucket
+        from deepspeed_tpu.models.transformer import cache_alloc_len
+
+        def dispatch(params, tok, cache, pos):
+            if pos + 1 > cache_alloc_len(cache):
+                cache = self._grow_cache(
+                    cache, min(read_bucket(pos + 1, max_len, floor), max_len))
+            return self._decode_fn(params, tok, cache, pos)
+
+        return dispatch
+
+    def _grow_cache(self, cache, new_len: int):
+        """Migrate a KV cache to a longer time axis (zero-padded tail; the
+        position mask keeps the tail inert until real writes reach it).
+        No donation — the output shape differs from the input's, so XLA
+        could not alias the buffers anyway; the old cache frees when its
+        last reference (the caller's local) drops after the dispatch."""
+        sharding = self._cache_sharding  # snapshot: the closure must match
+        # the cache THIS call grows, and _cache_sharding flips between
+        # batch-sharded and replicated with the request's batch size — so
+        # the memo key carries the batch dim alongside the target length
+        batch = jax.tree.leaves(cache)[0].shape[1]
+
+        def build():
+            def grow(c):
+                return jax.tree.map(
+                    lambda leaf: jnp.pad(
+                        leaf, [(0, 0), (0, 0), (0, new_len - leaf.shape[2]),
+                               (0, 0), (0, 0)]), c)
+
+            return jax.jit(grow, in_shardings=(sharding,),
+                           out_shardings=sharding)
+
+        # every bucket from floor to max_len is a distinct target length —
+        # keep them all resident, not the default-4 LRU window
+        return self._cached_fn("grow_cache", (batch, new_len), build,
+                               slots=16)(cache)
 
     def _ring_cache_len(self, max_len: int, prompt_len: int) -> int:
         """Rolling-cache sizing: shrink the cache to the sliding window when
@@ -487,37 +615,69 @@ class InferenceEngine:
 
         return dataclasses.replace(self.cfg, rolling_kv_cache=False)
 
-    def _cached_fn(self, kind: str, key, builder):
+    def _cached_fn(self, kind: str, key, builder, slots: int = 4):
         """Bounded memoization for every compiled-fn family on the engine
         (plain decode, speculative, ragged) — decoding.cached_fn, shared
         with the hybrid engine. Multiple slots matter: the speculative and
         ragged paths share the "segment" family but legitimately use
-        different cache lengths (the spec path adds gamma+1 slack)."""
+        different cache lengths (the spec path adds gamma+1 slack), and
+        tight-read families multiply keys by the bucket count."""
         from deepspeed_tpu.inference.decoding import cached_fn
 
-        return cached_fn(self, kind, key, builder)
+        return cached_fn(self, kind, key, builder, slots=slots)
 
     def _segment_fn(self, batch_size: int, max_len: int):
         """Per-row-position segment forward, shared by the speculative and
-        ragged paths (any segment width retraces under the same wrapper)."""
-        from deepspeed_tpu.inference.decoding import compile_segment_fn
+        ragged paths (any segment width retraces under the same wrapper).
+        Returns a DISPATCHER ``fn(params, toks, cache, pos, active=None)``:
+        callers that know the live rows' max cached extent (the ragged /
+        chunked decode tails) pass ``active`` and get a tight-read variant
+        compiled per bucket; 4-arg callers (speculative verify) read the
+        full cache as before."""
+        from deepspeed_tpu.inference.decoding import compile_segment_fn, read_bucket
 
-        return self._cached_fn(
-            "segment", (batch_size, max_len),
-            lambda: compile_segment_fn(self.mesh, self._ring_off_cfg, self.param_shardings,
-                                       batch_size, max_len)[0],
-        )
+        floor = self._tight_floor()
+
+        def fn_for(read_len):
+            # one long generation walks every bucket up to max_len (~6 keys
+            # at 4096/128) — the default 4 slots would evict and recompile
+            # the early buckets on EVERY subsequent request
+            return self._cached_fn(
+                "segment", (batch_size, max_len, read_len),
+                lambda: compile_segment_fn(self.mesh, self._ring_off_cfg,
+                                           self.param_shardings, batch_size,
+                                           max_len, read_len=read_len)[0],
+                slots=16,
+            )
+
+        local = {}  # dispatcher-local memo: the per-token decode tail must
+        # not touch the LRU (dict pop/reinsert + a telemetry counter inc)
+        # on EVERY step — one cached_fn hit per bucket per request, like
+        # the one-fetch-per-generate accounting before tight reads
+
+        def dispatch(params, toks, cache, pos, active=None):
+            read_len = None
+            if floor is not None and active is not None:
+                r = read_bucket(active, max_len, floor)
+                read_len = None if r >= max_len else r
+            if read_len not in local:
+                local[read_len] = fn_for(read_len)
+            return local[read_len](params, toks, cache, pos)
+
+        return dispatch
 
     def _fused_generate_fn(self, batch_size: int, max_len: int,
                            max_new_tokens: int, temperature: float,
-                           top_k: int, top_p: float):
+                           top_k: int, top_p: float,
+                           read_floor: Optional[int] = None):
         """(generate_fn, cache_sharding) for the fused whole-generation
         program — shared wiring in decoding.fused_generate_fn."""
         from deepspeed_tpu.inference.decoding import fused_generate_fn
 
         return fused_generate_fn(self, self.mesh, self.cfg, self.param_shardings,
                                  batch_size, max_len, max_new_tokens,
-                                 temperature, top_k, top_p)
+                                 temperature, top_k, top_p,
+                                 read_floor=read_floor)
 
     def _ragged_fns_for(self, batch_size: int, max_len: int):
         """(ragged_prefill_fn, segment_fn, cache_sharding) for attention_mask
@@ -570,11 +730,25 @@ class InferenceEngine:
 
     @staticmethod
     def _truncate_eos(tokens, prompt_len, eos_id):
-        arr = np.array(tokens)  # copy: np.asarray on a jax.Array is read-only
-        for b in range(arr.shape[0]):
-            hits = np.where(arr[b, prompt_len:] == eos_id)[0]
-            if hits.size:
-                arr[b, prompt_len + hits[0] + 1:] = eos_id
+        """Pad everything after each row's first generated EOS with EOS.
+
+        One host transfer (read-only ``np.asarray`` view), and the writable
+        copy + device re-dispatch happen ONLY for rows that actually need
+        rewriting — the common no-EOS case (and the speculative path, which
+        already EOS-pads) used to pay a full host copy AND a full re-upload
+        of the token buffer on every call."""
+        arr = np.asarray(tokens)
+        gen = arr[:, prompt_len:]
+        need = []
+        for b in np.nonzero((gen == eos_id).any(axis=1))[0]:
+            first = int(np.argmax(gen[b] == eos_id))
+            if not (gen[b, first + 1:] == eos_id).all():
+                need.append((b, first))
+        if not need:
+            return tokens
+        arr = arr.copy()
+        for b, first in need:
+            arr[b, prompt_len + first + 1:] = eos_id
         return jnp.asarray(arr)
 
 
@@ -595,7 +769,9 @@ def init_inference(model, config=None, params=None, mesh=None, draft_model=None,
             # must cover both engines or long-context speculative serving
             # silently loses it
             config={"dtype": engine.config.dtype,
-                    "kv_cache_dtype": engine.config.kv_cache_dtype},
+                    "kv_cache_dtype": engine.config.kv_cache_dtype,
+                    "kv_tight_read": engine.config.kv_tight_read,
+                    "kv_read_floor": engine.config.kv_read_floor},
             params=draft_params, mesh=mesh, seed=seed,
         )
     return engine

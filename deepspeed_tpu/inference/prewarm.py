@@ -53,6 +53,13 @@ def main(argv=None):
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--no-tight-read", action="store_true",
+                   help="warm the full-length-read program set instead of "
+                        "the (default) tight-read bucket stages")
+    p.add_argument("--kv-floor", type=int, default=0,
+                   help="tight-read bucket floor override (0 = config "
+                        "default); must match the serving config or the "
+                        "warmed executables miss")
     p.add_argument("--chunk", type=int, default=0,
                    help="also warm the chunked-prefill program set")
     p.add_argument("--continuous", action="store_true",
@@ -98,6 +105,10 @@ def main(argv=None):
     cfg = {"dtype": args.dtype}
     if args.kv_int8:
         cfg["kv_cache_dtype"] = "int8"
+    if args.no_tight_read:
+        cfg["kv_tight_read"] = False
+    if args.kv_floor:
+        cfg["kv_read_floor"] = args.kv_floor
     rs = np.random.RandomState(0)
 
     def tick(name, fn):

@@ -1180,15 +1180,37 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: Optional[int] =
     }
 
 
+def cache_alloc_len(cache) -> int:
+    """Allocated time-axis length of a cache pytree (dense or int8)."""
+    return jax.tree.leaves(cache)[0].shape[2]
+
+
+def kv_read_bytes_per_row(cfg: TransformerConfig, read_len: int) -> int:
+    """HBM bytes ONE sequence row's attention streams from the KV cache
+    when a decode step attends ``read_len`` slots: K and V across all
+    layers, int8 payload + fp32 per-token-per-head scales when
+    ``kv_cache_dtype == "int8"``. This is the deterministic host-side
+    accounting behind the ``kv_bytes_read`` telemetry field and the
+    bench's roofline math — it counts exactly what the compiled read
+    touches, so tests can assert it."""
+    if cfg.kv_cache_dtype == "int8":
+        per_slot = cfg.kv_heads * (cfg.head_dim * 1 + 4)  # q8 payload + s
+    else:
+        per_slot = cfg.kv_heads * cfg.head_dim * jnp.dtype(cfg.jnp_dtype).itemsize
+    return 2 * cfg.num_layers * read_len * per_slot
+
+
 def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos,
-                       window=None):
+                       window=None, read_len=None):
     """One decoder layer over a segment of S new tokens with KV cache.
 
     x: (B, S, D); k_cache/v_cache: (B, T, nkv, hd) for THIS layer; pos: the
     count of tokens already cached — a scalar (all rows aligned: plain
     prefill/decode) or an (B,) vector (rows at different depths: the
     speculative-decode verify/draft path writes each row's segment at its
-    own offset). Returns (x, new_k_cache, new_v_cache).
+    own offset). ``read_len`` (static int) tight-reads the cache: attention
+    streams only slots [0, read_len) — the caller guarantees it covers
+    every attended position. Returns (x, new_k_cache, new_v_cache).
     """
     attn_p, mlp_p = layer_params["attn"], layer_params["mlp"]
     ln1, ln2 = layer_params["ln1"], layer_params["ln2"]
@@ -1242,6 +1264,7 @@ def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig
     attn_out = softmax_context(
         q, k_cache, v_cache, pos, scale=cfg.attn_scale, positions=positions,
         alibi_slopes=slopes, local_window=window, ring=ring,
+        read_len=read_len if not ring else None,
     ).reshape(B, S, nh * hd)
     attn_out = _linear(attn_out, attn_p["wo"])
     if cfg.use_bias:
@@ -1271,17 +1294,22 @@ def _finish_layer_cached(x, h, attn_out, layer_params, cfg: TransformerConfig, k
     return _norm(x + mlp_out, ln2["scale"], ln2.get("bias"), cfg), k_cache, v_cache
 
 
-def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, positions=None):
+def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, positions=None,
+                       read_len=None):
     """Segment forward with KV cache (prefill: S = prompt len, pos = 0;
     decode: S = 1). ``pos`` may be a scalar (all rows aligned) or an (B,)
     vector of per-row depths (speculative decoding — rows advance by their
     own accepted counts). ``positions`` (B, S) overrides the derived token
     positions for RAGGED/padded prompts: pad slots carry position >= cache
     length, so their KV writes drop out of bounds and real tokens pack
-    densely per row (requires vector ``pos``). Returns (logits (B,S,V),
-    updated cache)."""
+    densely per row (requires vector ``pos``). ``read_len`` (static int)
+    tight-reads the cache time axis — attention streams slots
+    [0, read_len) only; the caller guarantees the active extent fits.
+    Returns (logits (B,S,V), updated cache)."""
     dtype = cfg.jnp_dtype
     B, S = tokens.shape
+    if read_len is not None and read_len >= cache_alloc_len(cache):
+        read_len = None  # degenerate slice: the allocation is already tight
     x = jnp.take(params["embed"]["tok"], tokens, axis=0).astype(dtype)
     if positions is not None:
         assert jnp.ndim(pos) == 1, "explicit positions require vector pos"
@@ -1317,7 +1345,8 @@ def forward_with_cache(params, cfg: TransformerConfig, tokens, cache, pos, posit
         h = carry
         layer_p, k_c, v_c, win = inp
         win = win if varying else uniform_w
-        h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos, window=win)
+        h, k_c, v_c = _layer_body_cached(h, layer_p, k_c, v_c, cfg, positions, pos,
+                                         window=win, read_len=read_len)
         return h, (k_c, v_c)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"], windows))

@@ -74,6 +74,21 @@ def dequantize_kv(cache_component, dtype):
     return (cache_component["q8"].astype(jnp.float32) * cache_component["s"]).astype(dtype)
 
 
+def slice_kv_time(cache_component, read_len: Optional[int]):
+    """First ``read_len`` time slots of a cache component (dense
+    (B, T, H, hd) array or int8 {"q8","s"} pair). ``read_len`` is a static
+    python int, so the slice is a static-shape view — the attention
+    contraction downstream only ever touches those bytes in HBM (the
+    tight-read geometry: decode reads the bucketed active length, not the
+    full allocation)."""
+    if read_len is None:
+        return cache_component
+    if isinstance(cache_component, dict):
+        return {"q8": cache_component["q8"][:, :read_len],
+                "s": cache_component["s"][:, :read_len]}
+    return cache_component[:, :read_len]
+
+
 def _write_component(cache, new, pos, positions, ring=False):
     if ring:
         # ring-buffer write: slot = absolute position mod cache length.
@@ -118,7 +133,7 @@ def update_kv_cache(k_cache, v_cache, k_new, v_new, pos,
 
 def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
                     positions=None, alibi_slopes=None, local_window=None,
-                    ring=False) -> jnp.ndarray:
+                    ring=False, read_len: Optional[int] = None) -> jnp.ndarray:
     """Cached masked attention (softmax_context binding): q (B, S, nh, hd)
     against (B, T, nkv, hd) caches (GQA repeat applied here).
 
@@ -139,8 +154,17 @@ def softmax_context(q, k_cache, v_cache, pos, scale: Optional[float] = None,
     absolute positions (identical to the plain cache while nothing has
     wrapped). Requires the aligned path (scalar ``pos`` + ``positions``)
     and a ``local_window`` no larger than the cache.
+    ``read_len`` (static int): attend only cache slots [0, read_len) — the
+    tight-read geometry. The caller guarantees every attended position is
+    below it; the masked tail beyond the active length contributes exact
+    zeros, so logits match the full-length read. Incompatible with ring
+    (the ring is already O(window)).
     """
     B, S, nh, hd = q.shape
+    if read_len is not None:
+        assert not ring, "tight reads do not apply to the rolling (ring) cache"
+        k_cache = slice_kv_time(k_cache, read_len)
+        v_cache = slice_kv_time(v_cache, read_len)
     if isinstance(k_cache, dict):  # int8 KV cache: dequant at the read
         k_cache = dequantize_kv(k_cache, q.dtype)
         v_cache = dequantize_kv(v_cache, q.dtype)

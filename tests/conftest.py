@@ -31,23 +31,31 @@ except AttributeError:
 _CACHE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".pytest_jax_cache"
 )
+# under pytest-xdist each worker gets its OWN dir: the session-start wipe
+# below would race sibling workers on a shared one, and cross-process
+# entry reuse between live workers is the segfault mode it guards against
+_xdist_worker = os.environ.get("PYTEST_XDIST_WORKER")
+if _xdist_worker:
+    _CACHE_DIR += f"-{_xdist_worker}"
 # A cache written by a different jaxlib/CPU hard-aborts (SIGABRT, no
 # traceback) on entry deserialization mid-suite — wipe on stamp mismatch.
 import jaxlib  # noqa: E402
 import platform  # noqa: E402
 import shutil  # noqa: E402
 
-_STAMP = f"{jax.__version__}|{jaxlib.__version__}|{platform.machine()}"
-_stamp_file = os.path.join(_CACHE_DIR, ".stamp")
-try:
-    with open(_stamp_file) as _fh:
-        _cache_ok = _fh.read() == _STAMP
-except OSError:
-    _cache_ok = not os.path.isdir(_CACHE_DIR)  # missing dir = fresh start
-if not _cache_ok:
-    shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+_STAMP = f"{jax.__version__}|{jaxlib.__version__}|{platform.machine()}"  # kept for forensics
+# The cache is SESSION-SCOPED, not cross-run: XLA:CPU executables
+# deserialized from a cache written by ANOTHER process segfault on this
+# jaxlib (reliably reproduced: a fully-green `pytest tests/unit/ops` run
+# followed by an identical rerun on its own cache dies in device_put /
+# engine.step with "Fatal Python error: Segmentation fault"; the
+# jax|jaxlib|arch stamp cannot catch it because the versions match).
+# Same-process re-loads — the per-module clear_caches() below recompiling
+# from the entries THIS run wrote — are safe and are where the ~2x warm
+# speedup actually lives, so wipe at session start and keep the dir on.
+shutil.rmtree(_CACHE_DIR, ignore_errors=True)
 os.makedirs(_CACHE_DIR, exist_ok=True)
-with open(_stamp_file, "w") as _fh:
+with open(os.path.join(_CACHE_DIR, ".stamp"), "w") as _fh:
     _fh.write(_STAMP)
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -28,10 +28,29 @@ def test_aggregate_fixture():
     # nested comm dict flattens to a dotted metric
     assert steps["comm_bytes.all_reduce"]["max"] == 4096
     req = report["inference_request"]
-    assert req["total_ms"]["count"] == 3
+    assert req["total_ms"]["count"] == 3  # the continuous event has none
     assert req["ttft_ms"]["count"] == 2  # fused path has no TTFT field
+    # cache-geometry fields aggregate like any numeric field
+    assert req["kv_bytes_read"]["count"] == 4
+    assert req["cache_utilization"]["max"] == 0.4375
     # comm_summary ops flatten too
     assert report["comm_summary"]["ops.all_reduce.total_bytes"]["max"] == 12288
+
+
+def test_decode_table():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.decode_table(events)
+    assert set(table) == {"fused", "decode_loop", "continuous"}
+    loop = table["decode_loop"]
+    assert loop["count"] == 2
+    assert loop["ttft_ms_p50"] == 5.75
+    assert loop["kv_bytes_read_p95"] == 884736
+    assert loop["kv_bytes_per_token_mean"] == 58982.4
+    # fused events carry no TTFT; the row simply omits those stats
+    assert "ttft_ms_p50" not in table["fused"]
+    assert table["continuous"]["cache_utilization_mean"] == 0.4375
+    text = ds_trace_report.format_decode_table(table)
+    assert "decode summary" in text and "kv_bytes_read_p50" in text
 
 
 def test_kind_filter_and_skip_fields():
@@ -59,9 +78,22 @@ def test_cli_smoke_tables():
     assert proc.returncode == 0, proc.stderr
     out = proc.stdout
     assert "== train_step (3 events) ==" in out
-    assert "== inference_request (3 events) ==" in out
+    assert "== inference_request (4 events) ==" in out
     assert "p50" in out and "p95" in out and "max" in out
     assert "fwd_ms" in out and "ttft_ms" in out and "mfu" in out
+    # the decode summary rides along whenever inference_request events exist
+    assert "decode summary" in out and "kv_bytes_read_p50" in out
+
+
+def test_cli_decode_flag():
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--decode", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    table = json.loads(proc.stdout)["decode"]
+    assert table["decode_loop"]["count"] == 2
+    assert table["continuous"]["kv_bytes_per_token_mean"] == 29491.2
 
 
 def test_cli_json_mode():

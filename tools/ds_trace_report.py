@@ -101,6 +101,60 @@ def aggregate(events, kinds=None, all_fields=False):
     return report
 
 
+def decode_table(events):
+    """Per-path decode/serving summary over ``inference_request`` events:
+    {path: {count, ttft_ms_p50/p95, tok_s_p50/p95, kv_bytes_read_p50/p95,
+    kv_bytes_per_token_mean, cache_utilization_mean}}. The kv_* fields come
+    from the cache-geometry telemetry (int8 KV / tight-read overhaul); rows
+    omit stats their events don't carry (e.g. the fused path has no TTFT)."""
+    by_path = {}
+    for ev in events:
+        if ev.get("kind") != "inference_request":
+            continue
+        by_path.setdefault(ev.get("path", "?"), []).append(ev)
+    out = {}
+    for path, evs in sorted(by_path.items()):
+        row = {"count": len(evs)}
+        for field, label in (("ttft_ms", "ttft_ms"),
+                             ("decode_tokens_per_sec", "tok_s"),
+                             ("kv_bytes_read", "kv_bytes_read")):
+            vals = sorted(float(e[field]) for e in evs
+                          if isinstance(e.get(field), (int, float))
+                          and not isinstance(e.get(field), bool))
+            if vals:
+                row[f"{label}_p50"] = percentile(vals, 50.0)
+                row[f"{label}_p95"] = percentile(vals, 95.0)
+        for field in ("kv_bytes_per_token", "cache_utilization"):
+            vals = [float(e[field]) for e in evs
+                    if isinstance(e.get(field), (int, float))
+                    and not isinstance(e.get(field), bool)]
+            if vals:
+                row[f"{field}_mean"] = sum(vals) / len(vals)
+        out[path] = row
+    return out
+
+
+def format_decode_table(table):
+    if not table:
+        return ""
+    cols = ("count", "ttft_ms_p50", "ttft_ms_p95", "tok_s_p50", "tok_s_p95",
+            "kv_bytes_read_p50", "kv_bytes_read_p95", "kv_bytes_per_token_mean",
+            "cache_utilization_mean")
+    present = [c for c in cols if any(c in row for row in table.values())]
+    name_w = max(len("path"), max(len(p) for p in table))
+    col_w = max(12, max(len(c) for c in present) + 2)
+    lines = ["== decode summary (inference_request by path) =="]
+    header = "path".ljust(name_w) + "".join(c.rjust(col_w) for c in present)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for path, row in table.items():
+        line = path.ljust(name_w)
+        for c in present:
+            line += (_fmt(row[c]) if c in row else "-").rjust(col_w)
+        lines.append(line)
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v):
     if v == 0:
         return "0"
@@ -144,6 +198,10 @@ def main(argv=None):
                     help="emit the aggregate as JSON instead of tables")
     ap.add_argument("--all-fields", action="store_true",
                     help="include bookkeeping fields (ts, step, ...)")
+    ap.add_argument("--decode", action="store_true",
+                    help="only the per-path decode summary (TTFT/tok-s/"
+                         "kv_bytes_read percentiles over inference_request "
+                         "events)")
     args = ap.parse_args(argv)
 
     try:
@@ -162,11 +220,26 @@ def main(argv=None):
         print(f"no events in {args.trace}", file=sys.stderr)
         return 1
 
+    if args.decode:
+        table = decode_table(events)
+        if not table:
+            print("no inference_request events in the trace", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"decode": table}, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_decode_table(table))
+        return 0
+
     report = aggregate(events, kinds=args.kind, all_fields=args.all_fields)
     if args.as_json:
         print(json.dumps(report, indent=2, sort_keys=True))
     else:
         sys.stdout.write(format_tables(report))
+        if not args.kind or "inference_request" in args.kind:
+            table = decode_table(events)
+            if table:
+                sys.stdout.write("\n" + format_decode_table(table))
     return 0
 
 
