@@ -48,8 +48,9 @@ requests emit an ``inference_request`` event with ``kv_bytes_read`` /
 ``step()`` maintains a ``cache_utilization`` gauge for dashboards.
 """
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -171,6 +172,18 @@ class ContinuousBatchingEngine:
         self._prefixes: Dict[int, dict] = {}  # prefix caching (register_prefix)
         self._pending: List[_Request] = []
         self._results: Dict[int, np.ndarray] = {}
+        # cancelled rids, remembered so status()/result() answer precisely
+        # instead of "unknown" — BOUNDED (oldest evicted past 4096): a
+        # long-running server cancels routinely and must not leak an int
+        # per cancellation for its lifetime. Evicted rids age back to
+        # "unknown", which is also what collected results report.
+        self._cancelled: "OrderedDict[int, None]" = OrderedDict()
+        self._cancelled_cap = 4096
+        # serving-layer enrichment point: called in _finish with
+        # (rid, event dict) and may mutate/replace the event before it is
+        # emitted (deepspeed_tpu/serving adds queue_ms/priority/deadline_met
+        # and retags path:"serving"). None = emit the event as built.
+        self.request_event_hook: Optional[Callable[[int, dict], Optional[dict]]] = None
 
     # -- single-pool compatibility surface (tests, introspection) --------
     @property
@@ -200,14 +213,27 @@ class ContinuousBatchingEngine:
         return sum(p.kv_bytes() for p in self._pools)
 
     # -- public API -----------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+    def validate_request(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
+        """Argument checks shared by ``submit`` and the serving layer's
+        admission control (which must reject malformed requests BEFORE
+        deciding whether capacity exists). Raises ValueError — a real
+        error, not an assert that vanishes under ``python -O`` — and
+        returns the canonicalized prompt array."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        assert prompt.size > 0, "empty prompt"
-        assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
-        assert prompt.size + max_new_tokens <= self.cache_len, (
-            f"prompt {prompt.size} + max_new_tokens {max_new_tokens} exceeds "
-            f"the largest pool cache_len {self.cache_len}"
-        )
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (admission emits a token)")
+        if prompt.size + max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds the largest pool cache_len {self.cache_len}"
+            )
+        return prompt
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        prompt = self.validate_request(prompt_ids, max_new_tokens)
         rid = self._next_rid
         self._next_rid += 1
         self._pending.append(_Request(rid, prompt, max_new_tokens))
@@ -220,8 +246,10 @@ class ContinuousBatchingEngine:
         the per-request suffix. Returns a prefix id for submit_with_prefix.
         """
         prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
-        assert prefix.size > 0, "empty prefix"
-        assert prefix.size < self.cache_len, "prefix does not fit the cache"
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        if prefix.size >= self.cache_len:
+            raise ValueError("prefix does not fit the cache")
         from deepspeed_tpu.models import transformer as tf
 
         n = prefix.size
@@ -261,14 +289,18 @@ class ContinuousBatchingEngine:
         """Queue a request whose prompt is (registered prefix + suffix);
         the prefix KV is reused, only the suffix is prefilled."""
         suffix = np.asarray(suffix_ids, np.int32).reshape(-1)
-        assert suffix.size > 0, "empty suffix (use submit for prefix-only prompts)"
-        assert max_new_tokens >= 1, "max_new_tokens must be >= 1 (admission emits a token)"
+        if suffix.size == 0:
+            raise ValueError("empty suffix (use submit for prefix-only prompts)")
+        if max_new_tokens < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (admission emits a token)")
         pre = self._require_prefix(prefix_id)
         total = pre["tokens"].size + suffix.size
-        assert total + max_new_tokens <= self.cache_len, (
-            f"prefix {pre['tokens'].size} + suffix {suffix.size} + "
-            f"max_new_tokens {max_new_tokens} exceeds cache_len {self.cache_len}"
-        )
+        if total + max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"prefix {pre['tokens'].size} + suffix {suffix.size} + "
+                f"max_new_tokens {max_new_tokens} exceeds cache_len {self.cache_len}"
+            )
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, np.concatenate([pre["tokens"], suffix]), max_new_tokens)
@@ -279,8 +311,72 @@ class ContinuousBatchingEngine:
     def has_work(self) -> bool:
         return bool(self._pending) or any(p.active for p in self._pools)
 
+    def status(self, rid: int) -> str:
+        """Non-destructive request state: ``"pending"`` (queued, no slot
+        yet), ``"active"`` (decoding in a slot), ``"finished"`` (result
+        ready, not yet collected), ``"cancelled"``, or ``"unknown"``
+        (never submitted, or result already collected)."""
+        if any(r.rid == rid for r in self._pending):
+            return "pending"
+        if any(r.rid == rid for p in self._pools for r in p.active.values()):
+            return "active"
+        if rid in self._results:
+            return "finished"
+        if rid in self._cancelled:
+            return "cancelled"
+        return "unknown"
+
+    def peek(self, rid: int) -> Optional[np.ndarray]:
+        """The finished result for ``rid`` WITHOUT consuming it (``result``
+        pops; pollers — the serving layer — must not race the collector).
+        None while the request is pending/active or the rid is unknown."""
+        return self._results.get(rid)
+
     def result(self, rid: int) -> np.ndarray:
-        return self._results.pop(rid)
+        try:
+            return self._results.pop(rid)
+        except KeyError:
+            state = self.status(rid)
+            detail = {
+                "pending": "still queued for a slot (step() until finished)",
+                "active": "still decoding (step() until finished)",
+                "cancelled": "cancelled before it finished",
+                "unknown": "never submitted or its result was already collected",
+            }[state]
+            raise KeyError(
+                f"no result for request {rid}: {state} — {detail}") from None
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request: a pending one leaves the queue, an active one
+        frees its pool slot immediately (no cache clearing needed — slot
+        reuse position-masks stale KV, same as normal completion). Returns
+        False when the rid is already finished/collected/unknown: too late
+        to cancel, the caller keeps the result semantics it already has."""
+        for i, req in enumerate(self._pending):
+            if req.rid == rid:
+                self._pending.pop(i)
+                self._mark_cancelled(rid)
+                return True
+        for pool in self._pools:
+            for slot, req in pool.active.items():
+                if req.rid == rid:
+                    pool.active.pop(slot)
+                    self._mark_cancelled(rid)
+                    return True
+        return False
+
+    def _mark_cancelled(self, rid: int):
+        self._cancelled[rid] = None
+        while len(self._cancelled) > self._cancelled_cap:
+            self._cancelled.popitem(last=False)
+
+    def pool_state(self) -> List[dict]:
+        """Per-pool occupancy snapshot (ordered by pool length, the same
+        order ``_place`` scans): ``{"length", "slots", "free"}``. The
+        serving layer's admission control mirrors placement against this
+        without reaching into ``_pools``."""
+        return [{"length": p.length, "slots": p.n_slots,
+                 "free": p.n_slots - len(p.active)} for p in self._pools]
 
     def finished(self) -> Dict[int, np.ndarray]:
         out, self._results = self._results, {}
@@ -563,4 +659,6 @@ class ContinuousBatchingEngine:
             }
             if new > 1:  # admission emits the first token without a pool read
                 event["kv_bytes_per_token"] = round(req.kv_bytes_read / (new - 1), 1)
+            if self.request_event_hook is not None:
+                event = self.request_event_hook(req.rid, event) or event
             tele.emit("inference_request", event)

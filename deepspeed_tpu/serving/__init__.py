@@ -1,0 +1,38 @@
+"""SLO-aware request serving over continuous batching: admission control
+with explicit backpressure, pluggable scheduler policies (FIFO /
+priority / EDF / fair share) with anti-starvation aging, request
+lifecycle (cancel, stream, deadline shedding), and the load-test harness
+behind ``tools/ds_loadgen.py``. See docs/serving.md."""
+
+from deepspeed_tpu.serving.engine import ServingEngine, TokenStream
+from deepspeed_tpu.serving.policies import (
+    EdfPolicy,
+    FairSharePolicy,
+    FifoPolicy,
+    PriorityPolicy,
+    SchedulerPolicy,
+    resolve_policy,
+)
+from deepspeed_tpu.serving.request import (
+    ADMITTED,
+    CANCELLED,
+    EXPIRED,
+    FINISHED,
+    QUEUED,
+    QUEUED_STATUS,
+    RUNNING,
+    SHED,
+    TERMINAL_STATES,
+    Admission,
+    ServeRequest,
+)
+
+__all__ = [
+    "ServingEngine", "TokenStream",
+    "SchedulerPolicy", "FifoPolicy", "PriorityPolicy", "EdfPolicy",
+    "FairSharePolicy", "resolve_policy",
+    "Admission", "ServeRequest",
+    "ADMITTED", "QUEUED_STATUS", "SHED",
+    "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED",
+    "TERMINAL_STATES",
+]

@@ -1,0 +1,460 @@
+"""SLO-aware serving front-end over :class:`ContinuousBatchingEngine`.
+
+The batching engine (inference/continuous.py) is a fast decode loop with
+an UNBOUNDED pending list drained FIFO-with-skip: fine for a script, not
+a server. :class:`ServingEngine` adds the layer a server needs, without
+touching the hot path:
+
+- **Bounded admission + backpressure**: ``submit`` returns an
+  :class:`Admission` verdict — ``admitted`` (handed to the engine now),
+  ``queued`` (bounded queue), or ``shed`` (queue full / KV token budget
+  exceeded; nothing enqueued, retry-after hint attached) — instead of
+  growing a list without bound.
+- **Pluggable scheduling**: FIFO, strict priority, earliest-deadline-
+  first, per-tenant fair share (serving/policies.py), all subject to one
+  anti-starvation aging rule: a request whose queue wait exceeds
+  ``aging_s`` can no longer be leapfrogged, replacing bare FIFO-with-skip.
+- **Request lifecycle**: cancellation frees the pool slot mid-flight,
+  per-token streaming (callback or pull iterator), and queued work whose
+  deadline has blown is shed instead of decoded uselessly.
+- **Telemetry**: every lifecycle transition counts
+  (``serve_admitted/shed/expired/cancelled/finished_total``,
+  ``serve_deadline_met/missed_total``, ``serve_queue_depth`` /
+  ``serve_committed_tokens`` gauges); finished requests' per-request
+  ``inference_request`` events are enriched in place (via the engine's
+  ``request_event_hook``) with ``path:"serving"``, ``queue_ms``,
+  ``ttft_ms``, ``priority``, ``tenant``, ``deadline_ms``/``deadline_met``
+  so ``ds_trace_report --serve`` can summarize a run.
+
+Single-threaded by design, like the engine it wraps: the caller (or
+``tools/ds_loadgen.py``) drives ``step()``; everything is deterministic
+given the injected ``clock``, which is what makes the scheduler-policy
+tests exact.
+
+    cb = ContinuousBatchingEngine(model, config=..., cache_buckets=...)
+    srv = ServingEngine(cb, policy="edf", max_queue_depth=32)
+    adm = srv.submit(prompt, max_new_tokens=64, deadline_ms=500)
+    if adm:                       # admitted or queued (falsy == shed)
+        for tok in srv.stream(adm.rid):
+            ...                   # pulls srv.step() under the hood
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.serving.policies import SchedulerPolicy, resolve_policy
+from deepspeed_tpu.serving.request import (
+    ADMITTED,
+    CANCELLED,
+    EXPIRED,
+    FINISHED,
+    QUEUED,
+    QUEUED_STATUS,
+    RUNNING,
+    SHED,
+    TERMINAL_STATES,
+    Admission,
+    ServeRequest,
+)
+
+
+class TokenStream:
+    """Pull-based per-token iterator over one request's output. Each
+    ``next()`` returns the next generated token, driving
+    ``ServingEngine.step()`` as needed; iteration ends when the request
+    reaches a terminal state (check ``request.state`` to tell a finished
+    stream from a cancelled/expired one)."""
+
+    def __init__(self, serving: "ServingEngine", request: ServeRequest):
+        self._serving = serving
+        self._request = request
+        self._i = 0
+
+    @property
+    def request(self) -> ServeRequest:
+        return self._request
+
+    def __iter__(self) -> "TokenStream":
+        return self
+
+    def __next__(self) -> int:
+        while self._i >= len(self._request.tokens):
+            if self._request.state in TERMINAL_STATES:
+                raise StopIteration
+            if not self._serving.has_work():
+                raise StopIteration
+            self._serving.step()
+        tok = self._request.tokens[self._i]
+        self._i += 1
+        return tok
+
+
+class ServingEngine:
+    """Admission control + scheduling + lifecycle over a
+    :class:`ContinuousBatchingEngine` (which this object then owns: it
+    installs the request-event hook and expects to be the only caller of
+    ``engine.submit``/``step``)."""
+
+    def __init__(self, engine, policy="fifo", max_queue_depth: int = 64,
+                 kv_budget_tokens: Optional[int] = None,
+                 aging_s: float = 30.0, clock=time.monotonic):
+        if max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        if aging_s <= 0:
+            raise ValueError("aging_s must be > 0")
+        self._cb = engine
+        self.policy: SchedulerPolicy = resolve_policy(policy, aging_s=aging_s)
+        self.max_queue_depth = max_queue_depth
+        # KV token budget: total prompt+output tokens committed across
+        # RUNNING + QUEUED requests. Default 2x the slot-pool capacity —
+        # one poolful decoding plus one poolful staged behind it; more
+        # than that is queue wait the client should see as backpressure.
+        cap = sum(p["slots"] * p["length"] for p in engine.pool_state())
+        self.kv_budget_tokens = (kv_budget_tokens if kv_budget_tokens is not None
+                                 else 2 * cap)
+        if self.kv_budget_tokens < 1:
+            raise ValueError("kv_budget_tokens must be >= 1")
+        self.aging_s = aging_s
+        self._clock = clock
+        self._tele = engine._eng.telemetry
+        self._queue: List[ServeRequest] = []
+        self._running: Dict[int, ServeRequest] = {}   # engine rid -> request
+        self._requests: Dict[int, ServeRequest] = {}  # serving rid -> request
+        # handed to the engine but not yet admitted by an engine tick: the
+        # engine queues them in _pending, so pool_state() still reports
+        # their slots free — admission math must reserve them explicitly
+        self._staged: Dict[int, int] = {}             # engine rid -> need_tokens
+        self._next_rid = 0
+        self._t_start: Optional[float] = None  # first submit: rate clock zero
+        self._tokens_done = 0                  # finished requests' tokens
+        engine.request_event_hook = self._event_hook
+
+    # -- public API -----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               priority: int = 0, tenant: str = "default",
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> Admission:
+        """Admission-controlled submit. Malformed arguments raise
+        ValueError (an oversized request can NEVER run — that is an
+        error, not load); a well-formed one is admitted, queued, or shed
+        with explicit backpressure. Shed requests get no id and leave no
+        state behind."""
+        prompt = self._cb.validate_request(prompt_ids, max_new_tokens)
+        need = int(prompt.size) + max_new_tokens
+        if need > self.kv_budget_tokens:
+            # structurally inadmissible: no amount of draining frees
+            # enough budget, so a shed-with-retry-hint would loop forever
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds kv_budget_tokens {self.kv_budget_tokens}: this "
+                f"request can never be admitted under the configured budget")
+        now = self._clock()
+        if self._t_start is None:
+            self._t_start = now
+        if len(self._queue) >= self.max_queue_depth:
+            return self._shed("queue_full", prompt, need, now)
+        committed = self.committed_tokens()
+        if committed + need > self.kv_budget_tokens:
+            return self._shed("kv_budget", prompt, need, now,
+                              excess=committed + need - self.kv_budget_tokens)
+        rid = self._next_rid
+        self._next_rid += 1
+        req = ServeRequest(rid=rid, prompt=prompt,
+                           max_new_tokens=max_new_tokens, priority=priority,
+                           tenant=tenant, deadline_ms=deadline_ms,
+                           on_token=on_token, submit_t=now)
+        self._requests[rid] = req
+        # empty queue + a fitting free slot: hand straight to the engine —
+        # the strongest statement submit can truthfully make (with a
+        # non-empty queue the policy decides, so the verdict is "queued")
+        if not self._queue and self._fits_now(need):
+            self._handover(req, now)
+            status = ADMITTED
+        else:
+            self._queue.append(req)
+            status = QUEUED_STATUS
+        self._update_gauges()
+        return Admission(status=status, rid=rid)
+
+    def step(self) -> Dict[int, List[int]]:
+        """One serving tick: expire deadline-blown queued work, place
+        queued requests into free slots in policy order (bounded by the
+        aging barrier), then one engine tick. Returns {rid: [tokens]}
+        emitted this tick, keyed by SERVING rid."""
+        now = self._clock()
+        self._expire(now)
+        self._schedule(now)
+        out: Dict[int, List[int]] = {}
+        if self._cb.has_work():
+            emitted = self._cb.step()
+            # the engine admits every placeable pending request at the top
+            # of its tick, and we only hand over what fits — so after the
+            # tick the staged reservations are real slots (pool_state now
+            # counts them) or already finished-and-freed
+            self._staged.clear()
+            tnow = self._clock()
+            for erid, toks in emitted.items():
+                req = self._running.get(erid)
+                if req is None:
+                    continue  # not ours (direct engine.submit user)
+                if req.first_token_t is None and toks:
+                    req.first_token_t = tnow
+                req.tokens.extend(toks)
+                out[req.rid] = list(toks)
+                if req.on_token is not None:
+                    for tok in toks:
+                        req.on_token(req.rid, tok)
+            for erid, result in self._cb.finished().items():
+                req = self._running.pop(erid, None)
+                if req is None:
+                    continue
+                req.state = FINISHED
+                req.finish_t = tnow
+                req.result = result
+                if req.deadline_ms is not None and req.deadline_met is None:
+                    # telemetry off: the event hook didn't judge it first
+                    req.deadline_met = tnow <= req.deadline_at
+                self._tokens_done += len(req.tokens)
+                self.policy.on_finish(req, tnow)
+                if self._tele.enabled:
+                    reg = self._tele.registry
+                    reg.counter("serve_finished_total").inc()
+                    if req.deadline_met is not None:
+                        reg.counter("serve_deadline_met_total"
+                                    if req.deadline_met
+                                    else "serve_deadline_missed_total").inc()
+        self._update_gauges()
+        return out
+
+    def run(self, max_ticks: Optional[int] = None) -> int:
+        """Step until idle (or ``max_ticks``); returns ticks taken."""
+        ticks = 0
+        while self.has_work():
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            self.step()
+            ticks += 1
+        return ticks
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or self._cb.has_work()
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def committed_tokens(self) -> int:
+        """Prompt+output tokens committed by queued + running requests —
+        what admission weighs against ``kv_budget_tokens``."""
+        return (sum(r.need_tokens for r in self._queue)
+                + sum(r.need_tokens for r in self._running.values()))
+
+    def status(self, rid: int) -> str:
+        req = self._requests.get(rid)
+        return req.state if req is not None else "unknown"
+
+    def request(self, rid: int) -> Optional[ServeRequest]:
+        """The live request record (None once reaped or never admitted)."""
+        return self._requests.get(rid)
+
+    def result(self, rid: int):
+        """Pop a FINISHED request's full token array (prompt + generated).
+        Raises KeyError naming the actual state otherwise — mirrors
+        ``ContinuousBatchingEngine.result`` semantics."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(f"no result for request {rid}: unknown — never "
+                           f"admitted, shed, or already reaped")
+        if req.state != FINISHED:
+            raise KeyError(f"no result for request {rid}: {req.state}")
+        self._requests.pop(rid)
+        return req.result
+
+    def reap(self) -> Dict[int, ServeRequest]:
+        """Remove and return every terminal-state request record —
+        finished (``.result`` holds the tokens), cancelled, and expired.
+        A long-running server calls this (or ``result``) to keep the
+        record table bounded; the load generator uses it for reporting."""
+        done = {rid: r for rid, r in self._requests.items()
+                if r.state in TERMINAL_STATES}
+        for rid in done:
+            self._requests.pop(rid)
+        return done
+
+    def close(self):
+        """Flush/close the telemetry trace (the engines share one hub);
+        the load generator and servers call this at shutdown."""
+        self._tele.close()
+
+    def stream(self, rid: int) -> TokenStream:
+        """Per-token pull iterator for an admitted/queued request; tokens
+        already emitted are replayed first, then each ``next()`` drives
+        ``step()`` until the next token or a terminal state."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(f"unknown request {rid}: shed or already reaped")
+        return TokenStream(self, req)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or running request. A running one frees its
+        pool slot immediately — the next ``step()`` can admit into it.
+        False when already terminal/unknown (nothing left to cancel)."""
+        req = self._requests.get(rid)
+        if req is None or req.state in TERMINAL_STATES:
+            return False
+        now = self._clock()
+        if req.state == QUEUED:
+            self._queue = [r for r in self._queue if r.rid != rid]
+        else:  # RUNNING
+            self._cb.cancel(req.engine_rid)
+            self._running.pop(req.engine_rid, None)
+            self._staged.pop(req.engine_rid, None)
+        req.state = CANCELLED
+        req.finish_t = now
+        if self._tele.enabled:
+            self._tele.registry.counter("serve_cancelled_total").inc()
+            self._tele.emit("serving_event", {
+                "event": "cancelled", "request": rid,
+                "queue_ms": round(req.waited_s(now) * 1000.0, 3),
+                "tokens_emitted": len(req.tokens),
+            })
+        self._update_gauges()
+        return True
+
+    # -- internals ------------------------------------------------------
+    def _shed(self, reason: str, prompt, need: int, now: float,
+              excess: Optional[int] = None) -> Admission:
+        hint = self._retry_after(need if excess is None else excess, now)
+        if self._tele.enabled:
+            self._tele.registry.counter("serve_shed_total").inc()
+            event = {"event": "shed", "reason": reason,
+                     "prompt_tokens": int(prompt.size), "need_tokens": need,
+                     "queue_depth": len(self._queue),
+                     "committed_tokens": self.committed_tokens()}
+            if hint is not None:
+                event["retry_after_s"] = hint
+            self._tele.emit("serving_event", event)
+        return Admission(status=SHED, reason=reason, retry_after_s=hint)
+
+    def _retry_after(self, excess_tokens: int, now: float) -> Optional[float]:
+        """Coarse backpressure hint: how long until ``excess_tokens`` of
+        committed work drains at the observed completion rate. None until
+        any request has finished (no rate to extrapolate from)."""
+        if self._tokens_done <= 0 or self._t_start is None:
+            return None
+        elapsed = now - self._t_start
+        if elapsed <= 0:
+            return None
+        rate = self._tokens_done / elapsed
+        return round(max(1, excess_tokens) / rate, 3)
+
+    def _effective_pool_state(self) -> List[dict]:
+        """pool_state() with staged handovers already subtracted, placed
+        the way the engine's ``_place`` will (smallest fitting pool)."""
+        pools = [dict(p) for p in self._cb.pool_state()]
+        for need in self._staged.values():
+            pool = next((p for p in pools
+                         if p["length"] >= need and p["free"] > 0), None)
+            if pool is not None:
+                pool["free"] -= 1
+        return pools
+
+    def _fits_now(self, need: int) -> bool:
+        return any(p["length"] >= need and p["free"] > 0
+                   for p in self._effective_pool_state())
+
+    def _handover(self, req: ServeRequest, now: float):
+        req.engine_rid = self._cb.submit(req.prompt, req.max_new_tokens)
+        req.state = RUNNING
+        req.admit_t = now
+        self._staged[req.engine_rid] = req.need_tokens
+        self._running[req.engine_rid] = req
+        self.policy.on_admit(req, now)
+        if self._tele.enabled:
+            self._tele.registry.counter("serve_admitted_total").inc()
+
+    def _schedule(self, now: float):
+        """Place queued requests into free slots in policy order, bounded
+        by the anti-starvation aging rule: a request that has waited
+        ``aging_s`` (a) moves to the head of the order, oldest first —
+        so a request the policy keeps outranking (no-deadline work under
+        EDF, low priority under a high-priority stream) still gets the
+        next slot it fits — and (b) becomes a barrier when it does NOT
+        fit: nothing ranked behind it may leapfrog (the fix for the bare
+        FIFO-with-skip mode where a long request waiting for the big pool
+        starves behind an endless stream of short ones)."""
+        if not self._queue:
+            return
+        free = self._effective_pool_state()
+        placed = set()
+        order = self.policy.order(self._queue, now)
+        aged = [r for r in order if r.waited_s(now) >= self.aging_s]
+        if aged:
+            aged.sort(key=lambda r: r.rid)  # oldest aged request first
+            fresh = [r for r in order if r.waited_s(now) < self.aging_s]
+            order = aged + fresh
+        for req in order:
+            pool = next((p for p in free
+                         if p["length"] >= req.need_tokens and p["free"] > 0),
+                        None)
+            if pool is None:
+                if req.waited_s(now) >= self.aging_s:
+                    break  # aging barrier: nobody leapfrogs an aged request
+                continue
+            pool["free"] -= 1
+            self._handover(req, now)
+            placed.add(req.rid)
+        if placed:
+            self._queue = [r for r in self._queue if r.rid not in placed]
+
+    def _expire(self, now: float):
+        """Shed queued work whose deadline already blew: decoding it would
+        burn slot time on a response the client stopped waiting for."""
+        expired = [r for r in self._queue if now > r.deadline_at]
+        if not expired:
+            return
+        for req in expired:
+            req.state = EXPIRED
+            req.finish_t = now
+            if self._tele.enabled:
+                self._tele.registry.counter("serve_expired_total").inc()
+                self._tele.emit("serving_event", {
+                    "event": "expired", "request": req.rid,
+                    "queue_ms": round(req.waited_s(now) * 1000.0, 3),
+                    "deadline_ms": req.deadline_ms,
+                })
+        self._queue = [r for r in self._queue if r.state == QUEUED]
+
+    def _update_gauges(self):
+        if not self._tele.enabled:
+            return
+        reg = self._tele.registry
+        reg.gauge("serve_queue_depth").set(len(self._queue))
+        reg.gauge("serve_committed_tokens").set(self.committed_tokens())
+
+    def _event_hook(self, engine_rid: int, event: dict) -> Optional[dict]:
+        """Installed as the batching engine's ``request_event_hook``:
+        enrich the per-request ``inference_request`` event with the
+        serving-side lifecycle fields (and retag it as ours)."""
+        req = self._running.get(engine_rid)
+        if req is None:
+            return None  # a direct engine.submit request: leave it alone
+        now = self._clock()
+        event["path"] = "serving"
+        event["request"] = req.rid
+        q = req.queue_ms()
+        if q is not None:
+            event["queue_ms"] = round(q, 3)
+        # finishing tick: first_token_t for a one-tick request is not
+        # recorded yet, so fall back to "now" (same tick that emitted it)
+        ttft = req.ttft_ms()
+        event["ttft_ms"] = round(
+            ttft if ttft is not None else (now - req.submit_t) * 1000.0, 3)
+        event["priority"] = req.priority
+        event["tenant"] = req.tenant
+        if req.deadline_ms is not None:
+            # this is the request's single SLO verdict: the counters and
+            # loadgen records reuse it rather than re-reading the clock
+            req.deadline_met = bool(now <= req.deadline_at)
+            event["deadline_ms"] = req.deadline_ms
+            event["deadline_met"] = req.deadline_met
+        return event

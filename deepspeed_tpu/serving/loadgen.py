@@ -1,0 +1,398 @@
+"""Open-loop load generator + trace-replay harness for the serving layer
+(the engine room of ``tools/ds_loadgen.py``).
+
+Open-loop means arrivals follow a schedule that does NOT wait for the
+server — the regime that exposes tail latency and shedding (a closed
+loop self-throttles and hides both; see the Gemma-on-TPU serving writeup
+in PAPERS.md). The harness:
+
+1. generates (or replays) a workload: arrival times from a Poisson /
+   uniform / bursty process plus per-request prompt/output-length,
+   priority, tenant, and deadline mixes;
+2. drives a :class:`ServingEngine` in-process — submit when due, step
+   while there is work;
+3. reports what serving stacks are judged on: TTFT / TBT / queue-wait
+   percentiles, goodput vs offered load, and shed rate.
+
+With ``telemetry.trace_file`` set on the wrapped engine, the run also
+leaves a JSONL trace that ``tools/ds_trace_report.py --serve``
+summarizes — the same numbers computed from the event stream instead of
+in-process records.
+
+Workload items are plain dicts (JSONL-serializable for replay):
+``{"arrival_s", "prompt_tokens" | "prompt", "max_new_tokens",
+"priority", "tenant", "deadline_ms"}`` — ``prompt`` is explicit token
+ids (recorded mixes); ``prompt_tokens`` a length the harness fills with
+deterministic synthetic ids.
+"""
+
+import argparse
+import json
+import random
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.telemetry.registry import percentile
+
+_PROCESSES = ("poisson", "uniform", "burst")
+
+
+# -- workload synthesis ------------------------------------------------
+def gen_arrivals(n: int, rate: float, process: str = "poisson",
+                 seed: int = 0, burst_size: int = 8) -> List[float]:
+    """``n`` arrival offsets (seconds, ascending) at ``rate`` req/s.
+
+    poisson: exponential inter-arrivals — the memoryless open-loop
+    baseline. uniform: fixed spacing (the gentlest schedule at a given
+    rate). burst: groups of ``burst_size`` arriving together, bursts
+    spaced to preserve the average rate — the admission-control stressor.
+    """
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    if rate <= 0:
+        raise ValueError("rate must be > 0 req/s")
+    if process not in _PROCESSES:
+        raise ValueError(f"unknown arrival process {process!r} "
+                         f"(choose from {_PROCESSES})")
+    rng = random.Random(seed)
+    out, t = [], 0.0
+    if process == "poisson":
+        for _ in range(n):
+            t += rng.expovariate(rate)
+            out.append(t)
+    elif process == "uniform":
+        out = [i / rate for i in range(n)]
+    else:  # burst
+        if burst_size < 1:
+            raise ValueError("burst_size must be >= 1")
+        while len(out) < n:
+            out.extend([t] * min(burst_size, n - len(out)))
+            t += burst_size / rate
+    return out
+
+
+def synth_workload(n: int, seed: int = 0, prompt_range=(4, 16),
+                   new_range=(4, 16), tenants: int = 1, priorities: int = 1,
+                   deadline_ms: Optional[float] = None) -> List[dict]:
+    """``n`` request dicts with uniformly mixed prompt/output lengths,
+    round-robin-free random tenant/priority assignment, and an optional
+    uniform deadline. Fully determined by ``seed``."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        item = {
+            "prompt_tokens": int(rs.randint(prompt_range[0], prompt_range[1] + 1)),
+            "max_new_tokens": int(rs.randint(new_range[0], new_range[1] + 1)),
+        }
+        if priorities > 1:
+            item["priority"] = int(rs.randint(0, priorities))
+        if tenants > 1:
+            item["tenant"] = f"tenant{int(rs.randint(0, tenants))}"
+        if deadline_ms is not None:
+            item["deadline_ms"] = float(deadline_ms)
+        out.append(item)
+    return out
+
+
+def dump_workload(path: str, workload: List[dict],
+                  arrivals: Optional[List[float]] = None):
+    """Write a workload (+ arrival offsets) as replayable JSONL."""
+    with open(path, "w") as fh:
+        for i, item in enumerate(workload):
+            rec = dict(item)
+            if arrivals is not None:
+                rec["arrival_s"] = arrivals[i]
+            fh.write(json.dumps(rec) + "\n")
+
+
+def load_workload(path: str):
+    """(workload, arrivals) from a JSONL trace written by
+    :func:`dump_workload` (or recorded elsewhere in the same shape).
+    Arrivals is None when no line carries ``arrival_s``."""
+    workload, arrivals = [], []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            arrivals.append(rec.pop("arrival_s", None))
+            workload.append(rec)
+    if not workload:
+        raise ValueError(f"no workload records in {path}")
+    if any(a is None for a in arrivals):
+        return workload, None
+    return workload, arrivals
+
+
+# -- driving the engine ------------------------------------------------
+def _item_prompt(item: dict, index: int, seed: int, vocab: int) -> np.ndarray:
+    if "prompt" in item:
+        return np.asarray(item["prompt"], np.int32)
+    n = int(item["prompt_tokens"])
+    # per-item stream: prompts don't shift when the mix is resliced
+    return np.random.RandomState(seed + index).randint(0, vocab, (n,)).astype(np.int32)
+
+
+def run_load(serving, workload: List[dict], arrivals: List[float],
+             seed: int = 0, clock=time.monotonic, sleep=time.sleep):
+    """Drive ``serving`` open-loop: submit each workload item at its
+    arrival offset (never waiting for the server), stepping whenever
+    there is work. Returns ``(records, wall_s)`` — one record per item
+    with the admission verdict and, for admitted requests, the final
+    lifecycle numbers (queue/ttft/tbt ms, tokens, deadline_met)."""
+    if len(arrivals) != len(workload):
+        raise ValueError(f"{len(workload)} workload items but "
+                         f"{len(arrivals)} arrival times")
+    vocab = serving._cb.cfg.vocab_size
+    n = len(workload)
+    records: List[dict] = [{} for _ in range(n)]
+    rid_to_index: Dict[int, int] = {}
+    t0 = clock()
+    i = 0
+    while i < n or serving.has_work():
+        now = clock() - t0
+        while i < n and arrivals[i] <= now:
+            item = workload[i]
+            adm = serving.submit(
+                _item_prompt(item, i, seed, vocab),
+                int(item.get("max_new_tokens", 32)),
+                priority=int(item.get("priority", 0)),
+                tenant=str(item.get("tenant", "default")),
+                deadline_ms=item.get("deadline_ms"),
+            )
+            rec = records[i]
+            rec["status"] = adm.status
+            rec["arrival_s"] = arrivals[i]
+            if adm:
+                rid_to_index[adm.rid] = i
+                rec["rid"] = adm.rid
+            else:
+                rec["state"] = "shed"
+                rec["reason"] = adm.reason
+                if adm.retry_after_s is not None:
+                    rec["retry_after_s"] = adm.retry_after_s
+            i += 1
+        if serving.has_work():
+            serving.step()
+        elif i < n:
+            # idle before the next arrival: don't spin the host
+            sleep(min(max(arrivals[i] - (clock() - t0), 0.0), 0.002))
+    wall_s = clock() - t0
+    for rid, req in serving.reap().items():
+        rec = records[rid_to_index[rid]]
+        rec["state"] = req.state
+        rec["tokens"] = len(req.tokens)
+        rec["generated"] = list(req.tokens)  # parity checks / replay diffing
+        q = req.queue_ms()
+        if q is not None:
+            rec["queue_ms"] = q
+        t = req.ttft_ms()
+        if t is not None:
+            rec["ttft_ms"] = t
+        if (req.first_token_t is not None and req.finish_t is not None
+                and len(req.tokens) > 1):
+            rec["tbt_ms"] = ((req.finish_t - req.first_token_t) * 1000.0
+                             / (len(req.tokens) - 1))
+        if req.deadline_met is not None:  # the shared per-request verdict
+            rec["deadline_met"] = req.deadline_met
+    return records, wall_s
+
+
+# -- reporting ---------------------------------------------------------
+def _pcts(vals: List[float]) -> dict:
+    return {"p50": percentile(vals, 50.0), "p99": percentile(vals, 99.0)}
+
+
+def summarize(records: List[dict], wall_s: float) -> dict:
+    """The serving scorecard over one run's records: counts per outcome,
+    TTFT/TBT/queue-wait p50/p99, offered load, throughput, goodput
+    (deadline-met output tokens per second — all finished tokens when the
+    workload carries no deadlines), shed rate, deadline-met fraction."""
+    by_state: Dict[str, int] = {}
+    for r in records:
+        state = r.get("state", r.get("status", "?"))
+        by_state[state] = by_state.get(state, 0) + 1
+    finished = [r for r in records if r.get("state") == "finished"]
+    shed = [r for r in records if r.get("state") in ("shed", "expired")]
+    arrivals = [r["arrival_s"] for r in records if "arrival_s" in r]
+    span = max(arrivals) if arrivals else 0.0
+    out = {
+        "requests": len(records),
+        "outcomes": dict(sorted(by_state.items())),
+        "wall_s": round(wall_s, 3),
+        "offered_rps": round(len(records) / span, 3) if span > 0 else None,
+        "shed_rate": round(len(shed) / len(records), 4) if records else 0.0,
+    }
+    for field in ("ttft_ms", "tbt_ms", "queue_ms"):
+        vals = [r[field] for r in finished if field in r]
+        if vals:
+            out[field] = {k: round(v, 3) for k, v in _pcts(vals).items()}
+    total_tokens = sum(r.get("tokens", 0) for r in finished)
+    out["throughput_tok_s"] = round(total_tokens / wall_s, 3) if wall_s > 0 else 0.0
+    with_deadline = [r for r in finished if "deadline_met" in r]
+    good_tokens = sum(r.get("tokens", 0) for r in finished
+                      if r.get("deadline_met", True))
+    out["goodput_tok_s"] = round(good_tokens / wall_s, 3) if wall_s > 0 else 0.0
+    if with_deadline:
+        out["deadline_met_frac"] = round(
+            sum(1 for r in with_deadline if r["deadline_met"])
+            / len(with_deadline), 4)
+    return out
+
+
+def format_summary(summary: dict) -> str:
+    lines = ["== ds_loadgen summary =="]
+    oc = " ".join(f"{k}={v}" for k, v in summary["outcomes"].items())
+    lines.append(f"requests       {summary['requests']}  ({oc})")
+    if summary.get("offered_rps") is not None:
+        lines.append(f"offered load   {summary['offered_rps']} req/s over "
+                     f"{summary['wall_s']} s wall")
+    else:
+        lines.append(f"wall time      {summary['wall_s']} s")
+    for field, label in (("ttft_ms", "TTFT"), ("tbt_ms", "TBT"),
+                         ("queue_ms", "queue wait")):
+        if field in summary:
+            p = summary[field]
+            lines.append(f"{label:<14} p50 {p['p50']:.1f} ms   p99 {p['p99']:.1f} ms")
+    lines.append(f"throughput     {summary['throughput_tok_s']} tok/s")
+    lines.append(f"goodput        {summary['goodput_tok_s']} tok/s")
+    lines.append(f"shed rate      {summary['shed_rate']:.2%}")
+    if "deadline_met_frac" in summary:
+        lines.append(f"deadline met   {summary['deadline_met_frac']:.2%}")
+    return "\n".join(lines) + "\n"
+
+
+# -- CLI ---------------------------------------------------------------
+def _parse_range(spec: str):
+    lo, sep, hi = spec.partition(":")
+    if not sep:
+        return int(lo), int(lo)
+    return int(lo), int(hi)
+
+
+def _parse_buckets(spec: str):
+    # "2x32,1x64" -> [(2, 32), (1, 64)]
+    out = []
+    for part in spec.split(","):
+        slots, sep, length = part.strip().partition("x")
+        if not sep:
+            raise ValueError(f"bucket spec {part!r} is not SLOTSxLEN")
+        out.append((int(slots), int(length)))
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="open-loop load generator for the serving layer: "
+                    "drives ServingEngine over ContinuousBatchingEngine "
+                    "and reports TTFT/TBT/goodput/shed (docs/serving.md)")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--rate", type=float, default=8.0, help="offered req/s")
+    p.add_argument("--process", choices=_PROCESSES, default="poisson")
+    p.add_argument("--burst-size", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompt-range", default="4:16", metavar="LO:HI")
+    p.add_argument("--new-range", default="4:16", metavar="LO:HI")
+    p.add_argument("--tenants", type=int, default=1)
+    p.add_argument("--priorities", type=int, default=1,
+                   help="priority levels to mix (1 = all equal)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request SLO; enables goodput/deadline stats")
+    p.add_argument("--preset", default="toy",
+                   help="'toy' (tiny CPU-runnable model) or a "
+                        "models/transformer.py preset name")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--cache-len", type=int, default=128)
+    p.add_argument("--buckets", default=None, metavar="SxL,SxL",
+                   help="cache_buckets instead of --slots/--cache-len, "
+                        "e.g. 6x128,2x512")
+    p.add_argument("--tokens-per-tick", type=int, default=1)
+    p.add_argument("--policy", default="fifo",
+                   choices=("fifo", "priority", "edf", "fair"))
+    p.add_argument("--queue-depth", type=int, default=64)
+    p.add_argument("--kv-budget", type=int, default=None,
+                   help="KV token budget (default: 2x pool capacity)")
+    p.add_argument("--aging-s", type=float, default=30.0)
+    p.add_argument("--trace-out", default=None,
+                   help="telemetry JSONL destination; summarize with "
+                        "`ds_trace_report.py --serve`")
+    p.add_argument("--replay", default=None,
+                   help="replay a JSONL workload (dump_workload shape) "
+                        "instead of synthesizing one")
+    p.add_argument("--dump-workload", default=None,
+                   help="write the synthesized workload+arrivals as "
+                        "replayable JSONL")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the summary as JSON instead of the table")
+    args = p.parse_args(argv)
+
+    if args.replay:
+        workload, arrivals = load_workload(args.replay)
+        if arrivals is None:
+            arrivals = gen_arrivals(len(workload), args.rate, args.process,
+                                    args.seed, args.burst_size)
+    else:
+        workload = synth_workload(
+            args.requests, seed=args.seed,
+            prompt_range=_parse_range(args.prompt_range),
+            new_range=_parse_range(args.new_range), tenants=args.tenants,
+            priorities=args.priorities, deadline_ms=args.deadline_ms)
+        arrivals = gen_arrivals(args.requests, args.rate, args.process,
+                                args.seed, args.burst_size)
+    if args.dump_workload:
+        dump_workload(args.dump_workload, workload, arrivals)
+
+    import jax
+
+    from deepspeed_tpu.inference.continuous import ContinuousBatchingEngine
+    from deepspeed_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerModel,
+    )
+    from deepspeed_tpu.serving.engine import ServingEngine
+
+    if args.preset == "toy":
+        model = TransformerModel(TransformerConfig(
+            vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+            max_seq_len=max(args.cache_len, 128), dtype=args.dtype))
+    else:
+        model = TransformerModel.from_preset(args.preset, dtype=args.dtype)
+    params = model.init(jax.random.PRNGKey(args.seed))
+    cfg = {"dtype": args.dtype}
+    if args.trace_out:
+        cfg["telemetry"] = {"enabled": True, "trace_file": args.trace_out}
+    engine_kwargs = {}
+    if args.buckets:
+        engine_kwargs["cache_buckets"] = _parse_buckets(args.buckets)
+    else:
+        engine_kwargs["max_slots"] = args.slots
+        engine_kwargs["cache_len"] = args.cache_len
+    cb = ContinuousBatchingEngine(model, params=params, config=cfg,
+                                  tokens_per_tick=args.tokens_per_tick,
+                                  **engine_kwargs)
+    serving = ServingEngine(cb, policy=args.policy,
+                            max_queue_depth=args.queue_depth,
+                            kv_budget_tokens=args.kv_budget,
+                            aging_s=args.aging_s)
+
+    records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
+    summary = summarize(records, wall_s)
+    if args.as_json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        sys.stdout.write(format_summary(summary))
+    if args.trace_out:
+        serving.close()
+        print(f"trace written to {args.trace_out} "
+              f"(summarize: python tools/ds_trace_report.py {args.trace_out} "
+              f"--serve)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,7 +123,7 @@ class TestContinuousBatching:
         cb = ContinuousBatchingEngine(model, params=params,
                                       config={"dtype": "float32"},
                                       max_slots=2, cache_len=32)
-        with pytest.raises(AssertionError, match="cache_len"):
+        with pytest.raises(ValueError, match="cache_len"):
             cb.submit(np.arange(30, dtype=np.int32), max_new_tokens=8)
 
     def test_step_stream_matches_results(self, setup):
@@ -183,7 +183,7 @@ class TestContinuousBatching:
                                       config={"dtype": "float32"},
                                       max_slots=2, cache_len=32)
         pid = cb.register_prefix(np.arange(20, dtype=np.int32) % 128)
-        with pytest.raises(AssertionError, match="cache_len"):
+        with pytest.raises(ValueError, match="cache_len"):
             cb.submit_with_prefix(pid, np.arange(8, dtype=np.int32), max_new_tokens=8)
 
     def test_zero_max_new_tokens_rejected(self, setup):
@@ -191,8 +191,10 @@ class TestContinuousBatching:
         cb = ContinuousBatchingEngine(model, params=params,
                                       config={"dtype": "float32"},
                                       max_slots=2, cache_len=64)
-        with pytest.raises(AssertionError, match="max_new_tokens"):
+        with pytest.raises(ValueError, match="max_new_tokens"):
             cb.submit(np.arange(4, dtype=np.int32), max_new_tokens=0)
+        with pytest.raises(ValueError, match="empty prompt"):
+            cb.submit([], max_new_tokens=4)
 
     def test_unregister_prefix_releases(self, setup):
         model, params, _ = setup
@@ -235,8 +237,99 @@ class TestContinuousBatching:
         full = np.concatenate([prefix, suffix])
         want = np.asarray(plain.generate(full[None, :], max_new_tokens=4))[0]
         np.testing.assert_array_equal(done[rid], want)
-        with pytest.raises(AssertionError, match="max_new_tokens"):
+        with pytest.raises(ValueError, match="max_new_tokens"):
             cb.submit_with_prefix(cb.register_prefix(prefix), suffix, max_new_tokens=0)
+
+
+class TestRequestLifecycle:
+    """status/peek/result/cancel — the polling + cancellation surface the
+    serving layer (deepspeed_tpu/serving) is built on."""
+
+    def test_status_and_peek_across_lifecycle(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=1, cache_len=64)
+        p_a, p_b = _prompts((4, 5), seed=7)
+        # admission emits 1 token and the same step() decodes 1 more, so
+        # max_new_tokens=4 keeps the request active past the first tick
+        ra = cb.submit(p_a, max_new_tokens=4)
+        rb = cb.submit(p_b, max_new_tokens=4)  # queues behind ra (1 slot)
+        assert cb.status(ra) == "pending" and cb.status(rb) == "pending"
+        cb.step()
+        assert cb.status(ra) == "active" and cb.status(rb) == "pending"
+        assert cb.peek(ra) is None  # not finished: peek stays empty
+        while cb.status(ra) in ("pending", "active"):
+            cb.step()
+        assert cb.status(ra) == "finished"
+        got = cb.peek(ra)
+        assert got is not None and len(got) == len(p_a) + 4
+        np.testing.assert_array_equal(cb.result(ra), got)  # peek didn't consume
+        assert cb.status(ra) == "unknown"  # collected
+        assert cb.status(12345) == "unknown"
+        while cb.has_work():
+            cb.step()
+        cb.finished()
+
+    def test_result_error_names_rid_and_state(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=1, cache_len=64)
+        rid = cb.submit(_prompts((4,), seed=8)[0], max_new_tokens=4)
+        with pytest.raises(KeyError, match=f"request {rid}: pending"):
+            cb.result(rid)
+        cb.step()  # admission + first decode: 2 of 4 tokens, still active
+        with pytest.raises(KeyError, match=f"request {rid}: active"):
+            cb.result(rid)
+        with pytest.raises(KeyError, match="request 999: unknown"):
+            cb.result(999)
+        while cb.has_work():
+            cb.step()
+        cb.finished()
+
+    def test_cancel_pending_and_active_frees_slot(self, setup):
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=1, cache_len=64)
+        p_a, p_b, p_c = _prompts((4, 5, 6), seed=9)
+        ra = cb.submit(p_a, max_new_tokens=8)
+        rb = cb.submit(p_b, max_new_tokens=8)
+        cb.step()
+        assert cb.cancel(rb) is True          # pending: leaves the queue
+        assert cb.status(rb) == "cancelled" and not cb._pending
+        assert cb.cancel(ra) is True          # active: frees the slot NOW
+        assert cb.status(ra) == "cancelled"
+        assert cb.pool_state() == [{"length": 64, "slots": 1, "free": 1}]
+        rc = cb.submit(p_c, max_new_tokens=2)  # freed slot is reusable
+        while cb.has_work():
+            cb.step()
+        out = cb.finished()
+        assert set(out) == {rc}
+        assert len(out[rc]) == len(p_c) + 2
+        assert cb.cancel(rc) is False          # already collected: too late
+        with pytest.raises(KeyError, match="cancelled"):
+            cb.result(ra)
+
+    def test_cancelled_memory_is_bounded(self, setup):
+        """A long-running server cancels routinely; the engine remembers
+        only a bounded window of cancelled rids (evicted ones age back to
+        'unknown', same as collected results)."""
+        model, params, _ = setup
+        cb = ContinuousBatchingEngine(model, params=params,
+                                      config={"dtype": "float32"},
+                                      max_slots=1, cache_len=64)
+        cb._cancelled_cap = 4
+        prompt = _prompts((3,), seed=10)[0]
+        rids = []
+        for _ in range(6):  # cancel while pending: no decode involved
+            rid = cb.submit(prompt, max_new_tokens=2)
+            assert cb.cancel(rid) is True
+            rids.append(rid)
+        assert len(cb._cancelled) == 4
+        assert cb.status(rids[0]) == "unknown"   # evicted
+        assert cb.status(rids[-1]) == "cancelled"
 
 
 class TestBucketedKV:

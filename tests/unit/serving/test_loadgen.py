@@ -1,0 +1,223 @@
+"""Load-generator harness (serving/loadgen.py): arrival-process and
+report math are pure and exact; the in-process runs drive the real
+serving stack over the toy model. The few-hundred-request soak is
+slow-marked (tier-1 keeps the small run)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.inference.continuous import ContinuousBatchingEngine
+from deepspeed_tpu.models.transformer import TransformerConfig, TransformerModel
+from deepspeed_tpu.serving import ServingEngine
+from deepspeed_tpu.serving import loadgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+class TestArrivals:
+    def test_poisson_seeded_ascending_rate(self):
+        a = loadgen.gen_arrivals(200, rate=10.0, process="poisson", seed=3)
+        b = loadgen.gen_arrivals(200, rate=10.0, process="poisson", seed=3)
+        assert a == b  # fully determined by the seed
+        assert all(x < y for x, y in zip(a, a[1:]))
+        # 200 arrivals at 10/s: the span concentrates around 20 s
+        assert 10.0 < a[-1] < 40.0
+
+    def test_uniform_fixed_spacing(self):
+        a = loadgen.gen_arrivals(5, rate=4.0, process="uniform")
+        assert a == [0.0, 0.25, 0.5, 0.75, 1.0]
+
+    def test_burst_groups_preserve_average_rate(self):
+        a = loadgen.gen_arrivals(10, rate=10.0, process="burst", burst_size=4)
+        assert a == [0.0] * 4 + [0.4] * 4 + [0.8] * 2
+        assert len(a) == 10
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError, match="rate"):
+            loadgen.gen_arrivals(4, rate=0.0)
+        with pytest.raises(ValueError, match="arrival process"):
+            loadgen.gen_arrivals(4, rate=1.0, process="lognormal")
+
+
+class TestWorkload:
+    def test_synth_deterministic_and_ranged(self):
+        w1 = loadgen.synth_workload(50, seed=7, prompt_range=(3, 9),
+                                    new_range=(2, 5), tenants=3, priorities=2,
+                                    deadline_ms=750.0)
+        w2 = loadgen.synth_workload(50, seed=7, prompt_range=(3, 9),
+                                    new_range=(2, 5), tenants=3, priorities=2,
+                                    deadline_ms=750.0)
+        assert w1 == w2
+        for item in w1:
+            assert 3 <= item["prompt_tokens"] <= 9
+            assert 2 <= item["max_new_tokens"] <= 5
+            assert item["priority"] in (0, 1)
+            assert item["tenant"] in ("tenant0", "tenant1", "tenant2")
+            assert item["deadline_ms"] == 750.0
+
+    def test_dump_load_roundtrip(self, tmp_path):
+        w = loadgen.synth_workload(8, seed=1)
+        arr = loadgen.gen_arrivals(8, rate=5.0, seed=1)
+        path = str(tmp_path / "mix.jsonl")
+        loadgen.dump_workload(path, w, arr)
+        w2, arr2 = loadgen.load_workload(path)
+        assert w2 == w and arr2 == arr
+        # without arrivals the loader reports None (caller regenerates)
+        loadgen.dump_workload(path, w)
+        w3, arr3 = loadgen.load_workload(path)
+        assert w3 == w and arr3 is None
+
+    def test_load_empty_rejected(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        with pytest.raises(ValueError, match="no workload records"):
+            loadgen.load_workload(str(p))
+
+
+class TestSummarize:
+    def test_scorecard_math(self):
+        records = [
+            {"state": "finished", "status": "admitted", "arrival_s": 0.0,
+             "tokens": 10, "ttft_ms": 5.0, "tbt_ms": 2.0, "queue_ms": 1.0,
+             "deadline_met": True},
+            {"state": "finished", "status": "queued", "arrival_s": 0.5,
+             "tokens": 10, "ttft_ms": 15.0, "tbt_ms": 4.0, "queue_ms": 9.0,
+             "deadline_met": False},
+            {"state": "shed", "status": "shed", "arrival_s": 1.0,
+             "reason": "queue_full"},
+            {"state": "expired", "status": "queued", "arrival_s": 2.0},
+        ]
+        s = loadgen.summarize(records, wall_s=4.0)
+        assert s["requests"] == 4
+        assert s["outcomes"] == {"expired": 1, "finished": 2, "shed": 1}
+        assert s["offered_rps"] == 2.0           # 4 requests over 2 s span
+        assert s["shed_rate"] == 0.5             # shed + expired
+        assert s["ttft_ms"]["p50"] == 10.0
+        assert s["queue_ms"]["p50"] == 5.0
+        assert s["throughput_tok_s"] == 5.0      # 20 tokens / 4 s
+        assert s["goodput_tok_s"] == 2.5         # only the deadline-met 10
+        assert s["deadline_met_frac"] == 0.5
+        text = loadgen.format_summary(s)
+        assert "ds_loadgen summary" in text and "shed rate" in text
+        assert "goodput" in text and "TTFT" in text
+
+    def test_no_deadlines_goodput_equals_throughput(self):
+        records = [{"state": "finished", "arrival_s": 0.0, "tokens": 8},
+                   {"state": "finished", "arrival_s": 1.0, "tokens": 8}]
+        s = loadgen.summarize(records, wall_s=2.0)
+        assert s["goodput_tok_s"] == s["throughput_tok_s"] == 8.0
+        assert "deadline_met_frac" not in s
+
+
+@pytest.fixture(scope="module")
+def setup():
+    comm.destroy()
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=128, dtype="float32")
+    model = TransformerModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _serving(setup, telemetry_file=None, **kw):
+    model, params = setup
+    cfg = {"dtype": "float32"}
+    if telemetry_file:
+        cfg["telemetry"] = {"enabled": True, "trace_file": telemetry_file}
+    cb = ContinuousBatchingEngine(model, params=params, config=cfg,
+                                  max_slots=kw.pop("max_slots", 2),
+                                  cache_len=kw.pop("cache_len", 64))
+    return cb, ServingEngine(cb, **kw)
+
+
+class TestRunLoad:
+    def test_small_run_reports_and_traces(self, setup, tmp_path):
+        """End-to-end: open-loop run over the toy model leaves records
+        for every workload item and a trace ds_trace_report --serve can
+        summarize."""
+        trace = str(tmp_path / "serve.jsonl")
+        cb, srv = _serving(setup, telemetry_file=trace, max_queue_depth=4)
+        workload = loadgen.synth_workload(16, seed=5, prompt_range=(3, 8),
+                                          new_range=(2, 4), deadline_ms=30_000.0)
+        arrivals = loadgen.gen_arrivals(16, rate=500.0, process="burst",
+                                        burst_size=8, seed=5)
+        records, wall_s = loadgen.run_load(srv, workload, arrivals, seed=5)
+        assert len(records) == 16 and wall_s > 0
+        assert all("status" in r for r in records)
+        finished = [r for r in records if r.get("state") == "finished"]
+        assert finished, "nothing finished"
+        for r in finished:
+            assert r["tokens"] >= 1 and "ttft_ms" in r and "queue_ms" in r
+        summary = loadgen.summarize(records, wall_s)
+        assert summary["requests"] == 16
+        assert summary["throughput_tok_s"] > 0
+        srv.close()
+
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "ds_trace_report.py"),
+             trace, "--serve", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        table = json.loads(out.stdout)["serve"]
+        assert table["finished"] == len(finished)
+        assert table["requests"] == 16
+
+    def test_replayed_prompts_reproduce_streams(self, setup):
+        """Replaying a workload with explicit prompt ids reproduces the
+        exact token streams (recorded-mix serving is deterministic)."""
+        rs = np.random.RandomState(3)
+        prompts = [rs.randint(0, 128, (n,)).astype(np.int32) for n in (4, 6)]
+        workload = [{"prompt": p.tolist(), "max_new_tokens": 4} for p in prompts]
+        streams = []
+        for _ in range(2):
+            _, srv = _serving(setup)
+            records, _ = loadgen.run_load(
+                srv, workload, arrivals=[0.0, 0.0], seed=0)
+            assert [r.get("tokens") for r in records] == [4, 4]
+            streams.append([r["generated"] for r in records])
+        assert streams[0] == streams[1]
+
+    def test_mismatched_lengths_rejected(self, setup):
+        _, srv = _serving(setup)
+        with pytest.raises(ValueError, match="arrival times"):
+            loadgen.run_load(srv, [{"prompt_tokens": 4}], [0.0, 1.0])
+
+
+@pytest.mark.slow
+class TestSoak:
+    def test_mixed_soak_drains_clean(self, setup, tmp_path):
+        """A few hundred mixed requests (tenants, priorities, deadlines,
+        bursty arrivals) through the full stack: everything reaches a
+        terminal state, the queue bound holds throughout, and the
+        scorecard adds up."""
+        trace = str(tmp_path / "soak.jsonl")
+        cb, srv = _serving(setup, telemetry_file=trace, max_queue_depth=16,
+                           policy="edf", max_slots=4, cache_len=64)
+        n = 300
+        workload = loadgen.synth_workload(
+            n, seed=9, prompt_range=(3, 12), new_range=(2, 8), tenants=3,
+            priorities=3, deadline_ms=60_000.0)
+        arrivals = loadgen.gen_arrivals(n, rate=400.0, process="burst",
+                                        burst_size=32, seed=9)
+        records, wall_s = loadgen.run_load(srv, workload, arrivals, seed=9)
+        assert not srv.has_work() and srv.queue_depth() == 0
+        assert len(srv.reap()) == 0  # run_load reaped everything
+        summary = loadgen.summarize(records, wall_s)
+        outcomes = summary["outcomes"]
+        assert sum(outcomes.values()) == n
+        assert outcomes.get("finished", 0) >= 1
+        # saturated at this offered load: backpressure engaged
+        assert outcomes.get("shed", 0) + outcomes.get("expired", 0) >= 1
+        assert summary["shed_rate"] < 1.0
+        srv.close()
+        events = [json.loads(l) for l in open(trace)]
+        fin = [e for e in events if e.get("kind") == "inference_request"]
+        assert len(fin) == outcomes.get("finished", 0)

@@ -1,0 +1,126 @@
+"""Scheduler policies (serving/policies.py) — pure ordering math, no jax:
+these tests pin the exact admission order each policy promises."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.policies import (
+    EdfPolicy,
+    FairSharePolicy,
+    FifoPolicy,
+    PriorityPolicy,
+    SchedulerPolicy,
+    resolve_policy,
+)
+from deepspeed_tpu.serving.request import Admission, ServeRequest
+
+
+def _req(rid, priority=0, tenant="default", deadline_ms=None, submit_t=0.0,
+         prompt_len=4, max_new=4):
+    return ServeRequest(rid=rid, prompt=np.zeros(prompt_len, np.int32),
+                        max_new_tokens=max_new, priority=priority,
+                        tenant=tenant, deadline_ms=deadline_ms,
+                        submit_t=submit_t)
+
+
+class TestFifo:
+    def test_submission_order(self):
+        queue = [_req(2), _req(0), _req(1)]
+        assert [r.rid for r in FifoPolicy().order(queue, now=5.0)] == [0, 1, 2]
+
+
+class TestPriority:
+    def test_higher_priority_first_ties_fifo(self):
+        queue = [_req(0, priority=0), _req(1, priority=5), _req(2, priority=5)]
+        got = PriorityPolicy(aging_s=30.0).order(queue, now=0.0)
+        assert [r.rid for r in got] == [1, 2, 0]
+
+    def test_aging_boosts_waiting_low_priority(self):
+        """One level per aging_s: after 2*aging_s of waiting, a priority-0
+        request outranks a fresh priority-1 request."""
+        pol = PriorityPolicy(aging_s=10.0)
+        old_low = _req(0, priority=0, submit_t=0.0)
+        new_high = _req(1, priority=1, submit_t=25.0)
+        assert [r.rid for r in pol.order([old_low, new_high], now=25.0)] == [0, 1]
+        # fresh clock: without the wait the priorities win
+        assert [r.rid for r in pol.order([old_low, new_high], now=5.0)] == [1, 0]
+
+    def test_rejects_bad_aging(self):
+        with pytest.raises(ValueError, match="aging_s"):
+            PriorityPolicy(aging_s=0)
+
+
+class TestEdf:
+    def test_earliest_deadline_first_none_last(self):
+        queue = [_req(0, deadline_ms=5000.0), _req(1),  # no SLO: sorts last
+                 _req(2, deadline_ms=1000.0), _req(3, deadline_ms=3000.0)]
+        got = EdfPolicy().order(queue, now=0.0)
+        assert [r.rid for r in got] == [2, 3, 0, 1]
+
+    def test_deadline_is_absolute_from_submit(self):
+        early_submit = _req(0, deadline_ms=5000.0, submit_t=0.0)   # abs 5.0
+        late_submit = _req(1, deadline_ms=1000.0, submit_t=10.0)   # abs 11.0
+        got = EdfPolicy().order([late_submit, early_submit], now=10.0)
+        assert [r.rid for r in got] == [0, 1]
+
+
+class TestFairShare:
+    def test_interleaves_tenants_under_flood(self):
+        """Tenant a floods 4 requests, tenant b submits 2 of the same
+        size: admission alternates a, b, a, b, a, a."""
+        pol = FairSharePolicy()
+        queue = ([_req(i, tenant="a") for i in range(4)]
+                 + [_req(i + 4, tenant="b") for i in range(2)])
+        admitted = []
+        while queue:
+            head = pol.order(queue, now=0.0)[0]
+            queue.remove(head)
+            pol.on_admit(head, now=0.0)
+            admitted.append(head.tenant)
+        assert admitted == ["a", "b", "a", "b", "a", "a"]
+
+    def test_new_tenant_starts_at_current_minimum(self):
+        """A late-arriving tenant is not owed the incumbents' history: its
+        account opens at the current minimum, so it ties the least-served
+        tenant instead of leading outright on a zero balance."""
+        pol = FairSharePolicy()
+        for i in range(3):
+            pol.on_admit(_req(i, tenant="a"), now=0.0)   # a: 24 tokens
+        pol.on_admit(_req(3, tenant="b"), now=0.0)       # b: opens 24, +8 = 32
+        queue = [_req(10, tenant="a"), _req(11, tenant="b"), _req(12, tenant="c")]
+        got = [r.tenant for r in pol.order(queue, now=0.0)]
+        # c opened at min(24, 32) = 24: TIES a (FIFO breaks it), is not
+        # handed the lead a zero balance would give it; b paid for its
+        # admitted request and queues behind both
+        assert got == ["a", "c", "b"]
+        assert pol._served["a"] == 3 * 8  # 3 requests x (4 prompt + 4 new)
+        assert pol._served["c"] == 24     # opened at the current minimum
+
+
+class TestResolve:
+    def test_names_and_instances(self):
+        assert isinstance(resolve_policy("fifo"), FifoPolicy)
+        assert isinstance(resolve_policy("priority"), PriorityPolicy)
+        assert isinstance(resolve_policy("edf"), EdfPolicy)
+        assert isinstance(resolve_policy("fair"), FairSharePolicy)
+        custom = PriorityPolicy(aging_s=1.0)
+        assert resolve_policy(custom) is custom
+        assert isinstance(resolve_policy("fifo"), SchedulerPolicy)
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError, match="unknown scheduler policy"):
+            resolve_policy("lifo")
+
+
+class TestRequestRecord:
+    def test_admission_truthiness(self):
+        assert Admission(status="admitted", rid=1)
+        assert Admission(status="queued", rid=2)
+        assert not Admission(status="shed", reason="queue_full")
+
+    def test_deadline_and_need(self):
+        r = _req(0, deadline_ms=1500.0, submit_t=2.0, prompt_len=6, max_new=10)
+        assert r.deadline_at == 3.5
+        assert r.need_tokens == 16
+        assert _req(1).deadline_at == float("inf")
+        assert r.waited_s(5.0) == 3.0 and r.waited_s(1.0) == 0.0

@@ -28,11 +28,15 @@ def test_aggregate_fixture():
     # nested comm dict flattens to a dotted metric
     assert steps["comm_bytes.all_reduce"]["max"] == 4096
     req = report["inference_request"]
-    assert req["total_ms"]["count"] == 3  # the continuous event has none
-    assert req["ttft_ms"]["count"] == 2  # fused path has no TTFT field
+    assert req["total_ms"]["count"] == 3  # continuous/serving events have none
+    assert req["ttft_ms"]["count"] == 4  # fused/continuous paths have no TTFT
     # cache-geometry fields aggregate like any numeric field
-    assert req["kv_bytes_read"]["count"] == 4
+    assert req["kv_bytes_read"]["count"] == 6
     assert req["cache_utilization"]["max"] == 0.4375
+    # serving lifecycle fields aggregate too (deadline_met is bool: excluded)
+    assert req["queue_ms"]["count"] == 2
+    assert "deadline_met" not in req
+    assert report["serving_event"]["queue_ms"]["max"] == 80.0
     # comm_summary ops flatten too
     assert report["comm_summary"]["ops.all_reduce.total_bytes"]["max"] == 12288
 
@@ -40,7 +44,7 @@ def test_aggregate_fixture():
 def test_decode_table():
     events, _ = ds_trace_report.load_events(FIXTURE)
     table = ds_trace_report.decode_table(events)
-    assert set(table) == {"fused", "decode_loop", "continuous"}
+    assert set(table) == {"fused", "decode_loop", "continuous", "serving"}
     loop = table["decode_loop"]
     assert loop["count"] == 2
     assert loop["ttft_ms_p50"] == 5.75
@@ -51,6 +55,30 @@ def test_decode_table():
     assert table["continuous"]["cache_utilization_mean"] == 0.4375
     text = ds_trace_report.format_decode_table(table)
     assert "decode summary" in text and "kv_bytes_read_p50" in text
+
+
+def test_serve_table():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.serve_table(events)
+    assert table["requests"] == 4  # 2 finished + 1 shed + 1 expired
+    assert table["finished"] == 2 and table["shed"] == 1
+    assert table["expired"] == 1 and table["cancelled"] == 0
+    assert table["shed_rate"] == 0.5  # (shed + expired) / requests
+    assert table["queue_ms_p50"] == 7.5
+    assert round(table["queue_ms_p95"], 2) == 11.55
+    assert table["ttft_ms_p50"] == 15.0
+    assert table["deadline_met_frac"] == 0.5
+    # goodput: only the deadline-met request's 8 tokens over the 0.6 s
+    # event-time span
+    assert table["good_tokens"] == 8
+    assert abs(table["goodput_tok_s"] - 8 / 0.6) < 0.01
+    text = ds_trace_report.format_serve_table(table)
+    assert "serving summary" in text and "shed rate" in text
+
+
+def test_serve_table_empty_without_serving_events():
+    events = [{"kind": "inference_request", "path": "fused", "ts": 1.0}]
+    assert ds_trace_report.serve_table(events) == {}
 
 
 def test_kind_filter_and_skip_fields():
@@ -78,11 +106,13 @@ def test_cli_smoke_tables():
     assert proc.returncode == 0, proc.stderr
     out = proc.stdout
     assert "== train_step (3 events) ==" in out
-    assert "== inference_request (4 events) ==" in out
+    assert "== inference_request (6 events) ==" in out
     assert "p50" in out and "p95" in out and "max" in out
     assert "fwd_ms" in out and "ttft_ms" in out and "mfu" in out
     # the decode summary rides along whenever inference_request events exist
     assert "decode summary" in out and "kv_bytes_read_p50" in out
+    # ... and the serving summary whenever serving events exist
+    assert "serving summary" in out and "shed rate" in out
 
 
 def test_cli_decode_flag():
@@ -94,6 +124,25 @@ def test_cli_decode_flag():
     table = json.loads(proc.stdout)["decode"]
     assert table["decode_loop"]["count"] == 2
     assert table["continuous"]["kv_bytes_per_token_mean"] == 29491.2
+
+
+def test_cli_serve_flag(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--serve", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    table = json.loads(proc.stdout)["serve"]
+    assert table["requests"] == 4 and table["shed_rate"] == 0.5
+    # a trace with no serving events exits 1 (same contract as --decode)
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text('{"schema": 1, "kind": "train_step", "fwd_ms": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, CLI, str(bare), "--serve"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no serving events" in proc.stderr
 
 
 def test_cli_json_mode():

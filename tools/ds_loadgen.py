@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""ds_loadgen launcher — open-loop load generator + trace replay for the
+serving layer (``deepspeed_tpu/serving/``).
+
+Drives a :class:`ServingEngine` (admission control + scheduling over
+continuous batching) at a configured offered load and reports TTFT / TBT
+/ queue-wait percentiles, goodput vs offered load, and shed rate. With
+``--trace-out`` the run leaves a telemetry JSONL that
+``tools/ds_trace_report.py --serve`` summarizes.
+
+Unlike ds_lint/ds_trace_report this tool necessarily imports jax (it
+runs a model); on a laptop use ``JAX_PLATFORMS=cpu`` with the default
+``--preset toy``.
+
+Usage (see ``--help`` / docs/serving.md):
+    python tools/ds_loadgen.py --requests 128 --rate 16 --process burst \\
+        --policy edf --deadline-ms 2000 --trace-out runs/serve.jsonl
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.serving.loadgen import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

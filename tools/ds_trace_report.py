@@ -155,6 +155,74 @@ def format_decode_table(table):
     return "\n".join(lines) + "\n"
 
 
+def serve_table(events):
+    """Serving-run scorecard over the serving-layer events: finished
+    requests are ``inference_request`` events with ``path:"serving"``
+    (carrying queue_ms/ttft_ms/deadline_met from the ServingEngine event
+    hook); sheds/expiries/cancellations are ``serving_event`` lifecycle
+    records. Reports queue-wait and TTFT p50/p95, shed rate, deadline-met
+    fraction, and goodput (deadline-met output tokens over the event-time
+    span). Empty dict when the trace holds no serving activity."""
+    finished = [e for e in events if e.get("kind") == "inference_request"
+                and e.get("path") == "serving"]
+    lifecycle = [e for e in events if e.get("kind") == "serving_event"]
+    if not finished and not lifecycle:
+        return {}
+    by_event = {}
+    for e in lifecycle:
+        by_event.setdefault(e.get("event", "?"), []).append(e)
+    shed = len(by_event.get("shed", []))
+    expired = len(by_event.get("expired", []))
+    cancelled = len(by_event.get("cancelled", []))
+    total = len(finished) + shed + expired + cancelled
+    out = {"finished": len(finished), "shed": shed, "expired": expired,
+           "cancelled": cancelled, "requests": total}
+    out["shed_rate"] = round((shed + expired) / total, 4) if total else 0.0
+    for fld in ("queue_ms", "ttft_ms"):
+        vals = sorted(float(e[fld]) for e in finished
+                      if isinstance(e.get(fld), (int, float))
+                      and not isinstance(e.get(fld), bool))
+        if vals:
+            out[f"{fld}_p50"] = percentile(vals, 50.0)
+            out[f"{fld}_p95"] = percentile(vals, 95.0)
+    with_deadline = [e for e in finished if isinstance(e.get("deadline_met"), bool)]
+    if with_deadline:
+        out["deadline_met_frac"] = round(
+            sum(1 for e in with_deadline if e["deadline_met"])
+            / len(with_deadline), 4)
+    ts = [float(e["ts"]) for e in finished + lifecycle
+          if isinstance(e.get("ts"), (int, float))]
+    span = max(ts) - min(ts) if len(ts) > 1 else 0.0
+    good = sum(int(e.get("new_tokens", 0)) for e in finished
+               if e.get("deadline_met", True) is True)
+    out["good_tokens"] = good
+    if span > 0:
+        out["goodput_tok_s"] = round(good / span, 3)
+    return out
+
+
+def format_serve_table(table):
+    if not table:
+        return ""
+    lines = ["== serving summary (path=serving + serving_event) =="]
+    counts = " ".join(f"{k}={table[k]}"
+                      for k in ("finished", "shed", "expired", "cancelled")
+                      if table.get(k))
+    lines.append(f"requests          {table['requests']}"
+                 + (f"  ({counts})" if counts else ""))
+    for fld, label in (("queue_ms", "queue wait"), ("ttft_ms", "ttft")):
+        if f"{fld}_p50" in table:
+            lines.append(f"{label:<17} p50 {_fmt(table[f'{fld}_p50'])} ms"
+                         f"   p95 {_fmt(table[f'{fld}_p95'])} ms")
+    lines.append(f"shed rate         {table['shed_rate'] * 100:.2f}%")
+    if "deadline_met_frac" in table:
+        lines.append(f"deadline met      {table['deadline_met_frac'] * 100:.2f}%")
+    if "goodput_tok_s" in table:
+        lines.append(f"goodput           {_fmt(table['goodput_tok_s'])} tok/s "
+                     f"({table['good_tokens']} deadline-met tokens)")
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v):
     if v == 0:
         return "0"
@@ -202,6 +270,10 @@ def main(argv=None):
                     help="only the per-path decode summary (TTFT/tok-s/"
                          "kv_bytes_read percentiles over inference_request "
                          "events)")
+    ap.add_argument("--serve", action="store_true",
+                    help="only the serving summary (queue-wait/TTFT "
+                         "percentiles, shed rate, deadline-met fraction, "
+                         "goodput over ServingEngine events)")
     args = ap.parse_args(argv)
 
     try:
@@ -231,6 +303,17 @@ def main(argv=None):
             sys.stdout.write(format_decode_table(table))
         return 0
 
+    if args.serve:
+        table = serve_table(events)
+        if not table:
+            print("no serving events in the trace", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"serve": table}, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_serve_table(table))
+        return 0
+
     report = aggregate(events, kinds=args.kind, all_fields=args.all_fields)
     if args.as_json:
         print(json.dumps(report, indent=2, sort_keys=True))
@@ -240,6 +323,10 @@ def main(argv=None):
             table = decode_table(events)
             if table:
                 sys.stdout.write("\n" + format_decode_table(table))
+        if not args.kind:
+            table = serve_table(events)
+            if table:
+                sys.stdout.write("\n" + format_serve_table(table))
     return 0
 
 
