@@ -7,48 +7,75 @@ new requests are admitted into free slots while other slots keep decoding,
 and finished sequences free their slot immediately — no head-of-line
 blocking on the longest sequence.
 
-TPU-shaped design: everything is static-shape. The decode tick is the
-existing per-row-position segment program (inference/decoding.py
-``compile_segment_fn`` — one jit, any slot occupancy); admission runs a
-B=1 ragged prefill into a small bucket-length cache and a compiled
-``dynamic_update_slice`` splices that row into the shared cache. Slot
-reuse needs no cache clearing: admission overwrites [0..len) and the
-causal position mask hides anything staler.
+TPU-shaped design: everything is static-shape, and — since PERF.md's
+central finding is that host-blocked dispatch, not FLOPs, governs decode
+throughput — the scheduler tick is built so device compute and host
+scheduling OVERLAP instead of alternating:
 
-Bucketed KV (VERDICT r4 #9): a single pool reserves ``cache_len`` for
-every slot — at long contexts most of that HBM idles under short requests.
-``cache_buckets=[(slots, len), ...]`` instead partitions the slots into
-pools with different cache lengths; admission places each request in the
-smallest-length pool it fits (prompt + max_new_tokens), falling back to
-longer pools when full. Each pool keeps its own static-shape segment
-program and cache, so this is the static-shape TPU analogue of paged KV:
-footprint sum(slots_i * len_i) instead of max_slots * max_len, no
-page-table gather in the attention kernel. ``kv_cache_bytes()`` reports
-the footprint for both layouts.
+- **On-device acceptance** (decoding.compile_pool_tick_fn): sampling,
+  EOS/quota done detection, position advance, and per-row emission
+  masking run inside the compiled tick program. Each tick returns one
+  small packed ``(tokens, n_emitted, done)`` int32 buffer, fetched with a
+  single coalesced device get — never per-row logits or host-side
+  truncation.
+- **Dispatch-ahead pipelining** (``pipeline_depth``, default 1): the
+  tick program THREADS its decode state (``last_tok``/``done`` and the
+  donated KV cache) through device outputs, so tick N+1 is dispatched on
+  tick N's output futures BEFORE the host blocks on tick N's packed
+  result. While the host parses results, admits requests, and runs the
+  serving layer's scheduling, the device is already executing the next
+  tick. ``pipeline_depth=0`` is the fully synchronous scheduler; token
+  streams are bitwise identical in both modes (per-request rng — see
+  decoding.request_keys — makes streams independent of slot/tick
+  placement). The visible difference is only WHEN a token is returned:
+  ``step()`` reports the results of the tick(s) it retired, which lag
+  dispatch by up to ``pipeline_depth`` ticks.
+- **Prefill/decode fusion** (``fused_prefill``, default on for
+  single-token ticks): admission no longer dispatches a blocking B=1
+  ragged prefill + cache splice. Instead one admitting row's next prompt
+  chunk (bucketed fixed shapes, ``prefill_chunk`` cap) rides INSIDE the
+  same tick program that decodes the active rows — Dynamic-SplitFuse
+  style, one more static-shape program per (chunk bucket, read bucket)
+  family — so decode ticks proceed during a long prompt's prefill. With
+  fusion off (or burst ticks), admission prefills ``prompt[:-1]`` through
+  the B=1 bucket program + splice WITHOUT sampling or fetching: the last
+  prompt token is re-fed by the first decode tick, whose logits yield the
+  first generated token, keeping every admission dispatch-only.
+- **Donation**: the pool KV cache and the threaded tick state are
+  ``donate_argnums`` operands of every tick program, so per-tick cache
+  copies disappear from HBM traffic.
+
+Bucketed KV (VERDICT r4 #9): ``cache_buckets=[(slots, len), ...]``
+partitions the slots into pools with different cache lengths; admission
+places each request in the smallest-length pool it fits, falling back to
+longer pools when full — the static-shape TPU analogue of paged KV.
+``kv_cache_bytes()`` reports the footprint for both layouts.
 
     eng = ContinuousBatchingEngine(model, config={"dtype": "bfloat16"},
                                    cache_buckets=[(6, 256), (2, 2048)])
     rid = eng.submit([12, 7, 99], max_new_tokens=32)
     while eng.has_work():
-        eng.step()            # one decode tick per non-empty pool
+        eng.step()            # dispatch tick N+1, retire tick N
     out = eng.result(rid)     # prompt + generated tokens (np.int32)
 
 ``tokens_per_tick=k`` fuses k decode steps per tick into one compiled
-scan (k× fewer host dispatches per token — the dominant serving cost on
-remote-dispatch links); admission then happens between bursts, adding up
-to k tokens of admission latency. Greedy output is identical to k=1.
+scan (k× fewer host dispatches per token); admission then happens between
+bursts. Tokens a burst computes past a row's done flag are wasted work,
+counted by the ``burst_wasted_tokens`` telemetry counter.
 
 Tight-read ticks (engine config ``kv_tight_read``, default on): every
-decode tick attends a bucketed ACTIVE length — the power-of-2 window
-covering the live rows' cached extents — instead of the full pool length,
-so young requests in a long pool stream a fraction of the cache bytes
-(decode is an HBM roofline; docs/inference.md "Cache geometry"). Finished
-requests emit an ``inference_request`` event with ``kv_bytes_read`` /
-``kv_bytes_per_token`` / ``kv_dtype`` / ``cache_utilization``, and
-``step()`` maintains a ``cache_utilization`` gauge for dashboards.
+tick attends a bucketed ACTIVE length (docs/inference.md "Cache
+geometry"). Finished requests emit an ``inference_request`` event with
+``kv_bytes_read`` / ``kv_bytes_per_token`` / ``kv_dtype`` /
+``cache_utilization``; each ``step()`` additionally records
+``tick_dispatch_ms`` / ``tick_block_ms`` / in-flight depth (histograms,
+gauge, and a per-step ``serving_tick`` trace event) so the
+overlap win is measurable from traces alone — ``tick_stats()`` exposes
+the same accounting in-process.
 """
 
-from collections import OrderedDict
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -58,15 +85,20 @@ import numpy as np
 
 from deepspeed_tpu.inference.decoding import (
     cached_fn,
+    compile_pool_tick_fn,
     compile_ragged_prefill_fn,
+    compile_row_update_fn,
     compile_segment_fn,
     read_bucket,
-    select_token,
 )
 
 # admission/bucket sizing shares the ONE bucketing rule with the tight-read
 # geometry (decoding.read_bucket); the old local name stays importable
 _bucket = read_bucket
+
+# smallest fused-prefill chunk program width (power-of-2 buckets up to the
+# pool's chunk cap bound the static-shape program family)
+_CHUNK_FLOOR = 16
 
 
 @dataclass
@@ -81,10 +113,30 @@ class _Request:
     # snapshot of the registered prefix entry (tokens/cache/bucket), taken
     # at submit time so unregister_prefix cannot strand a queued request
     prefix: Optional[dict] = None
+    # device-side emission quota (== max_new_tokens; placement guarantees
+    # the pool row holds prompt + quota)
+    quota: int = 0
+    # fused prefill: remaining (tokens, pos0, n_real, emits) prompt chunks
+    # still to ride a tick; None/empty = decode-active
+    chunks: Optional[List[tuple]] = None
     # KV-cache bytes this request's row streamed across its decode ticks
-    # (deterministic host accounting — models.transformer.
-    # kv_read_bytes_per_row at each tick's read length)
+    # (host accounting at the read length each retired tick dispatched)
     kv_bytes_read: int = 0
+
+
+class _TickRecord:
+    """Host bookkeeping for one DISPATCHED (possibly in-flight) pool tick:
+    the packed result future plus everything needed to attribute it when
+    the tick is retired."""
+
+    __slots__ = ("packed", "live", "k", "row_bytes", "fused")
+
+    def __init__(self, packed, live, k, row_bytes, fused):
+        self.packed = packed          # device future: (B, k+2) int32
+        self.live = live              # slot -> _Request live at dispatch
+        self.k = k                    # burst length (1 for plain/fused)
+        self.row_bytes = row_bytes    # KV bytes one row streams per step
+        self.fused = fused            # carried a prefill chunk
 
 
 class _Pool:
@@ -102,15 +154,31 @@ class _Pool:
             tf.init_cache(engine.cfg, n_slots, length), self.cache_sh
         )
         self.active: Dict[int, _Request] = {}       # slot -> request
-        self.pos = np.zeros(n_slots, np.int32)      # next write position
-        self.last_tok = np.zeros(n_slots, np.int32)
-        # tick programs keyed by tight-read length (None = full pool
-        # length): shape/sampling are fixed for the engine's lifetime, so
-        # they live on the pool — bounded by the power-of-2 bucket count,
-        # never evicted (an LRU consulted per tick could recompile, and a
-        # shared-cache lookup per tick would churn its recency bookkeeping)
-        self.segment_fns: Dict[Optional[int], object] = {None: self.segment_fn}
-        self.burst_fns: Dict[Optional[int], object] = {}
+        # device-THREADED tick state: the tick programs return these as
+        # outputs that feed the next tick's inputs, so a tick can be
+        # dispatched before the previous one's results are fetched. Free
+        # slots start done=1 (never emit); admission flips a row live.
+        self.last_tok_dev = jnp.zeros(n_slots, jnp.int32)
+        self.done_dev = jnp.ones(n_slots, jnp.int32)
+        self.set_row_fn = compile_row_update_fn(engine.mesh, engine.cfg,
+                                                n_slots,
+                                                donate=engine.donate_cache)
+        # host DISPATCH mirrors: the position/emission count each row will
+        # have reached once every dispatched tick retires. Exact for live
+        # rows (a live row advances by exactly k per burst until done);
+        # rows whose finish the host has not yet observed are excluded
+        # from dispatch, so the mirrors never need reconciliation.
+        self.disp_pos = np.zeros(n_slots, np.int32)
+        self.disp_gen = np.zeros(n_slots, np.int32)
+        # fused prefill: admitted requests whose prompt chunks still need
+        # ticks, FIFO — one admitting row rides each tick
+        self.prefill_q: "deque[_Request]" = deque()
+        self.chunk_cap = min(engine.prefill_chunk, length)
+        # tick programs keyed (chunk_width, read_len): shape/sampling are
+        # fixed for the engine's lifetime, so they live on the pool —
+        # bounded by the (chunk bucket × read bucket) family size, never
+        # evicted (an LRU consulted per tick could recompile mid-serve)
+        self.tick_fns: Dict[tuple, object] = {}
 
     def free_slots(self) -> List[int]:
         return [s for s in range(self.n_slots) if s not in self.active]
@@ -120,14 +188,17 @@ class _Pool:
 
 
 class ContinuousBatchingEngine:
-    """Slot-pool serving loop over the shared-cache decode program."""
+    """Slot-pool serving loop over the compiled pool-tick programs."""
 
     def __init__(self, model, config=None, params=None, mesh=None,
                  max_slots: Optional[int] = None, cache_len: Optional[int] = None,
                  cache_buckets: Optional[List] = None,
                  eos_token_id: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 tokens_per_tick: int = 1):
+                 tokens_per_tick: int = 1, pipeline_depth: int = 1,
+                 fused_prefill: bool = True,
+                 prefill_chunk: Optional[int] = None,
+                 donate_cache: bool = True):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         self._eng = InferenceEngine(model, config=config, params=params,
@@ -140,14 +211,29 @@ class ContinuousBatchingEngine:
         self.mesh = self._eng.mesh
         self.eos_token_id = eos_token_id
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
-        # burst decoding: k decode steps per scheduler tick in ONE compiled
-        # program (decoding.compile_burst_segment_fn) — k× fewer host
-        # dispatches per token; new requests admit only between bursts, and
-        # a request finishing mid-burst wastes the rest of its burst row
-        # (the freed slot's stale cache is position-masked on reuse)
         assert tokens_per_tick >= 1, tokens_per_tick
+        assert pipeline_depth >= 0, pipeline_depth
         self.tokens_per_tick = tokens_per_tick
-        self._rng = jax.random.PRNGKey(seed)
+        # dispatch-ahead pipelining: how many ticks may be in flight before
+        # the host blocks on the oldest packed result. 0 = fully
+        # synchronous (retire every tick before returning from step()).
+        self.pipeline_depth = pipeline_depth
+        # fused prefill requires single-token ticks: a burst program has no
+        # chunk row (admission between bursts uses the separate path)
+        self.fused_prefill = fused_prefill and tokens_per_tick == 1
+        self.prefill_chunk = (prefill_chunk
+                              or self._eng.config.prefill_chunk_size or 128)
+        # donate the KV cache + threaded state through the tick programs
+        # (no per-tick cache copy in HBM). The jax CPU backend implements
+        # donation by blocking at dispatch — which serializes the tick
+        # chain — so virtual-mesh overlap measurements pass False here
+        # (ds_loadgen --no-donate); on TPU donation and async dispatch
+        # compose and this stays on.
+        self.donate_cache = donate_cache
+        # ONE base key: every sampled token draws from
+        # fold_in(fold_in(base, rid), token_index) on device, so streams
+        # are identical across pipeline depths / fusion / slot placement
+        self._base_key = jax.random.PRNGKey(seed)
 
         if cache_buckets is None:
             cache_len = min(cache_len or self.cfg.max_seq_len, self.cfg.max_seq_len)
@@ -172,6 +258,15 @@ class ContinuousBatchingEngine:
         self._prefixes: Dict[int, dict] = {}  # prefix caching (register_prefix)
         self._pending: List[_Request] = []
         self._results: Dict[int, np.ndarray] = {}
+        # dispatched-but-not-retired ticks, oldest first; each entry maps
+        # pool index -> _TickRecord for one scheduler tick
+        self._inflight: "deque[Dict[int, _TickRecord]]" = deque()
+        # host-overhead accounting for the tick loop (tick_stats());
+        # telemetry mirrors it into histograms/counters when enabled
+        self._tick_stats = {"ticks": 0, "steps": 0, "dispatch_ms": 0.0,
+                            "block_ms": 0.0, "tokens": 0, "wasted_tokens": 0,
+                            "capacity_tokens": 0, "fused_prefill_ticks": 0,
+                            "max_inflight": 0}
         # cancelled rids, remembered so status()/result() answer precisely
         # instead of "unknown" — BOUNDED (oldest evicted past 4096): a
         # long-running server cancels routinely and must not leak an int
@@ -224,7 +319,7 @@ class ContinuousBatchingEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(
-                "max_new_tokens must be >= 1 (admission emits a token)")
+                "max_new_tokens must be >= 1 (every request emits a token)")
         if prompt.size + max_new_tokens > self.cache_len:
             raise ValueError(
                 f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
@@ -293,7 +388,7 @@ class ContinuousBatchingEngine:
             raise ValueError("empty suffix (use submit for prefix-only prompts)")
         if max_new_tokens < 1:
             raise ValueError(
-                "max_new_tokens must be >= 1 (admission emits a token)")
+                "max_new_tokens must be >= 1 (every request emits a token)")
         pre = self._require_prefix(prefix_id)
         total = pre["tokens"].size + suffix.size
         if total + max_new_tokens > self.cache_len:
@@ -309,7 +404,8 @@ class ContinuousBatchingEngine:
         return rid
 
     def has_work(self) -> bool:
-        return bool(self._pending) or any(p.active for p in self._pools)
+        return (bool(self._pending) or bool(self._inflight)
+                or any(p.active for p in self._pools))
 
     def status(self, rid: int) -> str:
         """Non-destructive request state: ``"pending"`` (queued, no slot
@@ -348,10 +444,12 @@ class ContinuousBatchingEngine:
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request: a pending one leaves the queue, an active one
-        frees its pool slot immediately (no cache clearing needed — slot
-        reuse position-masks stale KV, same as normal completion). Returns
-        False when the rid is already finished/collected/unknown: too late
-        to cancel, the caller keeps the result semantics it already has."""
+        frees its pool slot immediately — even while a tick carrying it is
+        still in flight (the retired tick's row is simply not attributed;
+        stale KV is position-masked on slot reuse, same as completion).
+        Returns False when the rid is already finished/collected/unknown:
+        too late to cancel, the caller keeps the result semantics it
+        already has."""
         for i, req in enumerate(self._pending):
             if req.rid == rid:
                 self._pending.pop(i)
@@ -361,6 +459,11 @@ class ContinuousBatchingEngine:
             for slot, req in pool.active.items():
                 if req.rid == rid:
                     pool.active.pop(slot)
+                    if req.chunks:
+                        try:
+                            pool.prefill_q.remove(req)
+                        except ValueError:
+                            pass
                     self._mark_cancelled(rid)
                     return True
         return False
@@ -382,6 +485,25 @@ class ContinuousBatchingEngine:
         out, self._results = self._results, {}
         return out
 
+    def tick_stats(self) -> dict:
+        """Host-overhead accounting for the tick loop: dispatch vs blocked
+        milliseconds, tokens emitted / wasted past done flags, pipeline
+        depth actually reached. ``overlap_frac`` is the fraction of
+        host-side tick-loop time NOT spent blocked on device results
+        (1.0 = the device never made the host wait); ``block_ms_per_token``
+        is the loadgen A/B headline — host-blocked ms per decoded token."""
+        s = dict(self._tick_stats)
+        s["pipeline_depth"] = self.pipeline_depth
+        # NOT the tokens_per_tick knob (the burst width): the observed mean
+        s["mean_emitted_per_tick"] = (round(s["tokens"] / s["ticks"], 3)
+                                      if s["ticks"] else 0.0)
+        s["block_ms_per_token"] = (round(s["block_ms"] / s["tokens"], 4)
+                                   if s["tokens"] else None)
+        host = s["dispatch_ms"] + s["block_ms"]
+        s["overlap_frac"] = (round(1.0 - s["block_ms"] / host, 4)
+                             if host > 0 else None)
+        return s
+
     def _place(self, req: _Request) -> Optional[tuple]:
         """(pool_index, slot) in the smallest-length pool that fits the
         request's full extent and has a free slot; None if all full."""
@@ -399,15 +521,16 @@ class ContinuousBatchingEngine:
         return None
 
     def step(self) -> Dict[int, List[int]]:
-        """One scheduler tick: admit pending into free slots, then one
-        decode step (or a ``tokens_per_tick``-token burst) for every pool
-        with active slots. Returns {rid: [tokens]} emitted this tick: an
-        active request emits up to ``tokens_per_tick`` tokens, a
-        just-admitted one additionally leads with its prefill token.
-        Concatenating the lists across ticks reproduces the generated
-        stream exactly. Finished requests move to
-        ``finished()``/``result()``."""
+        """One scheduler tick: admit pending into free slots (dispatch
+        their prefill), dispatch one tick per pool with dispatchable rows,
+        then retire in-flight ticks down to ``pipeline_depth``. Returns
+        {rid: [tokens]} emitted by the RETIRED tick(s) — with
+        ``pipeline_depth > 0`` a request's tokens surface up to that many
+        steps after the tick that computed them; concatenating the lists
+        across steps reproduces the generated stream exactly. Finished
+        requests move to ``finished()``/``result()``."""
         emitted: Dict[int, List[int]] = {}
+        t0 = time.perf_counter()
         # FIFO with skip: a request that only fits the (full) long pool
         # must not block shorter requests behind it
         still_pending = []
@@ -416,60 +539,81 @@ class ContinuousBatchingEngine:
             if placed is None:
                 still_pending.append(req)
                 continue
-            pi, slot = placed
-            emitted[req.rid] = [self._admit(req, pi, slot)]
+            self._admit(req, *placed)
         self._pending = still_pending
 
-        for pool in self._pools:
-            if not pool.active:
-                continue
-            if self.tokens_per_tick > 1:
-                self._burst_tick(pool, emitted)
-                continue
-            read_len = self._tick_read_len(pool, 1)
-            toks = jnp.asarray(pool.last_tok[:, None])
-            pos = jnp.asarray(pool.pos)
-            self._rng, sub = jax.random.split(self._rng)
-            logits, pool.cache = self._segment_for(pool, read_len)(
-                self._eng.params, toks, pool.cache, pos
-            )
-            row_bytes = self._row_read_bytes(pool, read_len)
-            nxt = np.asarray(select_token(
-                logits[:, 0], self.temperature, self.top_k, sub, self.top_p
-            ))
-            for slot, req in list(pool.active.items()):
-                req.kv_bytes_read += row_bytes
-                tok = int(nxt[slot])
-                self._record(req, pool, slot, tok)
-                emitted.setdefault(req.rid, []).append(tok)
-            pool.pos[[s for s in pool.active]] += 1
-            for slot in [s for s, r in pool.active.items() if r.done]:
-                self._finish(pool, slot)
-        if self._eng.telemetry.enabled:
+        recs: Dict[int, _TickRecord] = {}
+        for pi, pool in enumerate(self._pools):
+            rec = self._dispatch_tick(pool)
+            if rec is not None:
+                recs[pi] = rec
+        # the dispatch span is INTENTIONALLY unsynced: it measures host
+        # enqueue work while the device runs ahead (the whole point of the
+        # overlap); the block span in _retire ends at a real host fetch
+        dispatch_ms = (time.perf_counter() - t0) * 1000.0  # ds-lint: disable=unsynced-timing
+        if recs:
+            self._inflight.append(recs)
+        stats = self._tick_stats
+        stats["steps"] += 1
+        stats["ticks"] += len(recs)
+        # emission capacity this step actually dispatched: every slot of a
+        # ticked pool could emit k tokens (utilization denominators must
+        # not assume one tick covers ALL pools)
+        stats["capacity_tokens"] += sum(
+            self._pools[pi].n_slots * r.k for pi, r in recs.items())
+        stats["fused_prefill_ticks"] += sum(1 for r in recs.values() if r.fused)
+        stats["dispatch_ms"] += dispatch_ms
+        stats["max_inflight"] = max(stats["max_inflight"], len(self._inflight))
+
+        # retire down to the pipeline depth; when nothing new was
+        # dispatched, the remaining in-flight ticks are the drain tail
+        block_ms = 0.0
+        tokens0, wasted0 = stats["tokens"], stats["wasted_tokens"]
+        while self._inflight and (len(self._inflight) > self.pipeline_depth
+                                  or not recs):
+            block_ms += self._retire(self._inflight.popleft(), emitted)
+        stats["block_ms"] += block_ms
+
+        tele = self._eng.telemetry
+        if tele.enabled:
+            reg = tele.registry
             # serving dashboards read pool pressure off this gauge: cached
             # tokens across live slots / total reserved slot capacity
-            self._eng.telemetry.registry.gauge("cache_utilization").set(
-                self.cache_utilization())
+            reg.gauge("cache_utilization").set(self.cache_utilization())
+            reg.gauge("tick_inflight_depth").set(len(self._inflight))
+            n_tokens = stats["tokens"] - tokens0
+            n_wasted = stats["wasted_tokens"] - wasted0
+            if recs or block_ms:
+                reg.histogram("tick_dispatch_ms").observe(dispatch_ms)
+                reg.histogram("tick_block_ms").observe(block_ms)
+                if n_wasted:
+                    reg.counter("burst_wasted_tokens").inc(n_wasted)
+                tele.emit("serving_tick", {
+                    "dispatch_ms": round(dispatch_ms, 4),
+                    "block_ms": round(block_ms, 4),
+                    "inflight": len(self._inflight),
+                    "emitted": n_tokens,
+                    "wasted": n_wasted,
+                    "fused_prefill": any(r.fused for r in recs.values()),
+                })
         return emitted
 
     def cache_utilization(self) -> float:
         """Fraction of the reserved slot-pool KV capacity holding live
-        tokens (active rows' cached extents / sum of slots × length)."""
-        used = sum(int(p.pos[s]) for p in self._pools for s in p.active)
+        tokens (active rows' observed extents / sum of slots × length)."""
+        used = sum(min(r.prompt.size + len(r.generated), p.length)
+                   for p in self._pools for r in p.active.values())
         cap = sum(p.n_slots * p.length for p in self._pools)
         return used / cap if cap else 0.0
 
-    def _tick_read_len(self, pool: _Pool, n_tokens: int) -> Optional[int]:
-        """Tight-read length for a decode tick over ``pool``: the bucket
-        covering every ACTIVE row's extent after ``n_tokens`` more steps
-        (inactive rows compute garbage that is discarded either way).
-        None = read the full pool length (tight reads off, or the bucket
-        reached it)."""
-        if not self._eng.config.kv_tight_read or not pool.active:
+    # -- tick dispatch / retire ------------------------------------------
+    def _read_len(self, pool: _Pool, extent: int) -> Optional[int]:
+        """Tight-read length covering ``extent`` cached slots (None = read
+        the full pool length: tight reads off, or the bucket reached it)."""
+        if not self._eng.config.kv_tight_read or extent <= 0:
             return None
-        floor = self._eng.config.kv_read_floor
-        extent = max(int(pool.pos[s]) for s in pool.active) + n_tokens
-        r = read_bucket(extent, pool.length, floor)
+        r = read_bucket(extent, pool.length,
+                        self._eng.config.kv_read_floor)
         return None if r >= pool.length else r
 
     def _row_read_bytes(self, pool: _Pool, read_len: Optional[int]) -> int:
@@ -478,53 +622,139 @@ class ContinuousBatchingEngine:
         return kv_read_bytes_per_row(
             self.cfg, read_len if read_len is not None else pool.length)
 
-    def _segment_for(self, pool: _Pool, read_len: Optional[int]):
-        """The pool's decode-tick segment program at a tight-read length
-        (None = the full-length program the pool was built with). Pool-
-        resident, like the burst programs — bounded by the bucket count."""
-        if read_len not in pool.segment_fns:
-            pool.segment_fns[read_len] = compile_segment_fn(
+    def _tick_fn(self, pool: _Pool, read_len: Optional[int],
+                 chunk: Optional[int] = None):
+        """The pool's compiled tick program at (chunk width, tight-read
+        length). Pool-resident — bounded by the bucket family, never
+        evicted."""
+        key = (chunk, read_len)
+        if key not in pool.tick_fns:
+            pool.tick_fns[key] = compile_pool_tick_fn(
                 self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
-                pool.length, read_len=read_len)[0]
-        return pool.segment_fns[read_len]
+                pool.length, 1 if chunk is not None else self.tokens_per_tick,
+                self.temperature, self.top_k, self.top_p,
+                eos_token_id=self.eos_token_id, read_len=read_len,
+                chunk=chunk, donate=self.donate_cache)[0]
+        return pool.tick_fns[key]
 
-    def _burst_tick(self, pool: _Pool, emitted: Dict[int, List[int]]):
-        """One k-token burst for a pool: a single dispatch of the compiled
-        burst program, then host-side acceptance (truncate each row at
-        done). Greedy streams are identical to tokens_per_tick=1; sampled
-        streams are equally-distributed but consume the rng in a different
-        order. The whole burst reads one tight-read bucket sized to cover
-        max(active pos) + k."""
-        from deepspeed_tpu.inference.decoding import compile_burst_segment_fn
+    def _dispatch_tick(self, pool: _Pool) -> Optional[_TickRecord]:
+        """Dispatch one tick for ``pool`` WITHOUT waiting for anything:
+        inputs come from the host dispatch mirrors plus the device-threaded
+        state futures. Returns None when the pool has nothing to run."""
+        n, k = pool.n_slots, self.tokens_per_tick
+        pos = np.full(n, pool.length, np.int32)   # parked rows: writes drop
+        gen = np.zeros(n, np.int32)
+        quota = np.zeros(n, np.int32)
+        rids = np.zeros(n, np.int32)
+        emit_mask = np.zeros(n, np.int32)
+        live: Dict[int, _Request] = {}
+        extent = 0
+        for slot, req in pool.active.items():
+            if req.chunks:
+                continue  # mid-prefill: parked unless it rides this tick
+            if pool.disp_gen[slot] >= req.quota:
+                continue  # quota exhausted: result still in flight, no work
+            live[slot] = req
+            pos[slot] = pool.disp_pos[slot]
+            gen[slot] = pool.disp_gen[slot]
+            quota[slot] = req.quota
+            rids[slot] = req.rid
+            emit_mask[slot] = 1
+            extent = max(extent, int(pool.disp_pos[slot]) + k)
+        admit = pool.prefill_q[0] if (self.fused_prefill and pool.prefill_q) else None
+        if not live and admit is None:
+            return None
 
-        k = self.tokens_per_tick
-        read_len = self._tick_read_len(pool, k)
-        if read_len not in pool.burst_fns:
-            pool.burst_fns[read_len] = compile_burst_segment_fn(
-                self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
-                pool.length, k, self.temperature, self.top_k, self.top_p,
-                read_len=read_len)[0]
-        burst_fn = pool.burst_fns[read_len]
-        toks = jnp.asarray(pool.last_tok[:, None])
-        pos = jnp.asarray(pool.pos)
-        self._rng, sub = jax.random.split(self._rng)
-        out, pool.cache = burst_fn(self._eng.params, toks, pool.cache, pos, sub)
-        row_bytes = k * self._row_read_bytes(pool, read_len)
-        out = np.asarray(out)  # (n_slots, k)
-        for slot, req in list(pool.active.items()):
-            # the burst streams k read windows for every row it carries,
-            # whether or not the request accepts all k tokens
-            req.kv_bytes_read += row_bytes
-            accepted = 0
-            for j in range(k):
-                if req.done:
-                    break  # rest of the burst row is wasted work, not state
-                self._record(req, pool, slot, int(out[slot, j]))
-                emitted.setdefault(req.rid, []).append(int(out[slot, j]))
-                accepted += 1
-            pool.pos[slot] += accepted
-        for slot in [s for s, r in pool.active.items() if r.done]:
-            self._finish(pool, slot)
+        params = self._eng.params
+        if admit is not None:
+            ctoks, cpos0, nreal, emits = admit.chunks[0]
+            aslot = admit.slot
+            W = _bucket(nreal, pool.chunk_cap, _CHUNK_FLOOR)
+            extent = max(extent, cpos0 + nreal)
+            read_len = self._read_len(pool, extent)
+            fn = self._tick_fn(pool, read_len, chunk=W)
+            chunk_toks = np.zeros(W, np.int32)
+            chunk_toks[:nreal] = ctoks
+            chunk_pos = np.full(W, pool.length, np.int32)
+            chunk_pos[:nreal] = np.arange(cpos0, cpos0 + nreal, dtype=np.int32)
+            emit_col = np.zeros(n, np.int32)
+            if emits:
+                emit_col[aslot] = nreal - 1
+                emit_mask[aslot] = 1
+                quota[aslot] = admit.quota
+                rids[aslot] = admit.rid
+                live[aslot] = admit
+            packed, pool.cache, pool.last_tok_dev, pool.done_dev = fn(
+                params, pool.cache, pool.last_tok_dev, pool.done_dev,
+                jnp.asarray(pos), jnp.asarray(gen), jnp.asarray(quota),
+                jnp.asarray(rids), self._base_key, jnp.asarray(chunk_toks),
+                jnp.asarray(chunk_pos), aslot, jnp.asarray(emit_col),
+                jnp.asarray(emit_mask))
+            admit.chunks.pop(0)
+            if not admit.chunks:
+                pool.prefill_q.popleft()
+                admit.chunks = None
+                pool.disp_pos[aslot] = cpos0 + nreal  # full prompt cached
+                pool.disp_gen[aslot] = 1              # the emitted first token
+            rec = _TickRecord(packed, live, 1,
+                              self._row_read_bytes(pool, read_len), True)
+            advance = 1
+        else:
+            read_len = self._read_len(pool, extent)
+            fn = self._tick_fn(pool, read_len)
+            packed, pool.cache, pool.last_tok_dev, pool.done_dev = fn(
+                params, pool.cache, pool.last_tok_dev, pool.done_dev,
+                jnp.asarray(pos), jnp.asarray(gen), jnp.asarray(quota),
+                jnp.asarray(rids), self._base_key)
+            rec = _TickRecord(packed, live, k,
+                              self._row_read_bytes(pool, read_len), False)
+            advance = k
+        # advance the dispatch mirrors for the decode rows (the admitting
+        # row's were set above); quota-clamped so a burst tail never
+        # over-advances a row the host can predict finishing
+        for slot, req in live.items():
+            if admit is not None and slot == admit.slot:
+                continue
+            adv = min(advance, int(req.quota) - int(pool.disp_gen[slot]))
+            pool.disp_pos[slot] += adv
+            pool.disp_gen[slot] += adv
+        return rec
+
+    def _retire(self, recs: Dict[int, _TickRecord],
+                emitted: Dict[int, List[int]]) -> float:
+        """Retire one in-flight tick: ONE coalesced packed-buffer fetch per
+        pool, then pure host attribution (no further device traffic).
+        Returns the milliseconds spent blocked on the device."""
+        block_ms = 0.0
+        stats = self._tick_stats
+        for pi, rec in recs.items():
+            pool = self._pools[pi]
+            t0 = time.perf_counter()
+            arr = np.asarray(rec.packed)  # the single device get per tick
+            block_ms += (time.perf_counter() - t0) * 1000.0
+            k = rec.k
+            for slot, req in rec.live.items():
+                if pool.active.get(slot) is not req:
+                    # cancelled / already finished while this tick was in
+                    # flight: the whole row-tick computed past the done
+                    # flag — that IS the pipelining waste, count it
+                    stats["wasted_tokens"] += k
+                    continue
+                n = int(arr[slot, k])
+                stats["tokens"] += n
+                stats["wasted_tokens"] += k - n
+                # the row STREAMED k read windows whether or not it accepted
+                # all k tokens (burst tails past done are wasted work, not
+                # free work) — kv_bytes_read reports physical HBM traffic
+                req.kv_bytes_read += k * rec.row_bytes
+                if n:
+                    toks = [int(t) for t in arr[slot, :n]]
+                    req.generated.extend(toks)
+                    emitted.setdefault(req.rid, []).extend(toks)
+                if arr[slot, k + 1]:
+                    req.done = True
+                    self._finish(pool, slot)
+        return block_ms
 
     # -- internals ------------------------------------------------------
     def _prefill_for_bucket(self, bucket: int):
@@ -568,71 +798,144 @@ class ContinuousBatchingEngine:
         return cached_fn(self, "insert_bucket", (bucket, pi), build,
                          slots=8 * len(self._pools))
 
-    def _admit(self, req: _Request, pi: int, slot: int) -> Optional[int]:
+    def _chunk_schedule(self, pool: _Pool, toks: np.ndarray,
+                        start: int) -> List[tuple]:
+        """Split a prompt (or prefix suffix) into the fused-prefill chunk
+        stream: [(tokens, pos0, n_real, emits)] — one tick each, the final
+        chunk carries the last prompt token and samples the first generated
+        token from its column."""
+        cap = pool.chunk_cap
+        out, off, m = [], 0, int(toks.size)
+        while off < m:
+            take = min(cap, m - off)
+            out.append((np.asarray(toks[off:off + take], np.int32),
+                        start + off, take, off + take == m))
+            off += take
+        return out
+
+    def _set_row(self, pool: _Pool, slot: int, tok: int, flag: int):
+        """Admission-time update of one row of the device-threaded tick
+        state — dispatched against the current futures, never fetched."""
+        pool.last_tok_dev, pool.done_dev = pool.set_row_fn(
+            pool.last_tok_dev, pool.done_dev, slot, tok, flag)
+
+    def _admit(self, req: _Request, pi: int, slot: int):
+        """Place ``req`` into a slot and dispatch its prefill — NOTHING
+        here blocks or fetches. Fused mode queues the prompt as chunk(s)
+        riding the next tick(s); separate mode prefills ``prompt[:-1]``
+        through the B=1 bucket program + splice and re-feeds the last
+        prompt token on the first decode tick (whose logits produce the
+        first generated token — same stream, no admission-time sample)."""
         from deepspeed_tpu.models import transformer as tf
 
         pool = self._pools[pi]
-        n = req.prompt.size
+        req.slot, req.pool = slot, pi
+        # placement guarantees prompt + max_new_tokens fits the pool row,
+        # so the device emission quota is exactly max_new_tokens
+        req.quota = req.max_new_tokens
+        pool.active[slot] = req
+        start = 0
+        toks = req.prompt
         if req.prefix is not None:
             pre = req.prefix
-            n_pre = pre["tokens"].size
-            # 1) splice the cached prefix KV into the slot row (the prefix
-            #    bucket cache is NOT donated — it serves every request)
+            # splice the cached prefix KV into the slot row (the prefix
+            # bucket cache is NOT donated — it serves every request)
             insert_fn = self._insert_for_bucket(pre["bucket"], pi)
             pool.cache = insert_fn(pool.cache, pre["cache"], slot)
-            # 2) prefill ONLY the suffix through the shared segment program:
-            #    other rows' positions park at the pool length so their KV
-            #    writes drop; suffix pad columns land at future positions of
-            #    THIS row, each overwritten by a real decode write before it
-            #    is ever attended (same argument as slot reuse)
-            suffix = req.prompt[n_pre:]
-            sb = _bucket(suffix.size, pool.length)
-            toks = np.zeros((pool.n_slots, sb), np.int32)
-            toks[slot, :suffix.size] = suffix
-            pos = np.full(pool.n_slots, pool.length, np.int32)
-            pos[slot] = n_pre
-            logits, pool.cache = pool.segment_fn(
-                self._eng.params, jnp.asarray(toks), pool.cache, jnp.asarray(pos)
-            )
-            last_logits = logits[slot: slot + 1, suffix.size - 1]
-        else:
-            bucket = _bucket(n, pool.length)
-            prefill_fn = self._prefill_for_bucket(bucket)
-            insert_fn = self._insert_for_bucket(bucket, pi)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = req.prompt
-            # pads park at bucket (dropped writes), real tokens pack 0..n-1
-            positions = np.full((1, bucket), bucket, np.int32)
-            positions[0, :n] = np.arange(n, dtype=np.int32)
-            small = tf.init_cache(self.cfg, 1, bucket)
-            logits, small = prefill_fn(
-                self._eng.params, jnp.asarray(toks), jnp.asarray(positions), small
-            )
-            pool.cache = insert_fn(pool.cache, small, slot)
-            last_logits = logits[:, n - 1]
-        self._rng, sub = jax.random.split(self._rng)
-        first = int(np.asarray(select_token(
-            last_logits, self.temperature, self.top_k, sub, self.top_p
-        ))[0])
-        pool.active[slot] = req
-        req.slot = slot
-        req.pool = pi
-        # the first generated token's KV is written at position n by the
-        # NEXT decode tick (it feeds last_tok at pos, then pos advances) —
-        # same protocol as ragged_decode_loop
-        pool.pos[slot] = n
-        self._record(req, pool, slot, first)
-        if req.done:
-            self._finish(pool, slot)
-        return first
+            start = pre["tokens"].size
+            toks = req.prompt[start:]
+        if self.fused_prefill:
+            req.chunks = self._chunk_schedule(pool, toks, start)
+            pool.prefill_q.append(req)
+            # flip the row live on device; last_tok is set by the emitting
+            # chunk tick itself (the sampled first token)
+            self._set_row(pool, slot, int(toks[-1]), 0)
+            return
+        m = int(toks.size)
+        if m > 1:
+            if req.prefix is not None:
+                # prefill the suffix MINUS its last token through the shared
+                # segment program: other rows' positions park at the pool
+                # length so their KV writes drop; pad columns land at future
+                # positions of THIS row, each overwritten by a real decode
+                # write before it is ever attended (slot-reuse argument)
+                sb = _bucket(m - 1, pool.length)
+                seg_toks = np.zeros((pool.n_slots, sb), np.int32)
+                seg_toks[slot, :m - 1] = toks[:m - 1]
+                seg_pos = np.full(pool.n_slots, pool.length, np.int32)
+                seg_pos[slot] = start
+                _, pool.cache = pool.segment_fn(
+                    self._eng.params, jnp.asarray(seg_toks), pool.cache,
+                    jnp.asarray(seg_pos))
+            else:
+                b = _bucket(m - 1, pool.length)
+                prefill_fn = self._prefill_for_bucket(b)
+                insert_fn = self._insert_for_bucket(b, pi)
+                ptoks = np.zeros((1, b), np.int32)
+                ptoks[0, :m - 1] = toks[:m - 1]
+                # pads park at bucket (dropped writes), real tokens 0..m-2
+                positions = np.full((1, b), b, np.int32)
+                positions[0, :m - 1] = np.arange(m - 1, dtype=np.int32)
+                small = tf.init_cache(self.cfg, 1, b)
+                _, small = prefill_fn(
+                    self._eng.params, jnp.asarray(ptoks),
+                    jnp.asarray(positions), small)
+                pool.cache = insert_fn(pool.cache, small, slot)
+        # the first tick re-feeds the last prompt token at its own
+        # position (writing its KV there — the position was not prefilled)
+        # and samples the first generated token from the resulting logits
+        self._set_row(pool, slot, int(toks[-1]), 0)
+        pool.disp_pos[slot] = start + m - 1
+        pool.disp_gen[slot] = 0
 
-    def _record(self, req: _Request, pool: _Pool, slot: int, tok: int):
-        req.generated.append(tok)
-        pool.last_tok[slot] = tok
-        hit_eos = self.eos_token_id is not None and tok == self.eos_token_id
-        total = req.prompt.size + len(req.generated)
-        if hit_eos or len(req.generated) >= req.max_new_tokens or total >= pool.length:
-            req.done = True
+    def precompile_tick_programs(self, progress: Optional[Callable] = None) -> int:
+        """Compile (and block on) the FULL tick-program family — every
+        (pool, read bucket, {plain/burst, fused chunk widths}) variant a
+        serve could dispatch — so first serve-time requests don't pay the
+        20-40 s remote compile per variant (dstpu_prewarm --continuous).
+        Runs each program once on throwaway state. Returns the count."""
+        from deepspeed_tpu.models import transformer as tf
+
+        count = 0
+        for pool in self._pools:
+            # enumerate the families through the SAME functions the serve
+            # dispatch uses (_read_len over every reachable extent, the
+            # chunk bucket over every real chunk size) — the warmed set can
+            # never drift from what a live tick will request
+            read_lens = sorted(
+                {self._read_len(pool, e) for e in range(1, pool.length + 1)},
+                key=lambda r: (r is None, r))
+            chunks: List[Optional[int]] = [None]
+            if self.fused_prefill:
+                chunks += sorted({_bucket(m, pool.chunk_cap, _CHUNK_FLOOR)
+                                  for m in range(1, pool.chunk_cap + 1)})
+            for rl in read_lens:
+                for ch in chunks:
+                    t0 = time.time()
+                    fn = self._tick_fn(pool, rl, chunk=ch)
+                    cache = jax.device_put(
+                        tf.init_cache(self.cfg, pool.n_slots, pool.length),
+                        pool.cache_sh)
+
+                    def zeros():
+                        # donated operands must not alias the plain ones —
+                        # fresh buffers per argument
+                        return jnp.zeros(pool.n_slots, jnp.int32)
+
+                    parked = jnp.full(pool.n_slots, pool.length, jnp.int32)
+                    args = (self._eng.params, cache, zeros(),
+                            jnp.ones(pool.n_slots, jnp.int32), parked,
+                            zeros(), zeros(), zeros(), self._base_key)
+                    if ch is not None:
+                        args += (jnp.zeros(ch, jnp.int32),
+                                 jnp.full(ch, pool.length, jnp.int32), 0,
+                                 zeros(), zeros())
+                    jax.block_until_ready(fn(*args)[0])
+                    count += 1
+                    if progress is not None:
+                        progress(f"tick(pool={pool.length}, read_len={rl}, "
+                                 f"chunk={ch}) in {time.time() - t0:.1f}s")
+        return count
 
     def _finish(self, pool: _Pool, slot: int):
         # pool pressure BEFORE the pop: the event describes the state this
@@ -657,8 +960,8 @@ class ContinuousBatchingEngine:
                 "kv_bytes_read": int(req.kv_bytes_read),
                 "cache_utilization": round(util, 4),
             }
-            if new > 1:  # admission emits the first token without a pool read
-                event["kv_bytes_per_token"] = round(req.kv_bytes_read / (new - 1), 1)
+            if new:  # every token rides a pool-tick read now
+                event["kv_bytes_per_token"] = round(req.kv_bytes_read / new, 1)
             if self.request_event_hook is not None:
                 event = self.request_event_hook(req.rid, event) or event
             tele.emit("inference_request", event)

@@ -415,45 +415,191 @@ def compile_segment_fn(mesh, cfg, param_shardings, batch_size: int, cache_len: i
     return segment_fn, cache_sh, batch_sh
 
 
-def compile_burst_segment_fn(mesh, cfg, param_shardings, batch_size: int,
-                             cache_len: int, n_tokens: int, temperature: float,
-                             top_k: int, top_p: float,
-                             read_len: Optional[int] = None):
-    """``n_tokens`` per-row-position decode steps fused into ONE compiled
-    program (``lax.scan`` over the segment forward + sampling): the
-    continuous-batching engine's burst tick — k× fewer host dispatches per
-    generated token, at the cost of admitting new requests only between
-    bursts. Row r's tokens land at positions pos[r]..pos[r]+n_tokens-1.
-    ``read_len`` tight-reads the cache across the whole burst — the caller
-    sizes it to cover max(pos) + n_tokens.
+def request_keys(base_key, rids, gens):
+    """Per-row sampling keys for the serving tick programs:
+    ``fold_in(fold_in(base, rid), gen)`` vmapped over the batch. A request's
+    sampled stream therefore depends only on (engine seed, request id, token
+    index) — never on which slot it landed in, which tick it joined, or how
+    many ticks are in flight. That independence is what makes the pipelined
+    (dispatch-ahead) and fused-prefill tick modes bitwise-identical to the
+    sync scheduler: scheduling may shift WHEN a token is produced, never
+    WHAT it is."""
+    def one(rid, gen):
+        return jax.random.fold_in(jax.random.fold_in(base_key, rid), gen)
 
-    Returns ``(burst_fn, cache_sh, batch_sh)`` with
-    ``burst_fn(params, toks, cache, pos, rng) -> ((B, n_tokens) int32, cache)``.
+    return jax.vmap(one)(rids, gens)
+
+
+def select_token_rows(logits, temperature: float, top_k: int, keys,
+                      top_p: float = 1.0) -> jnp.ndarray:
+    """Row-wise :func:`select_token`: one key per row (request_keys) instead
+    of one key per batch, same temperature/top-k/top-p filter."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = _filter_logits(logits, temperature, top_k, top_p)
+    return jax.vmap(jax.random.categorical)(keys, filtered).astype(jnp.int32)
+
+
+def compile_pool_tick_fn(mesh, cfg, param_shardings, batch_size: int,
+                         cache_len: int, n_tokens: int, temperature: float,
+                         top_k: int, top_p: float,
+                         eos_token_id: Optional[int] = None,
+                         read_len: Optional[int] = None,
+                         chunk: Optional[int] = None,
+                         donate: bool = True):
+    """One continuous-batching scheduler tick as ONE compiled program with
+    ON-DEVICE ACCEPTANCE: the forward, per-row sampling (request_keys),
+    EOS/quota done detection, position advance, and emission masking all
+    run inside the jit, and the tick returns one small packed int32 buffer
+    — ``(B, n_tokens + 2)``: ``[:, :k]`` sampled tokens, ``[:, k]``
+    n_emitted, ``[:, k+1]`` the done flag — so the host fetches a single
+    coalesced buffer per tick instead of per-row logits/acceptance state.
+    ``last_tok`` and ``done`` are device-THREADED (returned as outputs that
+    feed the next tick's inputs), which is what lets the engine keep a tick
+    in flight: tick N+1 can be dispatched on tick N's output futures before
+    the host ever looks at tick N's packed result.
+
+    Plain / burst (``chunk=None``)::
+
+        tick_fn(params, cache, last_tok, done, pos, gen, quota, rids, key)
+          -> (packed, cache, last_tok, done)
+
+    ``pos``/``gen``/``quota``/``rids`` are per-row int32 vectors the host
+    uploads each tick (it knows them deterministically for live rows; rows
+    it parks carry ``pos = cache_len`` so their KV writes drop). ``quota``
+    is the row's max_new_tokens; a row whose token hits EOS or exhausts the
+    quota flips its done flag and freezes (emission masked, last_tok/pos
+    held) for any remaining burst steps and for any tick already in flight.
+
+    Fused prefill (``chunk=W``, requires ``n_tokens == 1``): the same tick
+    additionally prefills ONE admitting row's next W-wide prompt chunk
+    inside the same dispatch (Dynamic-SplitFuse-style) — decode rows ride
+    column 0, the admitting row carries ``chunk_toks``/``chunk_pos`` (pads
+    parked at ``cache_len``), and ``emit_col``/``emit_mask`` route sampling
+    to the admitting row's last real prompt column on its final chunk::
+
+        tick_fn(params, cache, last_tok, done, pos, gen, quota, rids, key,
+                chunk_toks, chunk_pos, admit_slot, emit_col, emit_mask)
+          -> (packed, cache, last_tok, done)
+
+    The cache AND the threaded last_tok/done buffers are donated
+    (``donate_argnums``), so per-tick copies of the KV pool disappear from
+    HBM traffic. ``donate=False`` opts out: the jax CPU backend implements
+    donation by BLOCKING at dispatch until the donated buffer is free,
+    which serializes the tick chain and defeats dispatch-ahead pipelining
+    — the virtual-mesh loadgen A/B runs donation-off to measure the
+    overlap; on TPU donation and async dispatch compose and both stay on.
+    Returns ``(tick_fn, cache_sh, batch_sh)``.
     """
     from deepspeed_tpu.models import transformer as tf
 
     batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+    k = n_tokens
+    assert k >= 1, k
+    donate_argnums = (1, 2, 3) if donate else ()
 
-    def run(params, toks, cache, pos, rng):
-        def body(carry, _):
-            last, cache, pos, rng = carry
-            rng, sub = jax.random.split(rng)
-            logits, cache = tf.forward_with_cache(params, cfg, last, cache, pos,
-                                                  read_len=read_len)
-            tok = select_token(logits[:, 0], temperature, top_k, sub, top_p)
-            return (tok[:, None], cache, pos + 1, rng), tok
+    def accept(tok, last_tok, done, gen, quota, emit_mask):
+        """Shared acceptance: which rows emit this step, updated state."""
+        live = (done == 0) & (emit_mask == 1)
+        gen2 = jnp.where(live, gen + 1, gen)
+        stop = gen2 >= quota
+        if eos_token_id is not None:
+            stop = stop | (tok == eos_token_id)
+        done2 = jnp.where(live & stop, 1, done)
+        last2 = jnp.where(live, tok, last_tok)
+        return last2, done2, gen2, live.astype(jnp.int32)
 
-        (_, cache, _, _), out = jax.lax.scan(
-            body, (toks, cache, pos, rng), None, length=n_tokens)
-        return jnp.moveaxis(out, 0, 1), cache
+    def sample(logits, rids, gen, base_key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        keys = request_keys(base_key, rids, gen)
+        return select_token_rows(logits, temperature, top_k, keys, top_p)
+
+    if chunk is None:
+        ones = jnp.ones((batch_size,), jnp.int32)
+
+        def run(params, cache, last_tok, done, pos, gen, quota, rids, base_key):
+            def body(carry, _):
+                cache, last_tok, done, pos, gen = carry
+                logits, cache = tf.forward_with_cache(
+                    params, cfg, last_tok[:, None], cache, pos,
+                    read_len=read_len)
+                tok = sample(logits[:, 0], rids, gen, base_key)
+                last2, done2, gen2, emitted = accept(
+                    tok, last_tok, done, gen, quota, ones)
+                pos2 = jnp.where(done == 0, pos + 1, pos)
+                return (cache, last2, done2, pos2, gen2), (tok, emitted)
+
+            (cache, last_tok, done, _, _), (toks, emitted) = jax.lax.scan(
+                body, (cache, last_tok, done, pos, gen), None, length=k)
+            packed = jnp.concatenate(
+                [jnp.moveaxis(toks, 0, 1),
+                 emitted.sum(axis=0, dtype=jnp.int32)[:, None],
+                 done[:, None]], axis=1)
+            return packed, cache, last_tok, done
+
+        fn = jax.jit(
+            run,
+            in_shardings=(param_shardings, cache_sh, batch_sh, batch_sh,
+                          batch_sh, batch_sh, batch_sh, batch_sh, None),
+            out_shardings=(batch_sh, cache_sh, batch_sh, batch_sh),
+            donate_argnums=donate_argnums,
+        )
+        return fn, cache_sh, batch_sh
+
+    assert k == 1, "fused-prefill ticks are single-token (burst admits " \
+                   "between bursts via the separate-prefill path)"
+    W = chunk
+
+    def run(params, cache, last_tok, done, pos, gen, quota, rids, base_key,
+            chunk_toks, chunk_pos, admit_slot, emit_col, emit_mask):
+        toks = jnp.zeros((batch_size, W), jnp.int32).at[:, 0].set(last_tok)
+        toks = toks.at[admit_slot].set(chunk_toks)
+        positions = jnp.full((batch_size, W), cache_len, jnp.int32)
+        positions = positions.at[:, 0].set(pos).at[admit_slot].set(chunk_pos)
+        logits, cache = tf.forward_with_cache(
+            params, cfg, toks, cache, pos, positions=positions,
+            read_len=read_len)
+        sel = jnp.take_along_axis(logits, emit_col[:, None, None], axis=1)[:, 0]
+        tok = sample(sel, rids, gen, base_key)
+        last2, done2, gen2, emitted = accept(
+            tok, last_tok, done, gen, quota, emit_mask)
+        packed = jnp.concatenate(
+            [tok[:, None], emitted[:, None], done2[:, None]], axis=1)
+        return packed, cache, last2, done2
 
     fn = jax.jit(
         run,
-        in_shardings=(param_shardings, batch_sh, cache_sh, batch_sh, None),
-        out_shardings=(batch_sh, cache_sh),
-        donate_argnums=(2,),
+        in_shardings=(param_shardings, cache_sh, batch_sh, batch_sh,
+                      batch_sh, batch_sh, batch_sh, batch_sh, None,
+                      None, None, None, batch_sh, batch_sh),
+        out_shardings=(batch_sh, cache_sh, batch_sh, batch_sh),
+        donate_argnums=donate_argnums,
     )
     return fn, cache_sh, batch_sh
+
+
+def compile_row_update_fn(mesh, cfg, batch_size: int, donate: bool = True):
+    """Tiny jitted row update for the device-threaded tick state: admission
+    sets one slot's ``last_tok``/``done`` without fetching or rebuilding the
+    (possibly still in-flight) arrays — the update is dispatched against the
+    current output futures and chains behind any tick already queued. Both
+    operands are donated (in-place on device); ``donate`` follows the
+    engine's ``donate_cache`` knob — the CPU backend blocks donated
+    dispatches, and admission must stay enqueue-only in overlap
+    measurements. Returns ``set_row(last_tok, done, slot, tok, flag) ->
+    (last_tok, done)``."""
+    batch_sh, _ = _decode_shardings(mesh, cfg, batch_size)
+
+    def set_row(last_tok, done, slot, tok, flag):
+        return last_tok.at[slot].set(tok), done.at[slot].set(flag)
+
+    return jax.jit(
+        set_row,
+        in_shardings=(batch_sh, batch_sh, None, None, None),
+        out_shardings=(batch_sh, batch_sh),
+        donate_argnums=(0, 1) if donate else (),
+    )
 
 
 def _filtered_probs(logits, temperature: float, top_k: int, top_p: float):

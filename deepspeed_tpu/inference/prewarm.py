@@ -63,10 +63,21 @@ def main(argv=None):
     p.add_argument("--chunk", type=int, default=0,
                    help="also warm the chunked-prefill program set")
     p.add_argument("--continuous", action="store_true",
-                   help="warm the continuous-batching pool programs")
+                   help="warm the continuous-batching pool programs — the "
+                        "FULL tick family: every (read bucket x {plain/"
+                        "burst, fused-prefill chunk width}) variant a serve "
+                        "could dispatch, so serve-time requests never pay "
+                        "the 20-40s remote compile per variant")
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--cache-len", type=int, default=512)
     p.add_argument("--burst", type=int, default=1)
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="pipeline depth the warmed serve will run at (a "
+                        "host-loop knob: it does not change the compiled "
+                        "program set, recorded for the drive-through warm)")
+    p.add_argument("--no-fused-prefill", action="store_true",
+                   help="skip the fused-prefill tick variants (warm the "
+                        "separate B=1 prefill + splice programs instead)")
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA cache dir (defaults to jax config / "
                         "JAX_COMPILATION_CACHE_DIR)")
@@ -138,9 +149,14 @@ def main(argv=None):
 
         serve = ContinuousBatchingEngine(
             model, params=params, config=dict(cfg), max_slots=args.slots,
-            cache_len=args.cache_len, tokens_per_tick=args.burst)
+            cache_len=args.cache_len, tokens_per_tick=args.burst,
+            pipeline_depth=args.pipeline_depth,
+            fused_prefill=not args.no_fused_prefill)
 
         def run_pool():
+            # drive a real request through: warms the admission programs
+            # (prefill/splice or the first chunk width) plus the tick
+            # read-buckets this prompt actually crosses
             pool_new = min(args.new, 8)
             plen = min(args.prompt, args.cache_len - pool_new)
             assert plen >= 1, (
@@ -153,6 +169,13 @@ def main(argv=None):
 
         tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
              f"burst={args.burst})", run_pool)
+        # then the FULL tick-program family (bucket x read_len x {plain,
+        # burst, fused-prefill}): a live serve dispatches whichever variant
+        # its mix demands — every one missing cold-costs a remote compile
+        n_fns = serve.precompile_tick_programs(
+            progress=lambda msg: print(f"prewarm: {msg}", flush=True))
+        print(f"prewarm: tick-program family complete "
+              f"({n_fns} variants resident)", flush=True)
     print("prewarm: done — executables persisted to the XLA compile cache",
           flush=True)
     return 0

@@ -17,6 +17,12 @@ touching the hot path:
 - **Request lifecycle**: cancellation frees the pool slot mid-flight,
   per-token streaming (callback or pull iterator), and queued work whose
   deadline has blown is shed instead of decoded uselessly.
+- **Pipelined drive**: the serving loop drives the engine's
+  dispatch-ahead tick pipeline (``pipeline_depth``, default one tick in
+  flight — the engine overlaps device compute with this layer's
+  scheduling/admission work; ``pipeline_depth=0`` restores the fully
+  synchronous loop, token streams bitwise identical). ``tick_stats()``
+  reports the dispatch/block/overlap accounting.
 - **Telemetry**: every lifecycle transition counts
   (``serve_admitted/shed/expired/cancelled/finished_total``,
   ``serve_deadline_met/missed_total``, ``serve_queue_depth`` /
@@ -97,11 +103,19 @@ class ServingEngine:
 
     def __init__(self, engine, policy="fifo", max_queue_depth: int = 64,
                  kv_budget_tokens: Optional[int] = None,
-                 aging_s: float = 30.0, clock=time.monotonic):
+                 aging_s: float = 30.0, clock=time.monotonic,
+                 pipeline_depth: Optional[int] = None):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
         if aging_s <= 0:
             raise ValueError("aging_s must be > 0")
+        if pipeline_depth is not None:
+            if pipeline_depth < 0:
+                raise ValueError("pipeline_depth must be >= 0")
+            # the serving layer drives the engine's dispatch-pipelined tick
+            # loop; None keeps whatever the engine was constructed with
+            # (default: 1 tick in flight — docs/serving.md "Tick pipeline")
+            engine.pipeline_depth = pipeline_depth
         self._cb = engine
         self.policy: SchedulerPolicy = resolve_policy(policy, aging_s=aging_s)
         self.max_queue_depth = max_queue_depth
@@ -247,6 +261,20 @@ class ServingEngine:
         what admission weighs against ``kv_budget_tokens``."""
         return (sum(r.need_tokens for r in self._queue)
                 + sum(r.need_tokens for r in self._running.values()))
+
+    def tick_stats(self) -> dict:
+        """Tick-utilization accounting for the serving loop: the engine's
+        dispatch/block/overlap numbers (``ContinuousBatchingEngine.
+        tick_stats``) plus ``utilization`` — fraction of the dispatched
+        emission capacity actually emitted (tokens / capacity_tokens,
+        where each ticked pool contributes slots × burst). This is the
+        in-process view of what ``ds_trace_report --serve`` computes from
+        ``serving_tick`` trace events, and what ``ds_loadgen``'s
+        ``--pipeline-depth`` A/B compares."""
+        s = self._cb.tick_stats()
+        cap = s.get("capacity_tokens", 0)
+        s["utilization"] = round(s["tokens"] / cap, 4) if cap else 0.0
+        return s
 
     def status(self, rid: int) -> str:
         req = self._requests.get(rid)

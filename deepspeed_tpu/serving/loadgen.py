@@ -207,11 +207,37 @@ def _pcts(vals: List[float]) -> dict:
     return {"p50": percentile(vals, 50.0), "p99": percentile(vals, 99.0)}
 
 
-def summarize(records: List[dict], wall_s: float) -> dict:
+def host_overhead(tick_stats: dict) -> dict:
+    """Host-overhead columns from a ``ServingEngine.tick_stats()`` (or the
+    bare engine's) snapshot: mean dispatch vs blocked ms per scheduler
+    step, the overlap fraction (host tick-loop time NOT spent blocked on
+    device results), and the A/B headline — host-blocked ms per decoded
+    token."""
+    steps = tick_stats.get("steps", 0)
+    out = {
+        "pipeline_depth": tick_stats.get("pipeline_depth"),
+        "ticks": tick_stats.get("ticks", 0),
+        "tick_dispatch_ms_mean": (round(tick_stats["dispatch_ms"] / steps, 4)
+                                  if steps else None),
+        "tick_block_ms_mean": (round(tick_stats["block_ms"] / steps, 4)
+                               if steps else None),
+        "overlap_frac": tick_stats.get("overlap_frac"),
+        "block_ms_per_token": tick_stats.get("block_ms_per_token"),
+        "wasted_tokens": tick_stats.get("wasted_tokens", 0),
+    }
+    if "utilization" in tick_stats:
+        out["tick_utilization"] = tick_stats["utilization"]
+    return out
+
+
+def summarize(records: List[dict], wall_s: float,
+              tick_stats: Optional[dict] = None) -> dict:
     """The serving scorecard over one run's records: counts per outcome,
     TTFT/TBT/queue-wait p50/p99, offered load, throughput, goodput
     (deadline-met output tokens per second — all finished tokens when the
-    workload carries no deadlines), shed rate, deadline-met fraction."""
+    workload carries no deadlines), shed rate, deadline-met fraction.
+    ``tick_stats`` (ServingEngine.tick_stats()) adds the ``host`` section:
+    dispatch/blocked ms, overlap fraction, blocked ms per token."""
     by_state: Dict[str, int] = {}
     for r in records:
         state = r.get("state", r.get("status", "?"))
@@ -241,6 +267,8 @@ def summarize(records: List[dict], wall_s: float) -> dict:
         out["deadline_met_frac"] = round(
             sum(1 for r in with_deadline if r["deadline_met"])
             / len(with_deadline), 4)
+    if tick_stats is not None:
+        out["host"] = host_overhead(tick_stats)
     return out
 
 
@@ -263,6 +291,37 @@ def format_summary(summary: dict) -> str:
     lines.append(f"shed rate      {summary['shed_rate']:.2%}")
     if "deadline_met_frac" in summary:
         lines.append(f"deadline met   {summary['deadline_met_frac']:.2%}")
+    host = summary.get("host")
+    if host:
+        def _ms(v):
+            return f"{v:.3f} ms" if isinstance(v, (int, float)) else "-"
+
+        lines.append(f"host overhead  dispatch {_ms(host['tick_dispatch_ms_mean'])}"
+                     f"/step   blocked {_ms(host['tick_block_ms_mean'])}/step"
+                     + (f"   overlap {host['overlap_frac']:.1%}"
+                        if host.get("overlap_frac") is not None else ""))
+        lines.append(f"blocked/token  {_ms(host['block_ms_per_token'])}  "
+                     f"(pipeline depth {host['pipeline_depth']}, "
+                     f"wasted {host['wasted_tokens']} tok)")
+    return "\n".join(lines) + "\n"
+
+
+def format_ab(sync: dict, pipelined: dict) -> str:
+    """Side-by-side sync-vs-pipelined comparison (``--pipeline-depth`` A/B):
+    the two scorecards plus the headline ratios — host-blocked ms per
+    decoded token (the ≥2x acceptance metric) and throughput."""
+    lines = ["== pipeline A/B: sync (depth 0) ==", format_summary(sync).rstrip(),
+             "", f"== pipelined (depth {pipelined['host']['pipeline_depth']}) ==",
+             format_summary(pipelined).rstrip(), ""]
+    b0 = (sync.get("host") or {}).get("block_ms_per_token")
+    b1 = (pipelined.get("host") or {}).get("block_ms_per_token")
+    if b0 is not None and b1 is not None:
+        # a (near-)zero pipelined value is the BEST case, not a missing one
+        ratio = f" ({b0 / b1:.2f}x less blocking)" if 0 < b1 < b0 else ""
+        lines.append(f"host-blocked ms/token: {b0:.4f} -> {b1:.4f}{ratio}")
+    t0, t1 = sync.get("throughput_tok_s"), pipelined.get("throughput_tok_s")
+    if t0 is not None and t1 is not None and t0 > 0:
+        lines.append(f"throughput tok/s:      {t0} -> {t1} ({t1 / t0:.2f}x)")
     return "\n".join(lines) + "\n"
 
 
@@ -312,6 +371,22 @@ def main(argv=None) -> int:
                    help="cache_buckets instead of --slots/--cache-len, "
                         "e.g. 6x128,2x512")
     p.add_argument("--tokens-per-tick", type=int, default=1)
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="ticks kept in flight (dispatch-ahead pipelining); "
+                        "0 = fully synchronous scheduler")
+    p.add_argument("--no-fused-prefill", action="store_true",
+                   help="admit via the separate B=1 prefill + splice "
+                        "instead of riding prompt chunks inside the tick")
+    p.add_argument("--no-donate", action="store_true",
+                   help="disable tick-state buffer donation: the jax CPU "
+                        "backend blocks at dispatch to honour donation, "
+                        "which serializes the tick chain — pass this for "
+                        "virtual-mesh overlap measurements (on TPU "
+                        "donation and async dispatch compose; keep it on)")
+    p.add_argument("--ab-pipeline", action="store_true",
+                   help="run the SAME workload twice — sync (depth 0) vs "
+                        "--pipeline-depth — and report both scorecards "
+                        "plus the host-blocked-ms/token ratio")
     p.add_argument("--policy", default="fifo",
                    choices=("fifo", "priority", "edf", "fair"))
     p.add_argument("--queue-depth", type=int, default=64)
@@ -363,31 +438,58 @@ def main(argv=None) -> int:
     else:
         model = TransformerModel.from_preset(args.preset, dtype=args.dtype)
     params = model.init(jax.random.PRNGKey(args.seed))
-    cfg = {"dtype": args.dtype}
-    if args.trace_out:
-        cfg["telemetry"] = {"enabled": True, "trace_file": args.trace_out}
-    engine_kwargs = {}
-    if args.buckets:
-        engine_kwargs["cache_buckets"] = _parse_buckets(args.buckets)
-    else:
-        engine_kwargs["max_slots"] = args.slots
-        engine_kwargs["cache_len"] = args.cache_len
-    cb = ContinuousBatchingEngine(model, params=params, config=cfg,
-                                  tokens_per_tick=args.tokens_per_tick,
-                                  **engine_kwargs)
-    serving = ServingEngine(cb, policy=args.policy,
-                            max_queue_depth=args.queue_depth,
-                            kv_budget_tokens=args.kv_budget,
-                            aging_s=args.aging_s)
 
-    records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
-    summary = summarize(records, wall_s)
-    if args.as_json:
-        print(json.dumps(summary, indent=2, sort_keys=True))
+    def build_serving(depth: int, trace_out=None):
+        cfg = {"dtype": args.dtype}
+        if trace_out:
+            cfg["telemetry"] = {"enabled": True, "trace_file": trace_out}
+        engine_kwargs = {}
+        if args.buckets:
+            engine_kwargs["cache_buckets"] = _parse_buckets(args.buckets)
+        else:
+            engine_kwargs["max_slots"] = args.slots
+            engine_kwargs["cache_len"] = args.cache_len
+        cb = ContinuousBatchingEngine(
+            model, params=params, config=cfg,
+            tokens_per_tick=args.tokens_per_tick,
+            pipeline_depth=depth,
+            fused_prefill=not args.no_fused_prefill,
+            donate_cache=not args.no_donate,
+            **engine_kwargs)
+        return ServingEngine(cb, policy=args.policy,
+                             max_queue_depth=args.queue_depth,
+                             kv_budget_tokens=args.kv_budget,
+                             aging_s=args.aging_s)
+
+    def one_run(depth: int, trace_out=None):
+        serving = build_serving(depth, trace_out=trace_out)
+        records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
+        summary = summarize(records, wall_s, tick_stats=serving.tick_stats())
+        if trace_out:
+            serving.close()
+        return summary
+
+    if args.ab_pipeline:
+        # BOTH sides must pay identical telemetry overhead or the A/B is
+        # biased — with --trace-out the sync run writes a sibling trace
+        sync_trace = args.trace_out + ".sync.jsonl" if args.trace_out else None
+        sync = one_run(0, trace_out=sync_trace)
+        pipelined = one_run(max(args.pipeline_depth, 1),
+                            trace_out=args.trace_out)
+        if sync_trace:
+            print(f"sync-side trace written to {sync_trace}")
+        if args.as_json:
+            print(json.dumps({"sync": sync, "pipelined": pipelined},
+                             indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_ab(sync, pipelined))
     else:
-        sys.stdout.write(format_summary(summary))
+        summary = one_run(args.pipeline_depth, trace_out=args.trace_out)
+        if args.as_json:
+            print(json.dumps(summary, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_summary(summary))
     if args.trace_out:
-        serving.close()
         print(f"trace written to {args.trace_out} "
               f"(summarize: python tools/ds_trace_report.py {args.trace_out} "
               f"--serve)")

@@ -92,10 +92,19 @@ class TestContinuousBatching:
                                       eos_token_id=eos)
         ra = cb.submit(prompts[0], max_new_tokens=8)
         rb = cb.submit(prompts[1], max_new_tokens=8)
-        cb.step()
-        done = cb.finished()
-        assert ra in done  # finished at its very first token
+        done = {}
+        ticks = 0
+        while cb.status(ra) in ("pending", "active"):
+            cb.step()
+            done.update(cb.finished())
+            ticks += 1
+        done.update(cb.finished())
+        # finished at its very first token (admission tick + the pipelined
+        # retire lag), freeing the slot while rb keeps decoding
+        assert ticks <= 2 + cb.pipeline_depth
+        assert ra in done
         assert len(done[ra]) == len(prompts[0]) + 1 and done[ra][-1] == eos
+        assert cb.status(rb) == "active"  # unaffected by ra's early exit
         while cb.has_work():
             cb.step()
             done.update(cb.finished())

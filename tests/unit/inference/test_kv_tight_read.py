@@ -210,18 +210,21 @@ class TestKvBytesAccounting:
         while cb.has_work():
             cb.step()
         cb.finished()
-        # simulate: admission emits token 1; each of the 11 ticks reads the
-        # bucket covering (pos + 1) where pos starts at the prompt length
+        # simulate: EVERY token rides a pool tick now (the admission tick
+        # itself samples token 1 — fused prefill — and each later tick
+        # feeds the previous token). Tick i reads the bucket covering
+        # (prompt + i) cached slots: the first tick attends exactly the
+        # prompt, the last attends prompt + 11.
         expect = 0
-        for i in range(11):
-            extent = 7 + i + 1
+        for i in range(12):
+            extent = 7 + i
             r = read_bucket(extent, 64, FLOOR)
             expect += kv_read_bytes_per_row(cb.cfg, r if r < 64 else 64)
         ev = [e for e in self._trace_events(trace)
               if e.get("path") == "continuous" and e["request"] == rid][0]
         assert ev["kv_bytes_read"] == expect
         assert ev["new_tokens"] == 12
-        assert ev["kv_bytes_per_token"] == round(expect / 11, 1)
+        assert ev["kv_bytes_per_token"] == round(expect / 12, 1)
 
     def test_cache_utilization_gauge(self, setup):
         model, params = setup

@@ -116,6 +116,31 @@ class TestSummarize:
         assert s["goodput_tok_s"] == s["throughput_tok_s"] == 8.0
         assert "deadline_met_frac" not in s
 
+    def test_host_overhead_columns_and_ab_format(self):
+        """The --pipeline-depth A/B surfaces: host-overhead math from a
+        tick_stats snapshot and the side-by-side comparison formatter."""
+        stats = {"pipeline_depth": 1, "ticks": 10, "steps": 5,
+                 "dispatch_ms": 4.0, "block_ms": 1.0, "tokens": 50,
+                 "wasted_tokens": 3, "overlap_frac": 0.8,
+                 "block_ms_per_token": 0.02, "utilization": 0.625}
+        host = loadgen.host_overhead(stats)
+        assert host["tick_dispatch_ms_mean"] == 0.8
+        assert host["tick_block_ms_mean"] == 0.2
+        assert host["overlap_frac"] == 0.8
+        assert host["block_ms_per_token"] == 0.02
+        assert host["tick_utilization"] == 0.625
+        records = [{"state": "finished", "arrival_s": 0.0, "tokens": 8}]
+        s1 = loadgen.summarize(records, wall_s=2.0, tick_stats=stats)
+        assert s1["host"]["pipeline_depth"] == 1
+        text = loadgen.format_summary(s1)
+        assert "host overhead" in text and "blocked/token" in text
+        sync = loadgen.summarize(records, wall_s=2.0, tick_stats=dict(
+            stats, pipeline_depth=0, block_ms_per_token=0.05))
+        ab = loadgen.format_ab(sync, s1)
+        assert "pipeline A/B" in ab
+        assert "2.50x less blocking" in ab
+        assert "throughput tok/s" in ab
+
 
 @pytest.fixture(scope="module")
 def setup():

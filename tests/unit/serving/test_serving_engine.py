@@ -177,6 +177,36 @@ class TestSaturation:
                        aging_s=300.0)
         assert srv.policy.aging_s == 300.0  # not the policy default
 
+    def test_pipeline_depth_drives_engine_and_tick_stats(self, setup):
+        """The serving layer drives the engine's dispatch-pipelined tick
+        loop: the knob reaches the engine, sync mode is selectable, both
+        produce identical streams, and tick_stats() reports the
+        utilization accounting."""
+        clock = FakeClock()
+        cb, srv = _make(setup, clock=clock, max_slots=2, cache_len=64,
+                        pipeline_depth=0)
+        assert cb.pipeline_depth == 0
+        p = _prompts((5, 7), seed=30)
+        sync_ids = [srv.submit(x, max_new_tokens=6) for x in p]
+        _drain(srv, clock, step_s=0.1)
+        sync_out = {rid: req.result for rid, req in srv.reap().items()}
+
+        clock2 = FakeClock()
+        cb2, srv2 = _make(setup, clock=clock2, max_slots=2, cache_len=64,
+                          pipeline_depth=2)
+        assert cb2.pipeline_depth == 2
+        piped_ids = [srv2.submit(x, max_new_tokens=6) for x in p]
+        _drain(srv2, clock2, step_s=0.1)
+        piped = srv2.reap()
+        for a, b in zip(sync_ids, piped_ids):
+            np.testing.assert_array_equal(sync_out[a.rid], piped[b.rid].result)
+        stats = srv2.tick_stats()
+        assert stats["pipeline_depth"] == 2 and stats["ticks"] > 0
+        assert stats["tokens"] == 12
+        assert 0.0 < stats["utilization"] <= 1.0
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            _make(setup, max_slots=1, cache_len=32, pipeline_depth=-1)
+
 
 class TestPolicies:
     def test_edf_admission_order_under_contention(self, setup):

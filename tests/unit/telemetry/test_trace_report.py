@@ -69,11 +69,20 @@ def test_serve_table():
     assert table["ttft_ms_p50"] == 15.0
     assert table["deadline_met_frac"] == 0.5
     # goodput: only the deadline-met request's 8 tokens over the 0.6 s
-    # event-time span
+    # event-time span (serving_tick events do NOT widen the span)
     assert table["good_tokens"] == 8
     assert abs(table["goodput_tok_s"] - 8 / 0.6) < 0.01
+    # host-overhead breakdown from the serving_tick events: 1.4 ms
+    # dispatched vs 0.6 ms blocked over 2 ticks emitting 12 tokens
+    assert table["tick_steps"] == 2
+    assert table["tick_dispatch_ms_mean"] == 0.7
+    assert table["tick_block_ms_mean"] == 0.3
+    assert table["overlap_frac"] == 0.7      # 1 - 0.6 / 2.0
+    assert table["block_ms_per_token"] == 0.05
+    assert table["wasted_tokens"] == 2 and table["inflight_max"] == 1
     text = ds_trace_report.format_serve_table(table)
     assert "serving summary" in text and "shed rate" in text
+    assert "tick host" in text and "blocked/token" in text
 
 
 def test_serve_table_empty_without_serving_events():

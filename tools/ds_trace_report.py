@@ -162,11 +162,17 @@ def serve_table(events):
     hook); sheds/expiries/cancellations are ``serving_event`` lifecycle
     records. Reports queue-wait and TTFT p50/p95, shed rate, deadline-met
     fraction, and goodput (deadline-met output tokens over the event-time
-    span). Empty dict when the trace holds no serving activity."""
+    span). Per-tick ``serving_tick`` events add the host-overhead
+    breakdown — mean dispatch vs blocked ms, the overlap fraction (tick-
+    loop time NOT spent blocked on device results), host-blocked ms per
+    decoded token, and tokens computed past done flags (wasted) — so the
+    dispatch-pipelining win is measurable from the trace alone. Empty
+    dict when the trace holds no serving activity."""
     finished = [e for e in events if e.get("kind") == "inference_request"
                 and e.get("path") == "serving"]
     lifecycle = [e for e in events if e.get("kind") == "serving_event"]
-    if not finished and not lifecycle:
+    ticks = [e for e in events if e.get("kind") == "serving_tick"]
+    if not finished and not lifecycle and not ticks:
         return {}
     by_event = {}
     for e in lifecycle:
@@ -198,6 +204,24 @@ def serve_table(events):
     out["good_tokens"] = good
     if span > 0:
         out["goodput_tok_s"] = round(good / span, 3)
+    if ticks:
+        def _tot(fld):
+            return sum(float(e.get(fld, 0.0)) for e in ticks)
+
+        dispatch, block = _tot("dispatch_ms"), _tot("block_ms")
+        emitted = _tot("emitted")
+        out["tick_steps"] = len(ticks)
+        out["tick_dispatch_ms_mean"] = round(dispatch / len(ticks), 4)
+        out["tick_block_ms_mean"] = round(block / len(ticks), 4)
+        if dispatch + block > 0:
+            out["overlap_frac"] = round(1.0 - block / (dispatch + block), 4)
+        if emitted > 0:
+            out["block_ms_per_token"] = round(block / emitted, 4)
+        out["wasted_tokens"] = int(_tot("wasted"))
+        depths = [int(e["inflight"]) for e in ticks
+                  if isinstance(e.get("inflight"), (int, float))]
+        if depths:
+            out["inflight_max"] = max(depths)
     return out
 
 
@@ -220,6 +244,21 @@ def format_serve_table(table):
     if "goodput_tok_s" in table:
         lines.append(f"goodput           {_fmt(table['goodput_tok_s'])} tok/s "
                      f"({table['good_tokens']} deadline-met tokens)")
+    if "tick_dispatch_ms_mean" in table:
+        line = (f"tick host         dispatch {_fmt(table['tick_dispatch_ms_mean'])} ms"
+                f"   blocked {_fmt(table['tick_block_ms_mean'])} ms")
+        if "overlap_frac" in table:
+            line += f"   overlap {table['overlap_frac'] * 100:.1f}%"
+        lines.append(line)
+        tail = []
+        if "block_ms_per_token" in table:
+            tail.append(f"blocked/token {_fmt(table['block_ms_per_token'])} ms")
+        if table.get("wasted_tokens"):
+            tail.append(f"wasted {table['wasted_tokens']} tok")
+        if "inflight_max" in table:
+            tail.append(f"inflight<= {table['inflight_max']}")
+        if tail:
+            lines.append(f"                  {'   '.join(tail)}")
     return "\n".join(lines) + "\n"
 
 
