@@ -600,26 +600,51 @@ def bench_serving():
 
     t_phase0 = time.time()
     budget_s = int(os.environ.get("DSTPU_BENCH_PHASE_BUDGET", "240"))
-    engine = ContinuousBatchingEngine(
-        model, config={"dtype": model.cfg.dtype}, max_slots=slots,
-        cache_len=cache_len, tokens_per_tick=burst)
     rs = np.random.RandomState(0)
     queue = [(t, jnp.asarray(rs.randint(0, model.cfg.vocab_size, (n,)), jnp.int32), new)
              for t, n, new in arrivals]
 
-    # warm the compiled programs so the timed loops measure serving, not
-    # 40s remote compiles: the FULL tick family (every read-bucket/chunk
-    # variant the A/B runs could dispatch — a partial warm would bill the
-    # stragglers to whichever side runs first) plus one driven request per
-    # prompt bucket for the admission prefill/splice programs
     from deepspeed_tpu.inference.continuous import _bucket
 
-    engine.precompile_tick_programs()
-    for b in sorted({_bucket(int(p.size), cache_len) for _, p, _ in queue}):
-        engine.submit(jnp.zeros((b,), jnp.int32), max_new_tokens=4)
-    while engine.has_work():
-        engine.step()
-    engine.finished()
+    def build_engine(tensor):
+        """One serving engine on a ("data","tensor") mesh of the given
+        tensor width (1 = the incumbent default mesh), warmed: the FULL
+        tick family (every read-bucket/chunk variant the A/B runs could
+        dispatch — a partial warm would bill the stragglers to whichever
+        side runs first) plus one driven request per prompt bucket for
+        the admission prefill/splice programs."""
+        cfg = {"dtype": model.cfg.dtype}
+        if tensor > 1:
+            cfg["mesh"] = {"shape": {"data": 1, "tensor": tensor}}
+        eng = ContinuousBatchingEngine(
+            model, config=cfg, max_slots=slots,
+            cache_len=cache_len, tokens_per_tick=burst)
+        eng.precompile_tick_programs()
+        for b in sorted({_bucket(int(p.size), cache_len) for _, p, _ in queue}):
+            eng.submit(jnp.zeros((b,), jnp.int32), max_new_tokens=4)
+        while eng.has_work():
+            eng.step()
+        eng.finished()
+        return eng
+
+    device_kind = jax.devices()[0].device_kind
+    nocache = _SMOKE or os.environ.get("DSTPU_BENCH_NOCACHE") == "1"
+    # tensor-width sweep (MULTICHIP numbers): power-of-2 widths that fit
+    # the host and divide the model's q AND kv heads — the serving column
+    # self-tunes its mesh exactly like the PR 3/5 geometry/depth sweeps.
+    # The cached width winner short-circuits to one engine build.
+    widths = [1]
+    if not _SMOKE:
+        w = 2
+        while (w <= jax.device_count() and model.cfg.num_heads % w == 0
+               and model.cfg.kv_heads % w == 0):
+            widths.append(w)
+            w *= 2
+    cached_width = None if nocache else _cached_serving_width(device_kind)
+    if cached_width in widths and len(widths) > 1:
+        widths = [cached_width]
+
+    engine = build_engine(widths[0])
     warm_s = time.time() - t_phase0
     _progress(f"serving warmup (engine + bucket compiles) done in {warm_s:.1f}s")
     if budget_s - warm_s < 30:
@@ -666,27 +691,52 @@ def bench_serving():
             "overlap_frac": round(1.0 - block / host, 4) if host > 0 else None,
         }
 
-    device_kind = jax.devices()[0].device_kind
-    cached_depth = (None if _SMOKE or os.environ.get("DSTPU_BENCH_NOCACHE") == "1"
-                    else _cached_serving_depth(device_kind))
+    def tune_depth(tensor):
+        """Depth A/B (or its cached winner) for ONE serving mesh; the
+        winner is cached PER MESH — a depth probed single-chip is never
+        replayed on a sharded tick chain."""
+        mesh_shape = {"data": 1, "tensor": tensor}
+        cached_depth = (None if nocache
+                        else _cached_serving_depth(device_kind, mesh_shape))
+        if cached_depth is not None:
+            side = run_serve(cached_depth)
+            return {"pipeline_depth": cached_depth, "ab": "cached", **side}
+        sync = run_serve(0)
+        piped = run_serve(1)
+        winner_depth = 1 if piped["tokens_per_sec"] >= sync["tokens_per_sec"] else 0
+        side = piped if winner_depth else sync
+        if not _SMOKE:
+            _save_serving_depth(device_kind, winner_depth, mesh_shape)
+        return {"pipeline_depth": winner_depth,
+                "ab": {"sync": sync, "pipelined": piped}, **side}
+
+    sweep = {}
+    swept_all = True
+    for t in widths:
+        if engine is None:
+            if time.time() - t_phase0 > budget_s - 60:
+                swept_all = False  # out of budget: keep what we measured
+                _progress(f"serving mesh sweep stopped before 1x{t} "
+                          f"(phase budget)")
+                break
+            engine = build_engine(t)
+        sweep[f"1x{t}"] = tune_depth(t)
+        engine = None  # free the width's params/caches before the next
+    best_key = max(sweep, key=lambda k: sweep[k]["tokens_per_sec"])
+    best = sweep[best_key]
+    best_tensor = int(best_key.split("x")[1])
+    if not _SMOKE and swept_all and len(sweep) > 1:
+        _save_serving_width(device_kind, best_tensor)
     extra = {
         "requests": len(arrivals),
         "slots": slots,
         "cache_len": cache_len,
         "tokens_per_tick": burst,
+        "mesh": {"data": 1, "tensor": best_tensor},
+        "mesh_sweep": sweep,
+        "mesh_sweep_complete": swept_all,
+        **best,
     }
-    if cached_depth is not None:
-        best = run_serve(cached_depth)
-        extra.update({"pipeline_depth": cached_depth, "ab": "cached", **best})
-    else:
-        sync = run_serve(0)
-        piped = run_serve(1)
-        winner_depth = 1 if piped["tokens_per_sec"] >= sync["tokens_per_sec"] else 0
-        best = piped if winner_depth else sync
-        if not _SMOKE:
-            _save_serving_depth(device_kind, winner_depth)
-        extra.update({"pipeline_depth": winner_depth,
-                      "ab": {"sync": sync, "pipelined": piped}, **best})
     return {
         "metric": "serving_continuous_tokens_per_sec",
         "value": best["tokens_per_sec"],
@@ -863,7 +913,8 @@ def _bench_digest():
     for rel in ("_bench_impl.py", "deepspeed_tpu/ops/pallas/flash_attention.py",
                 "deepspeed_tpu/models/transformer.py", "deepspeed_tpu/runtime/engine.py",
                 "deepspeed_tpu/inference/decoding.py",
-                "deepspeed_tpu/inference/continuous.py"):
+                "deepspeed_tpu/inference/continuous.py",
+                "deepspeed_tpu/parallel/partition.py"):
         try:
             with open(os.path.join(root, rel), "rb") as f:
                 h.update(f.read())
@@ -918,17 +969,40 @@ def _save_winner(device_kind, attn, remat, bs, block=None):
                       {"attn": attn, "remat": remat, "bs": bs, "block": block})
 
 
-def _cached_serving_depth(device_kind):
-    """Serving-bench winner (pipeline depth of the sync-vs-pipelined A/B),
-    cached alongside the decode winner under a ``serving/`` key and
-    digest-invalidated the same way."""
-    entry = _winner_cache_get(f"serving/{_winner_key(device_kind)}")
+def _serving_winner_key(device_kind, mesh_shape):
+    """Serving winners are keyed by the SERVING MESH as well as the device
+    kind/count: a pipeline depth probed single-chip says nothing about the
+    sharded tick chain (collectives sit on the dispatch path), so a
+    ``mesh1x1`` winner must never be replayed on a ``mesh1x4`` serve."""
+    d = int(mesh_shape.get("data", 1))
+    t = int(mesh_shape.get("tensor", 1))
+    return f"serving/{_winner_key(device_kind)}/mesh{d}x{t}"
+
+
+def _cached_serving_depth(device_kind, mesh_shape=None):
+    """Serving-bench winner (pipeline depth of the sync-vs-pipelined A/B)
+    for one serving mesh, cached alongside the decode winner under a
+    ``serving/`` key and digest-invalidated the same way."""
+    entry = _winner_cache_get(
+        _serving_winner_key(device_kind, mesh_shape or {}))
     return int(entry["pipeline_depth"]) if entry is not None else None
 
 
-def _save_serving_depth(device_kind, depth):
-    _winner_cache_put(f"serving/{_winner_key(device_kind)}",
+def _save_serving_depth(device_kind, depth, mesh_shape=None):
+    _winner_cache_put(_serving_winner_key(device_kind, mesh_shape or {}),
                       {"pipeline_depth": int(depth)})
+
+
+def _cached_serving_width(device_kind):
+    """Tensor-width winner of the bench_serving mesh sweep (None = never
+    swept on this host/digest)."""
+    entry = _winner_cache_get(f"serving_mesh/{_winner_key(device_kind)}")
+    return int(entry["tensor"]) if entry is not None else None
+
+
+def _save_serving_width(device_kind, tensor):
+    _winner_cache_put(f"serving_mesh/{_winner_key(device_kind)}",
+                      {"tensor": int(tensor)})
 
 
 def bench_gpt2_train():

@@ -11,6 +11,10 @@ module*:
 - ``jax.make_mesh((..,), ('data', 'model'))``
 - ``mesh_shape={'data': 1, 'fsdp': -1}`` dict literals (this repo's
   ``comm.init_distributed`` convention)
+- ``InferenceConfig.mesh`` declarations: ``mesh={'data': 1, 'tensor': 2}``
+  keyword args, ``MeshConfig(shape={...})`` calls, and the config-dict
+  forms ``{"mesh": {...}}`` / ``{"mesh": {"shape": {...}}}`` (the serving
+  mesh block, docs/inference.md "Tensor-parallel serving")
 
 Modules that declare no mesh literally are skipped — the mesh arrives from
 another layer and the check would only guess.
@@ -63,6 +67,28 @@ class PartitionSpecAxisRule(Rule):
                             f"({', '.join(sorted(declared))})",
                         )
 
+    # the MeshConfig block's own field names — a dict using ANY of them
+    # is the block form (mirrors InferenceConfig.parse's detection), so
+    # its keys are field names, never axes; axes live under 'shape'
+    _MESH_BLOCK_FIELDS = {"shape", "rules", "use_rules"}
+
+    @staticmethod
+    def _shape_dict_axes(node):
+        """Axis names out of a mesh-shape dict literal — either the flat
+        ``{'data': 1, 'tensor': 2}`` form or the InferenceConfig mesh
+        block ``{'shape': {...}, 'rules': [...]}`` (axes live under the
+        nested ``shape``; a rules-only block declares no axes)."""
+        if not isinstance(node, ast.Dict):
+            return set()
+        keys = [k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+        if set(keys) & PartitionSpecAxisRule._MESH_BLOCK_FIELDS:
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "shape"):
+                    return PartitionSpecAxisRule._shape_dict_axes(v)
+            return set()
+        return set(keys)
+
     @staticmethod
     def _declared_axes(tree):
         axes = set()
@@ -77,10 +103,28 @@ class PartitionSpecAxisRule(Rule):
                         if kw.arg == "axis_names":
                             axes.update(_str_elts(kw.value))
                 for kw in node.keywords:
-                    if kw.arg == "mesh_shape" and isinstance(kw.value, ast.Dict):
-                        for key in kw.value.keys:
-                            if isinstance(key, ast.Constant) and isinstance(key.value, str):
-                                axes.add(key.value)
+                    # mesh_shape= (comm.init_distributed) and mesh=
+                    # (InferenceConfig / engine ctors) dict literals; a
+                    # MeshConfig(shape={...}) call declares the same way
+                    if kw.arg in ("mesh_shape", "mesh"):
+                        axes.update(PartitionSpecAxisRule._shape_dict_axes(kw.value))
+                    elif kw.arg == "shape" and name == "MeshConfig":
+                        axes.update(PartitionSpecAxisRule._shape_dict_axes(kw.value))
+                # config-dict form: a {"mesh": {...}} / {"mesh": {"shape":
+                # {...}}} literal passed AS A CALL ARGUMENT (engine
+                # config=, InferenceConfig.parse({...})) declares the
+                # serving mesh block. Restricted to call arguments on
+                # purpose: a bare {"mesh": ...} assignment or return is
+                # usually a data record (telemetry, bench extra), and a
+                # record must neither declare axes nor flip a
+                # mesh-from-another-layer module into a checked one.
+                for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(sub, ast.Dict):
+                        for k, v in zip(sub.keys, sub.values):
+                            if (isinstance(k, ast.Constant)
+                                    and k.value in ("mesh", "mesh_shape")):
+                                axes.update(
+                                    PartitionSpecAxisRule._shape_dict_axes(v))
             elif isinstance(node, ast.Assign):
                 # mesh_shape = {'data': 1, ...} bound then passed by name
                 if (
@@ -90,7 +134,5 @@ class PartitionSpecAxisRule(Rule):
                         for t in node.targets
                     )
                 ):
-                    for key in node.value.keys:
-                        if isinstance(key, ast.Constant) and isinstance(key.value, str):
-                            axes.add(key.value)
+                    axes.update(PartitionSpecAxisRule._shape_dict_axes(node.value))
         return axes

@@ -3,7 +3,7 @@ DeepSpeedInferenceConfig — dtype, tensor_parallel, moe, quant,
 replace_with_kernel_inject, max_out_tokens)."""
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.runtime.config_utils import from_dict
 from deepspeed_tpu.telemetry.config import TelemetryConfig
@@ -13,6 +13,31 @@ from deepspeed_tpu.telemetry.config import TelemetryConfig
 class QuantConfig:
     enabled: bool = False
     num_bits: int = 8
+
+
+@dataclass
+class MeshConfig:
+    """Serving mesh for tensor-parallel inference (docs/inference.md
+    "Tensor-parallel serving"). ``shape`` maps mesh axis names
+    (comm.MESH_AXES; serving uses ``data``/``tensor``) to sizes — an
+    explicit shape smaller than the host's device count builds a SUBSET
+    mesh over the first ``prod(shape)`` devices (virtual-mesh A/Bs run
+    several widths in one process); ``-1`` absorbs remaining devices as
+    before. ``rules`` are regex partition-rule overrides,
+    ``[[pattern, [axis, ...]], ...]`` matched against ``/``-joined param
+    paths: on a model carrying ``logical_specs`` annotations they
+    override placement PER MATCHED LEAF (unmatched params keep their
+    annotation); without annotations — or under ``use_rules`` — they
+    front the whole-tree regex table (parallel/partition.DEFAULT_RULES).
+    The default (no shape, no rules) is the single-chip degenerate mesh
+    — bit-identical to pre-mesh configs."""
+
+    shape: Optional[Dict[str, int]] = None
+    # regex partition-rule overrides (see class docstring)
+    rules: Optional[List[Any]] = None
+    # force the regex rule table even for models carrying logical_specs
+    # annotations (default: annotations win, regex serves models without)
+    use_rules: bool = False
 
 
 @dataclass
@@ -100,7 +125,11 @@ class InferenceConfig:
     replace_method: str = "auto"
     enable_cuda_graph: bool = False  # no-op: XLA compiles whole programs
     profile_model_time: bool = False
-    mesh: Optional[Dict[str, int]] = None
+    # serving mesh block: shape + regex partition-rule overrides; a plain
+    # {axis: size} dict (the pre-mesh-block form) still parses as the
+    # shape alone. None = the engine's default mesh (single-chip
+    # degenerate unless tensor_parallel.tp_size says otherwise).
+    mesh: MeshConfig = field(default_factory=MeshConfig)
 
     @classmethod
     def parse(cls, config) -> "InferenceConfig":
@@ -136,10 +165,17 @@ class InferenceConfig:
             telemetry = {"enabled": telemetry}
         if isinstance(telemetry, TelemetryConfig):
             telemetry = dict(telemetry.__dict__)
+        mesh = config.get("mesh", {})
+        if not isinstance(mesh, MeshConfig):
+            mesh = dict(mesh or {})
+            if mesh and not (set(mesh) & set(MeshConfig.__dataclass_fields__)):
+                # pre-mesh-block form: a plain {axis: size} dict IS the shape
+                mesh = {"shape": mesh}
+            mesh = from_dict(MeshConfig, mesh)
         known = {f for f in cls.__dataclass_fields__}
         base = {k: v for k, v in config.items()
                 if k in known and k not in ("tensor_parallel", "moe", "quant", "speculative",
-                                            "telemetry", "dtype")}
+                                            "telemetry", "dtype", "mesh")}
         return cls(
             dtype=dtype,
             tensor_parallel=from_dict(TensorParallelConfig, tp if isinstance(tp, dict) else {}),
@@ -147,5 +183,6 @@ class InferenceConfig:
             quant=from_dict(QuantConfig, quant),
             speculative=from_dict(SpeculativeConfig, spec),
             telemetry=from_dict(TelemetryConfig, telemetry),
+            mesh=mesh,
             **base,
         )

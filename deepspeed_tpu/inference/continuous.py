@@ -158,8 +158,13 @@ class _Pool:
         # outputs that feed the next tick's inputs, so a tick can be
         # dispatched before the previous one's results are fetched. Free
         # slots start done=1 (never emit); admission flips a row live.
-        self.last_tok_dev = jnp.zeros(n_slots, jnp.int32)
-        self.done_dev = jnp.ones(n_slots, jnp.int32)
+        # Placed REPLICATED over the mesh up front (the tick programs'
+        # row-state sharding) so the first tick never pays a reshard.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        row_sh = NamedSharding(engine.mesh, PartitionSpec())
+        self.last_tok_dev = jax.device_put(jnp.zeros(n_slots, jnp.int32), row_sh)
+        self.done_dev = jax.device_put(jnp.ones(n_slots, jnp.int32), row_sh)
         self.set_row_fn = compile_row_update_fn(engine.mesh, engine.cfg,
                                                 n_slots,
                                                 donate=engine.donate_cache)
@@ -618,9 +623,13 @@ class ContinuousBatchingEngine:
 
     def _row_read_bytes(self, pool: _Pool, read_len: Optional[int]) -> int:
         from deepspeed_tpu.models.transformer import kv_read_bytes_per_row
+        from deepspeed_tpu.parallel.partition import kv_shard_width
 
+        # per-chip: the pool cache shards its heads axis over the mesh's
+        # tensor width, so each chip streams 1/tp of the row's window
         return kv_read_bytes_per_row(
-            self.cfg, read_len if read_len is not None else pool.length)
+            self.cfg, read_len if read_len is not None else pool.length,
+            tp=kv_shard_width(self.mesh, self.cfg))
 
     def _tick_fn(self, pool: _Pool, read_len: Optional[int],
                  chunk: Optional[int] = None):

@@ -61,18 +61,21 @@ def read_stages(prompt_len: int, n_steps: int, cache_len: int,
 
 
 def decode_kv_bytes(cfg, prompt_len: int, new_tokens: int, cache_len: int,
-                    floor: Optional[int] = None) -> int:
+                    floor: Optional[int] = None, tp: int = 1) -> int:
     """Deterministic host-side accounting: KV-cache bytes ONE sequence row
     streams across the ``new_tokens - 1`` decode steps of a generation
     (prefill excluded — its read is the segment itself). This mirrors the
     read geometry the compiled programs actually execute (read_stages), so
     telemetry's ``kv_bytes_read`` is assertable in tests and comparable
-    across tight/full configurations."""
+    across tight/full configurations. ``tp`` (the cache's heads-axis shard
+    width, parallel.partition.kv_shard_width) makes the number PER-CHIP:
+    each chip of a tensor-parallel mesh streams only its head shard."""
     from deepspeed_tpu.models.transformer import kv_read_bytes_per_row
 
     total = 0
     for r, n in read_stages(prompt_len, new_tokens - 1, cache_len, floor):
-        total += n * kv_read_bytes_per_row(cfg, r if r is not None else cache_len)
+        total += n * kv_read_bytes_per_row(cfg, r if r is not None else cache_len,
+                                           tp=tp)
     return total
 
 
@@ -91,6 +94,20 @@ def _decode_shardings(mesh, cfg, batch_size: int):
         tf.init_cache(cfg, 1, 8),
     )
     return batch_sh, cache_sh
+
+
+def _tick_shardings(mesh, cfg, batch_size: int):
+    """(row_sh, cache_sh, batch_sh) for the serving tick programs. The
+    per-row scheduling state (pos/gen/quota/rids, the threaded
+    last_tok/done) and the packed ``(B, k+2)`` acceptance buffer stay
+    FULLY REPLICATED over the mesh: the host uploads/fetches them every
+    tick, and a replicated buffer keeps that one coalesced transfer per
+    tick instead of a per-device gather — the row vectors are a few
+    hundred int32s, so replication costs nothing while the KV cache and
+    params carry the real sharding (heads/hidden/vocab on ``tensor``)."""
+    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+    row_sh = NamedSharding(mesh, PartitionSpec())
+    return row_sh, cache_sh, batch_sh
 
 
 def compile_decode_fns(mesh, cfg, param_shardings, batch_size: int, cache_len: int):
@@ -493,7 +510,7 @@ def compile_pool_tick_fn(mesh, cfg, param_shardings, batch_size: int,
     """
     from deepspeed_tpu.models import transformer as tf
 
-    batch_sh, cache_sh = _decode_shardings(mesh, cfg, batch_size)
+    row_sh, cache_sh, _ = _tick_shardings(mesh, cfg, batch_size)
     k = n_tokens
     assert k >= 1, k
     donate_argnums = (1, 2, 3) if donate else ()
@@ -540,12 +557,12 @@ def compile_pool_tick_fn(mesh, cfg, param_shardings, batch_size: int,
 
         fn = jax.jit(
             run,
-            in_shardings=(param_shardings, cache_sh, batch_sh, batch_sh,
-                          batch_sh, batch_sh, batch_sh, batch_sh, None),
-            out_shardings=(batch_sh, cache_sh, batch_sh, batch_sh),
+            in_shardings=(param_shardings, cache_sh, row_sh, row_sh,
+                          row_sh, row_sh, row_sh, row_sh, None),
+            out_shardings=(row_sh, cache_sh, row_sh, row_sh),
             donate_argnums=donate_argnums,
         )
-        return fn, cache_sh, batch_sh
+        return fn, cache_sh, row_sh
 
     assert k == 1, "fused-prefill ticks are single-token (burst admits " \
                    "between bursts via the separate-prefill path)"
@@ -570,13 +587,13 @@ def compile_pool_tick_fn(mesh, cfg, param_shardings, batch_size: int,
 
     fn = jax.jit(
         run,
-        in_shardings=(param_shardings, cache_sh, batch_sh, batch_sh,
-                      batch_sh, batch_sh, batch_sh, batch_sh, None,
-                      None, None, None, batch_sh, batch_sh),
-        out_shardings=(batch_sh, cache_sh, batch_sh, batch_sh),
+        in_shardings=(param_shardings, cache_sh, row_sh, row_sh,
+                      row_sh, row_sh, row_sh, row_sh, None,
+                      None, None, None, row_sh, row_sh),
+        out_shardings=(row_sh, cache_sh, row_sh, row_sh),
         donate_argnums=donate_argnums,
     )
-    return fn, cache_sh, batch_sh
+    return fn, cache_sh, row_sh
 
 
 def compile_row_update_fn(mesh, cfg, batch_size: int, donate: bool = True):
@@ -589,15 +606,15 @@ def compile_row_update_fn(mesh, cfg, batch_size: int, donate: bool = True):
     dispatches, and admission must stay enqueue-only in overlap
     measurements. Returns ``set_row(last_tok, done, slot, tok, flag) ->
     (last_tok, done)``."""
-    batch_sh, _ = _decode_shardings(mesh, cfg, batch_size)
+    row_sh, _, _ = _tick_shardings(mesh, cfg, batch_size)
 
     def set_row(last_tok, done, slot, tok, flag):
         return last_tok.at[slot].set(tok), done.at[slot].set(flag)
 
     return jax.jit(
         set_row,
-        in_shardings=(batch_sh, batch_sh, None, None, None),
-        out_shardings=(batch_sh, batch_sh),
+        in_shardings=(row_sh, row_sh, None, None, None),
+        out_shardings=(row_sh, row_sh),
         donate_argnums=(0, 1) if donate else (),
     )
 

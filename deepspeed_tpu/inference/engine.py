@@ -117,17 +117,36 @@ class InferenceEngine:
         self.cfg = cfg
 
         # mesh: inference default is tensor-parallel (+ expert-parallel for
-        # MoE models, reference moe_inference ep groups) over available chips
+        # MoE models, reference moe_inference ep groups) over available chips.
+        # An EXPLICIT config.mesh.shape with no -1 wildcard builds a subset
+        # mesh over the first prod(shape) devices WITHOUT touching the global
+        # comm state — several serving widths coexist in one process (the
+        # sharded-vs-replicated loadgen A/B, the bench width sweep).
+        mesh_cfg = self.config.mesh
         if mesh is None:
-            if comm.is_initialized():
+            shape = mesh_cfg.shape
+            if shape is not None:
+                # ALWAYS a LOCAL mesh for an explicit config shape — a
+                # wildcard absorbs the whole host, a no-wildcard shape
+                # takes the first prod(shape) devices — so a serving
+                # engine never overwrites the process-global comm mesh a
+                # training engine may be using
+                devs = jax.devices()
+                if -1 not in shape.values():
+                    need = int(np.prod(list(shape.values()) or [1]))
+                    if need > len(devs):
+                        raise ValueError(
+                            f"mesh shape {shape} needs {need} devices, "
+                            f"only {len(devs)} available")
+                    devs = devs[:need]
+                mesh = comm.build_mesh(shape, devices=devs)
+            elif comm.is_initialized():
                 mesh = comm.get_mesh()
             else:
-                shape = self.config.mesh
-                if shape is None:
-                    shape = {"data": -1, "tensor": self.config.tensor_parallel.tp_size}
-                    ep = self.config.moe.ep_size
-                    if (self.config.moe.enabled or cfg.moe_num_experts > 0) and ep > 1:
-                        shape["expert"] = ep
+                shape = {"data": -1, "tensor": self.config.tensor_parallel.tp_size}
+                ep = self.config.moe.ep_size
+                if (self.config.moe.enabled or cfg.moe_num_experts > 0) and ep > 1:
+                    shape["expert"] = ep
                 mesh = comm.init_distributed(mesh_shape=shape, verbose=False)
         self.mesh = mesh
 
@@ -135,7 +154,27 @@ class InferenceEngine:
         abstract = jax.eval_shape(self.model.init, jax.random.PRNGKey(seed))
         logical = self.model.logical_specs(abstract) if hasattr(self.model, "logical_specs") else None
         self.policy.logical_specs = logical
-        self.param_shardings = self.policy.param_shardings(abstract)
+        if mesh_cfg.use_rules or logical is None:
+            # whole-tree regex partition table (parallel/partition.py —
+            # the module_inject layer for a mesh backend): user overrides
+            # first, then the model-family defaults; serves models
+            # WITHOUT logical_specs annotations, or any config forcing
+            # the regex path with use_rules
+            from deepspeed_tpu.parallel.partition import partition_params
+
+            self.param_shardings = partition_params(mesh, abstract,
+                                                    rules=mesh_cfg.rules)
+        elif mesh_cfg.rules:
+            # annotations win, user rules override PER-LEAF: only params
+            # a rule matches change placement — one attention override
+            # must not strip the expert/vocab intent annotations carry
+            from deepspeed_tpu.parallel.partition import apply_rule_overrides
+
+            self.param_shardings = apply_rule_overrides(
+                mesh, abstract, self.policy.param_shardings(abstract),
+                mesh_cfg.rules)
+        else:
+            self.param_shardings = self.policy.param_shardings(abstract)
         self.replicated = NamedSharding(mesh, PartitionSpec())
         self.batch_sharding = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
 
@@ -284,12 +323,17 @@ class InferenceEngine:
         and how much of the allocation the request actually used. Pure host
         math mirroring the compiled read geometry (decoding.read_stages),
         so tests assert it exactly and the CPU mesh can measure the
-        tight-read win with the TPU relay down."""
+        tight-read win with the TPU relay down. On a tensor-parallel mesh
+        the bytes are PER-CHIP — each chip streams only its head shard, so
+        kv_shard_width divides them out (that per-chip rate is what bounds
+        a bandwidth-limited decode step)."""
         if not self.telemetry.enabled:
             return None
         from deepspeed_tpu.inference.decoding import decode_kv_bytes
+        from deepspeed_tpu.parallel.partition import kv_shard_width
 
-        per_row = decode_kv_bytes(self.cfg, prompt_len, new_tokens, cache_len, floor)
+        per_row = decode_kv_bytes(self.cfg, prompt_len, new_tokens, cache_len,
+                                  floor, tp=kv_shard_width(self.mesh, self.cfg))
         decoded = max(new_tokens - 1, 0)
         alloc = alloc if alloc is not None else cache_len
         fields = {

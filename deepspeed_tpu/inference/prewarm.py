@@ -78,6 +78,12 @@ def main(argv=None):
     p.add_argument("--no-fused-prefill", action="store_true",
                    help="skip the fused-prefill tick variants (warm the "
                         "separate B=1 prefill + splice programs instead)")
+    p.add_argument("--mesh", default=None, metavar="DATA:TENSOR[,..]",
+                   help="serving mesh shape(s) to warm under, e.g. 1:2 or "
+                        "1:1,1:2 — the tick-program family is compiled PER "
+                        "tensor width (sharded programs are distinct "
+                        "executables), so warm every width the serve will "
+                        "run or the first sharded request pays the compile")
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA cache dir (defaults to jax config / "
                         "JAX_COMPILATION_CACHE_DIR)")
@@ -134,48 +140,76 @@ def main(argv=None):
     # one param init shared by every engine: a second engine would
     # re-initialize AND hold another full on-device copy (3x HBM at 7B)
     params = model.init(jax.random.PRNGKey(0))
-    eng = deepspeed_tpu.init_inference(model, params=params, config=dict(cfg))
-    tick(f"fused generate (B={args.batch}, S={args.prompt}, new={args.new})",
-         lambda: np.asarray(eng.generate(toks, max_new_tokens=args.new)))
 
-    if args.chunk:
-        eng_c = deepspeed_tpu.init_inference(
-            model, params=params, config=dict(cfg, prefill_chunk_size=args.chunk))
-        tick(f"chunked prefill (chunk={args.chunk}) + per-token decode",
-             lambda: np.asarray(eng_c.generate(toks, max_new_tokens=2)))
+    # each requested serving mesh compiles its OWN program family (a
+    # sharded executable is a different program — warming 1:1 does nothing
+    # for a 1:2 serve); None = the engine's default mesh
+    meshes = [None]
+    if args.mesh:
+        from deepspeed_tpu.parallel.partition import parse_mesh_arg
 
-    if args.continuous:
-        from deepspeed_tpu.inference import ContinuousBatchingEngine
+        meshes = [parse_mesh_arg(s) for s in args.mesh.split(",")]
 
-        serve = ContinuousBatchingEngine(
-            model, params=params, config=dict(cfg), max_slots=args.slots,
-            cache_len=args.cache_len, tokens_per_tick=args.burst,
-            pipeline_depth=args.pipeline_depth,
-            fused_prefill=not args.no_fused_prefill)
+    for shape in meshes:
+        mcfg = dict(cfg)
+        label = ""
+        if shape is not None:
+            mcfg["mesh"] = {"shape": shape}
+            label = (f", mesh={shape.get('data', 1)}:"
+                     f"{shape.get('tensor', 1)}")
+        eng = deepspeed_tpu.init_inference(model, params=params, config=dict(mcfg))
+        tick(f"fused generate (B={args.batch}, S={args.prompt}, "
+             f"new={args.new}{label})",
+             lambda: np.asarray(eng.generate(toks, max_new_tokens=args.new)))
 
-        def run_pool():
-            # drive a real request through: warms the admission programs
-            # (prefill/splice or the first chunk width) plus the tick
-            # read-buckets this prompt actually crosses
-            pool_new = min(args.new, 8)
-            plen = min(args.prompt, args.cache_len - pool_new)
-            assert plen >= 1, (
-                f"--cache-len {args.cache_len} leaves no room for a prompt "
-                f"(warming {pool_new} tokens)")
-            serve.submit(toks[0, :plen], max_new_tokens=pool_new)
-            while serve.has_work():
-                serve.step()
-            serve.finished()
+        if args.chunk:
+            eng_c = deepspeed_tpu.init_inference(
+                model, params=params,
+                config=dict(mcfg, prefill_chunk_size=args.chunk))
+            tick(f"chunked prefill (chunk={args.chunk}) + per-token decode"
+                 f"{label}",
+                 lambda: np.asarray(eng_c.generate(toks, max_new_tokens=2)))
+            del eng_c
 
-        tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
-             f"burst={args.burst})", run_pool)
-        # then the FULL tick-program family (bucket x read_len x {plain,
-        # burst, fused-prefill}): a live serve dispatches whichever variant
-        # its mix demands — every one missing cold-costs a remote compile
-        n_fns = serve.precompile_tick_programs(
-            progress=lambda msg: print(f"prewarm: {msg}", flush=True))
-        print(f"prewarm: tick-program family complete "
-              f"({n_fns} variants resident)", flush=True)
+        if args.continuous:
+            from deepspeed_tpu.inference import ContinuousBatchingEngine
+
+            serve = ContinuousBatchingEngine(
+                model, params=params, config=dict(mcfg), max_slots=args.slots,
+                cache_len=args.cache_len, tokens_per_tick=args.burst,
+                pipeline_depth=args.pipeline_depth,
+                fused_prefill=not args.no_fused_prefill)
+
+            def run_pool():
+                # drive a real request through: warms the admission programs
+                # (prefill/splice or the first chunk width) plus the tick
+                # read-buckets this prompt actually crosses
+                pool_new = min(args.new, 8)
+                plen = min(args.prompt, args.cache_len - pool_new)
+                assert plen >= 1, (
+                    f"--cache-len {args.cache_len} leaves no room for a prompt "
+                    f"(warming {pool_new} tokens)")
+                serve.submit(toks[0, :plen], max_new_tokens=pool_new)
+                while serve.has_work():
+                    serve.step()
+                serve.finished()
+
+            tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
+                 f"burst={args.burst}{label})", run_pool)
+            # then the FULL tick-program family (bucket x read_len x {plain,
+            # burst, fused-prefill}) under THIS mesh: a live serve dispatches
+            # whichever variant its mix demands — every one missing
+            # cold-costs a remote compile
+            n_fns = serve.precompile_tick_programs(
+                progress=lambda msg: print(f"prewarm: {msg}", flush=True))
+            print(f"prewarm: tick-program family complete "
+                  f"({n_fns} variants resident{label})", flush=True)
+            del serve
+        # drop this width's engines (and their on-device param placements
+        # + KV pools) before the next width builds its own — two resident
+        # placements is exactly the 3x-HBM-at-7B hazard the shared param
+        # init above exists to avoid
+        del eng
     print("prewarm: done — executables persisted to the XLA compile cache",
           flush=True)
     return 0

@@ -1185,19 +1185,27 @@ def cache_alloc_len(cache) -> int:
     return jax.tree.leaves(cache)[0].shape[2]
 
 
-def kv_read_bytes_per_row(cfg: TransformerConfig, read_len: int) -> int:
+def kv_read_bytes_per_row(cfg: TransformerConfig, read_len: int,
+                          tp: int = 1) -> int:
     """HBM bytes ONE sequence row's attention streams from the KV cache
     when a decode step attends ``read_len`` slots: K and V across all
     layers, int8 payload + fp32 per-token-per-head scales when
     ``kv_cache_dtype == "int8"``. This is the deterministic host-side
     accounting behind the ``kv_bytes_read`` telemetry field and the
     bench's roofline math — it counts exactly what the compiled read
-    touches, so tests can assert it."""
+    touches, so tests can assert it.
+
+    ``tp`` is the tensor width the cache's heads axis is ACTUALLY split
+    over (parallel.partition.kv_shard_width): each chip streams only its
+    head shard, so the PER-CHIP bytes — the quantity that bounds a
+    bandwidth-limited decode step — divide by it. Must divide kv_heads
+    (the caller resolves the replicated fallback to tp=1)."""
+    assert cfg.kv_heads % tp == 0, (cfg.kv_heads, tp)
     if cfg.kv_cache_dtype == "int8":
         per_slot = cfg.kv_heads * (cfg.head_dim * 1 + 4)  # q8 payload + s
     else:
         per_slot = cfg.kv_heads * cfg.head_dim * jnp.dtype(cfg.jnp_dtype).itemsize
-    return 2 * cfg.num_layers * read_len * per_slot
+    return 2 * cfg.num_layers * read_len * per_slot // tp
 
 
 def _layer_body_cached(x, layer_params, k_cache, v_cache, cfg: TransformerConfig, positions, pos,

@@ -1,0 +1,6 @@
+from deepspeed_tpu.module_inject.policies import (  # noqa: F401
+    config_from_hf,
+    convert_hf_model,
+    partition_rules,
+    policy_for,
+)

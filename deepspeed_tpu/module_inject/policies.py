@@ -31,6 +31,13 @@ class HFPolicy:
     """Base: subclass per architecture (reference policy ABC, policy.py)."""
 
     ARCHITECTURES: Tuple[str, ...] = ()
+    # family-specific regex partition-rule overrides, prepended to
+    # parallel/partition.DEFAULT_RULES by ``partition_rules`` (the AutoTP
+    # analogue: most families need nothing — conversion lands in the
+    # builtin naming the default table covers; divisibility fallbacks,
+    # e.g. multi-query kv heads on a wide tensor axis, are clipped
+    # per-weight at placement, not here)
+    tp_rules: Tuple = ()
 
     @classmethod
     def matches(cls, hf_config) -> bool:
@@ -964,6 +971,23 @@ def policy_for(hf_config) -> HFPolicy:
 
 def config_from_hf(hf_config) -> TransformerConfig:
     return policy_for(hf_config).config(hf_config)
+
+
+def partition_rules(hf_config=None):
+    """Regex partition-rule table for a converted model's param tree —
+    the inference-TP half of module_inject on a mesh backend (reference:
+    auto_tp.py's column/row split decisions). Every policy relayouts into
+    the builtin transformer naming, so the model-family defaults
+    (parallel/partition.DEFAULT_RULES: heads/mlp/vocab on ``tensor``)
+    serve all architectures; a policy with family-specific needs prepends
+    its ``tp_rules`` (first match wins). Pass the result — or your own
+    overrides — as ``InferenceConfig.mesh.rules``."""
+    from deepspeed_tpu.parallel.partition import DEFAULT_RULES
+
+    rules = ()
+    if hf_config is not None:
+        rules = tuple(policy_for(hf_config).tp_rules)
+    return rules + tuple(DEFAULT_RULES)
 
 
 def convert_hf_model(hf_model) -> Tuple[TransformerConfig, Dict]:

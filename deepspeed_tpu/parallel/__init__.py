@@ -1,0 +1,10 @@
+from deepspeed_tpu.parallel.partition import (  # noqa: F401
+    DEFAULT_RULES,
+    kv_shard_width,
+    match_partition_rules,
+    mesh_tensor_width,
+    parse_mesh_arg,
+    partition_params,
+    serving_mesh,
+    tree_path_names,
+)

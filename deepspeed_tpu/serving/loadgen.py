@@ -306,6 +306,69 @@ def format_summary(summary: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def format_mesh_ab(results: "Dict[str, dict]") -> str:
+    """Side-by-side sharded-vs-replicated comparison (``--mesh`` sweep /
+    ``--ab-mesh``): one scorecard per mesh width plus headline ratio lines
+    against the replicated (``1x1``) side — throughput and host-blocked
+    ms per token per tensor width, the MULTICHIP serving record."""
+    def _dims(key):  # numeric order: 1x2 before 1x16
+        d, _, t = key.partition("x")
+        try:
+            return (int(d), int(t))
+        except ValueError:
+            return (1 << 30, 0)
+
+    keys = sorted(results, key=lambda k: (k != "1x1", _dims(k)))
+    lines = []
+    for key in keys:
+        lines += [f"== mesh {key} ==", format_summary(results[key]).rstrip(), ""]
+    base = results.get("1x1")
+    if base is not None:
+        for key in keys:
+            if key == "1x1":
+                continue
+            cur = results[key]
+            t0, t1 = base.get("throughput_tok_s"), cur.get("throughput_tok_s")
+            if t0 and t1 is not None:
+                lines.append(f"throughput tok/s 1x1 -> {key}: {t0} -> {t1} "
+                             f"({t1 / t0:.2f}x)")
+            b0 = (base.get("host") or {}).get("block_ms_per_token")
+            b1 = (cur.get("host") or {}).get("block_ms_per_token")
+            if b0 is not None and b1 is not None:
+                lines.append(f"host-blocked ms/token 1x1 -> {key}: "
+                             f"{b0:.4f} -> {b1:.4f}")
+    return "\n".join(lines) + "\n"
+
+
+def mesh_record(results: "Dict[str, dict]", workload_args: dict) -> dict:
+    """MULTICHIP_*-style JSON serving record for a mesh sweep: per-width
+    throughput + host-blocked ms/token plus the winning width, in a shape
+    the on-chip bench can read back to self-tune its tensor width."""
+    import jax
+
+    per_width = {
+        key: {
+            "throughput_tok_s": s.get("throughput_tok_s"),
+            "goodput_tok_s": s.get("goodput_tok_s"),
+            "block_ms_per_token": (s.get("host") or {}).get("block_ms_per_token"),
+            "overlap_frac": (s.get("host") or {}).get("overlap_frac"),
+            "shed_rate": s.get("shed_rate"),
+            "ttft_ms": s.get("ttft_ms"),
+        }
+        for key, s in results.items()
+    }
+    winner = max(results, key=lambda k: results[k].get("throughput_tok_s") or 0.0)
+    return {
+        "kind": "serving_mesh_ab",
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "meshes": per_width,
+        "winner": winner,
+        "workload": workload_args,
+        "summaries": results,
+    }
+
+
 def format_ab(sync: dict, pipelined: dict) -> str:
     """Side-by-side sync-vs-pipelined comparison (``--pipeline-depth`` A/B):
     the two scorecards plus the headline ratios — host-blocked ms per
@@ -387,6 +450,23 @@ def main(argv=None) -> int:
                    help="run the SAME workload twice — sync (depth 0) vs "
                         "--pipeline-depth — and report both scorecards "
                         "plus the host-blocked-ms/token ratio")
+    p.add_argument("--mesh", default=None, metavar="DATA:TENSOR[,..]",
+                   help="serving mesh shape(s), e.g. 1:2 — tensor widths "
+                        "shard attention heads / MLP hidden / vocab and "
+                        "the KV cache's heads axis over that many devices "
+                        "(docs/inference.md 'Tensor-parallel serving'). A "
+                        "comma list sweeps widths over the same workload. "
+                        "On the CPU virtual mesh combine with --no-donate "
+                        "(donation serializes dispatch there)")
+    p.add_argument("--ab-mesh", action="store_true",
+                   help="sharded-vs-replicated A/B: run the SAME workload "
+                        "on the replicated 1:1 mesh AND every --mesh "
+                        "width, report per-width scorecards + throughput/"
+                        "host-blocked ratios")
+    p.add_argument("--mesh-out", default=None, metavar="FILE",
+                   help="write the mesh sweep as a MULTICHIP_*-style JSON "
+                        "serving record (per-width throughput + "
+                        "host-blocked ms/token + winner)")
     p.add_argument("--policy", default="fifo",
                    choices=("fifo", "priority", "edf", "fair"))
     p.add_argument("--queue-depth", type=int, default=64)
@@ -439,8 +519,10 @@ def main(argv=None) -> int:
         model = TransformerModel.from_preset(args.preset, dtype=args.dtype)
     params = model.init(jax.random.PRNGKey(args.seed))
 
-    def build_serving(depth: int, trace_out=None):
+    def build_serving(depth: int, trace_out=None, mesh_shape=None):
         cfg = {"dtype": args.dtype}
+        if mesh_shape:
+            cfg["mesh"] = {"shape": mesh_shape}
         if trace_out:
             cfg["telemetry"] = {"enabled": True, "trace_file": trace_out}
         engine_kwargs = {}
@@ -461,21 +543,78 @@ def main(argv=None) -> int:
                              kv_budget_tokens=args.kv_budget,
                              aging_s=args.aging_s)
 
-    def one_run(depth: int, trace_out=None):
-        serving = build_serving(depth, trace_out=trace_out)
+    def one_run(depth: int, trace_out=None, mesh_shape=None):
+        serving = build_serving(depth, trace_out=trace_out,
+                                mesh_shape=mesh_shape)
         records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
         summary = summarize(records, wall_s, tick_stats=serving.tick_stats())
+        if mesh_shape:
+            summary["mesh"] = dict(mesh_shape)
         if trace_out:
             serving.close()
         return summary
+
+    meshes = []
+    if args.mesh:
+        from deepspeed_tpu.parallel.partition import parse_mesh_arg
+
+        meshes = [parse_mesh_arg(s) for s in args.mesh.split(",")]
+    if args.mesh_out and not meshes:
+        # without --mesh the serve runs the engine's DEFAULT mesh; a
+        # record labelled by an assumed shape would mislead the bench
+        # that reads it back
+        p.error("--mesh-out needs --mesh (the record is keyed by the "
+                "explicit serving mesh shape)")
+    if args.mesh_out and args.ab_pipeline:
+        p.error("--mesh-out records a per-width mesh sweep; it does not "
+                "combine with the depth A/B (--ab-pipeline) — run them "
+                "separately")
+
+    def write_mesh_record(results):
+        record = mesh_record(results, {
+            "requests": len(workload), "rate": args.rate,
+            "process": args.process, "pipeline_depth": args.pipeline_depth,
+            "donate": not args.no_donate, "slots": args.slots,
+            "cache_len": args.cache_len, "preset": args.preset})
+        with open(args.mesh_out, "w") as fh:
+            json.dump(record, fh, indent=2, sort_keys=True)
+        print(f"mesh record written to {args.mesh_out}")
+    if args.ab_mesh or len(meshes) > 1:
+        if args.ab_pipeline:
+            p.error("--ab-pipeline does not combine with --ab-mesh / a "
+                    "multi-width --mesh sweep (the sweep runs one depth "
+                    "per width); run the depth A/B per width separately")
+        widths = [m for m in meshes
+                  if (m.get("data", 1), m.get("tensor", 1)) != (1, 1)]
+        if not widths:
+            p.error("--ab-mesh needs at least one non-1x1 --mesh width "
+                    "to compare against the replicated baseline, e.g. "
+                    "--mesh 1:2")
+        # sharded-vs-replicated sweep: the replicated 1x1 mesh is always
+        # the baseline side, each width replays the SAME workload
+        sweep = [{"data": 1, "tensor": 1}] + widths
+        results = {}
+        for shape in sweep:
+            key = f"{shape.get('data', 1)}x{shape.get('tensor', 1)}"
+            trace = (f"{args.trace_out}.{key}.jsonl" if args.trace_out else None)
+            results[key] = one_run(args.pipeline_depth, trace_out=trace,
+                                   mesh_shape=shape)
+        if args.mesh_out:
+            write_mesh_record(results)
+        if args.as_json:
+            print(json.dumps(results, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_mesh_ab(results))
+        return 0
+    mesh_shape = meshes[0] if meshes else None
 
     if args.ab_pipeline:
         # BOTH sides must pay identical telemetry overhead or the A/B is
         # biased — with --trace-out the sync run writes a sibling trace
         sync_trace = args.trace_out + ".sync.jsonl" if args.trace_out else None
-        sync = one_run(0, trace_out=sync_trace)
+        sync = one_run(0, trace_out=sync_trace, mesh_shape=mesh_shape)
         pipelined = one_run(max(args.pipeline_depth, 1),
-                            trace_out=args.trace_out)
+                            trace_out=args.trace_out, mesh_shape=mesh_shape)
         if sync_trace:
             print(f"sync-side trace written to {sync_trace}")
         if args.as_json:
@@ -484,7 +623,11 @@ def main(argv=None) -> int:
         else:
             sys.stdout.write(format_ab(sync, pipelined))
     else:
-        summary = one_run(args.pipeline_depth, trace_out=args.trace_out)
+        summary = one_run(args.pipeline_depth, trace_out=args.trace_out,
+                          mesh_shape=mesh_shape)
+        if args.mesh_out:  # mesh_shape is set (--mesh-out requires --mesh)
+            key = f"{mesh_shape.get('data', 1)}x{mesh_shape.get('tensor', 1)}"
+            write_mesh_record({key: summary})
         if args.as_json:
             print(json.dumps(summary, indent=2, sort_keys=True))
         else:

@@ -1,0 +1,39 @@
+"""Fixture: mesh axes learned from InferenceConfig.mesh declarations.
+Expected findings (line): 27 'tnesor' typo — 'data'/'tensor'/'expert'
+are declared via the serving-mesh config forms below and must NOT flag;
+the bare data-record dict at the bottom declares NOTHING."""
+from jax.sharding import PartitionSpec as P
+
+
+def serve(init_inference, model):
+    # config-dict CALL ARGUMENT: the nested {"shape": {...}} mesh block
+    # declares axes (InferenceConfig.mesh serving block)
+    return init_inference(model, config={
+        "dtype": "bfloat16", "mesh": {"shape": {"data": 1, "tensor": 2}}})
+
+
+def build(engine_cls, model):
+    # flat mesh= kwarg dict also declares its keys as axes
+    return engine_cls(model, mesh={"expert": 2})
+
+
+def block(MeshConfig):
+    return MeshConfig(shape={"data": 2, "tensor": 4})
+
+
+good = P("data", "tensor")
+also_good = P("expert")
+
+typo = P("tnesor")
+
+# a bare {"mesh": ...} assignment is a DATA RECORD (telemetry / bench
+# extra), not a declaration — its keys must not become axes (if this
+# counted, 'bogus' would be declared and typo hunting would degrade)
+record = {"mesh": {"bogus": 1}}
+
+
+def rules_only(init_inference, model):
+    # a rules-only mesh block declares NO axes (its keys are MeshConfig
+    # field names, not axis names — they must not leak into 'declared')
+    return init_inference(model, config={
+        "mesh": {"rules": [["attn/", []]], "use_rules": True}})

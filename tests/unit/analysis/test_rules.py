@@ -59,6 +59,23 @@ def test_partition_spec_axis():
     assert "'tensor'" in by_line[17].message
 
 
+def test_partition_spec_axis_learns_inference_mesh_config():
+    """Axes declared through InferenceConfig.mesh forms — the nested
+    {"mesh": {"shape": {...}}} config dict passed as a call argument, a
+    flat mesh= kwarg dict, and MeshConfig(shape={...}) — all count as
+    declared; only the typo flags. The block's own field names never
+    become axes, and a bare {"mesh": ...} data-record assignment
+    declares nothing, and a rules-only mesh block leaks no field names."""
+    result = findings_for("partition_spec_mesh_config.py",
+                          "partition-spec-axis")
+    assert lines(result, "partition-spec-axis") == [27]
+    (f,) = result.findings
+    assert "'tnesor'" in f.message
+    declared = f.message.split("(")[-1]
+    assert "shape" not in declared and "bogus" not in declared
+    assert "rules" not in declared  # rules-only block: field names aren't axes
+
+
 def test_donated_buffer_reuse():
     result = findings_for("donated_buffer_reuse.py", "donated-buffer-reuse")
     assert lines(result, "donated-buffer-reuse") == [16]

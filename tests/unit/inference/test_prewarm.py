@@ -55,6 +55,21 @@ def test_prewarm_fused_only(tmp_path, restore_jax_cache_config):
     assert os.path.isdir(cache) and os.listdir(cache)
 
 
+def test_prewarm_mesh_widths(tmp_path, restore_jax_cache_config):
+    """--mesh 1:1,1:2 warms the program set once PER tensor width (a
+    sharded executable is a distinct program — warming 1:1 does nothing
+    for a 1:2 serve); both passes land in the same cache dir."""
+    from deepspeed_tpu.inference.prewarm import main
+
+    comm.destroy()
+    cache = str(tmp_path / "xla_cache")
+    rc = main(["--batch", "1", "--prompt", "8", "--new", "2",
+               "--dtype", "float32", "--mesh", "1:1,1:2",
+               "--cache-dir", cache, *TINY])
+    assert rc == 0
+    assert os.path.isdir(cache) and os.listdir(cache)
+
+
 @pytest.mark.slow  # full serving program set (chunked + continuous pool)
 def test_prewarm_full_set_persists(tmp_path, restore_jax_cache_config):
     from deepspeed_tpu.inference.prewarm import main

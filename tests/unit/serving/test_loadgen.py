@@ -141,6 +141,32 @@ class TestSummarize:
         assert "2.50x less blocking" in ab
         assert "throughput tok/s" in ab
 
+    def test_mesh_ab_format_and_record(self):
+        """The --mesh sweep surfaces: per-width scorecards with ratio
+        lines against the replicated 1x1 side, and the MULTICHIP-style
+        JSON record carrying per-width throughput + host-blocked
+        ms/token plus the winning width."""
+        def summ(tok_s, blocked):
+            return {"requests": 4, "outcomes": {"finished": 4},
+                    "wall_s": 1.0, "offered_rps": 4.0, "shed_rate": 0.0,
+                    "throughput_tok_s": tok_s, "goodput_tok_s": tok_s,
+                    "host": {"pipeline_depth": 1, "ticks": 8,
+                             "tick_dispatch_ms_mean": 1.0,
+                             "tick_block_ms_mean": 0.5, "overlap_frac": 0.5,
+                             "block_ms_per_token": blocked,
+                             "wasted_tokens": 0}}
+
+        results = {"1x1": summ(100.0, 0.04), "1x2": summ(150.0, 0.02)}
+        text = loadgen.format_mesh_ab(results)
+        assert "== mesh 1x1 ==" in text and "== mesh 1x2 ==" in text
+        assert "1.50x" in text
+        assert "0.0400 -> 0.0200" in text
+        rec = loadgen.mesh_record(results, {"requests": 4})
+        assert rec["kind"] == "serving_mesh_ab"
+        assert rec["winner"] == "1x2"
+        assert rec["meshes"]["1x2"]["throughput_tok_s"] == 150.0
+        assert rec["meshes"]["1x1"]["block_ms_per_token"] == 0.04
+
 
 @pytest.fixture(scope="module")
 def setup():
@@ -209,6 +235,27 @@ class TestRunLoad:
             assert [r.get("tokens") for r in records] == [4, 4]
             streams.append([r["generated"] for r in records])
         assert streams[0] == streams[1]
+
+    def test_cli_mesh_ab_runs_green(self, setup, tmp_path, capsys):
+        """ds_loadgen --mesh 1:2 --ab-mesh on the virtual mesh (donation
+        off per the CPU-backend caveat): both widths serve the same
+        workload and the MULTICHIP-style record lands with per-width
+        throughput + host-blocked ms/token."""
+        out_file = tmp_path / "mesh.json"
+        rc = loadgen.main([
+            "--requests", "6", "--rate", "500", "--slots", "2",
+            "--cache-len", "64", "--prompt-range", "3:6",
+            "--new-range", "3:5", "--mesh", "1:2", "--ab-mesh",
+            "--no-donate", "--mesh-out", str(out_file)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "== mesh 1x1 ==" in text and "== mesh 1x2 ==" in text
+        rec = json.loads(out_file.read_text())
+        assert set(rec["meshes"]) == {"1x1", "1x2"}
+        for width in rec["meshes"].values():
+            assert width["throughput_tok_s"] > 0
+            assert width["block_ms_per_token"] is not None
+        assert rec["summaries"]["1x2"]["mesh"] == {"data": 1, "tensor": 2}
 
     def test_mismatched_lengths_rejected(self, setup):
         _, srv = _serving(setup)
