@@ -113,9 +113,15 @@ class _Request:
     # snapshot of the registered prefix entry (tokens/cache/bucket), taken
     # at submit time so unregister_prefix cannot strand a queued request
     prefix: Optional[dict] = None
-    # device-side emission quota (== max_new_tokens; placement guarantees
-    # the pool row holds prompt + quota)
+    # device-side emission quota (gen_base + max_new_tokens; placement
+    # guarantees the pool row holds prompt + max_new_tokens)
     quota: int = 0
+    # recovery resume: the device ``gen`` counter starts here instead of
+    # 0, so the per-token RNG keys fold_in(fold_in(base, rid), gen)
+    # continue the original stream — a request re-admitted after engine
+    # loss with prompt = original + emitted and gen_base = len(emitted)
+    # draws its next token with the exact key the lost engine would have
+    gen_base: int = 0
     # fused prefill: remaining (tokens, pos0, n_real, emits) prompt chunks
     # still to ride a tick; None/empty = decode-active
     chunks: Optional[List[tuple]] = None
@@ -203,7 +209,8 @@ class ContinuousBatchingEngine:
                  tokens_per_tick: int = 1, pipeline_depth: int = 1,
                  fused_prefill: bool = True,
                  prefill_chunk: Optional[int] = None,
-                 donate_cache: bool = True):
+                 donate_cache: bool = True,
+                 fetch_timeout_s: Optional[float] = None):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         self._eng = InferenceEngine(model, config=config, params=params,
@@ -284,6 +291,23 @@ class ContinuousBatchingEngine:
         # emitted (deepspeed_tpu/serving adds queue_ms/priority/deadline_met
         # and retags path:"serving"). None = emit the event as built.
         self.request_event_hook: Optional[Callable[[int, dict], Optional[dict]]] = None
+        # fault-injection hook (serving/faults.py FaultInjector): called
+        # with (point, info) at "dispatch" (top of step, BEFORE any state
+        # mutates), "retire" (before each packed-result fetch) and
+        # "set_row" (admission row flip). The hook may raise — that IS the
+        # injection; no monkeypatching. None = no injection.
+        self.fault_hook: Optional[Callable[[str, dict], None]] = None
+        # watchdog: a packed-result fetch in _retire exceeding this many
+        # seconds raises TimeoutError (on TPU a preempted device surfaces
+        # as a stuck/erroring fetch; detection is post-hoc — the fetch
+        # itself cannot be interrupted from this thread). None = off.
+        self.fetch_timeout_s = fetch_timeout_s
+        # True once an exception escaped mid-tick: device-threaded state,
+        # dispatch mirrors and in-flight results can no longer be trusted
+        # to agree, so the serving layer must NOT retry step() — it
+        # rebuilds instead (bitwise-safe: see docs/serving.md recovery)
+        self.poisoned = False
+        self._tick_index = 0  # step() calls attempted (fault-plan clock)
 
     # -- single-pool compatibility surface (tests, introspection) --------
     @property
@@ -332,11 +356,30 @@ class ContinuousBatchingEngine:
             )
         return prompt
 
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> int:
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               rid: Optional[int] = None, gen_base: int = 0) -> int:
+        """Queue a request. ``rid``/``gen_base`` are the RESUME surface
+        (serving-layer recovery): an explicit ``rid`` preserves a lost
+        request's RNG identity on a rebuilt engine, and ``gen_base``
+        offsets the device generation counter so the per-token keys
+        continue the original stream — submit ``prompt + emitted`` with
+        ``gen_base=len(emitted)`` and the request picks up mid-stream
+        bitwise-identically."""
         prompt = self.validate_request(prompt_ids, max_new_tokens)
-        rid = self._next_rid
-        self._next_rid += 1
-        self._pending.append(_Request(rid, prompt, max_new_tokens))
+        if gen_base < 0:
+            raise ValueError("gen_base must be >= 0")
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            if (any(r.rid == rid for r in self._pending)
+                    or rid in self._results
+                    or any(r.rid == rid for p in self._pools
+                           for r in p.active.values())):
+                raise ValueError(f"explicit rid {rid} is already in use")
+            self._next_rid = max(self._next_rid, rid + 1)
+        self._pending.append(_Request(rid, prompt, max_new_tokens,
+                                      gen_base=gen_base))
         return rid
 
     def register_prefix(self, prefix_ids) -> int:
@@ -490,6 +533,18 @@ class ContinuousBatchingEngine:
         out, self._results = self._results, {}
         return out
 
+    def abort_inflight(self) -> int:
+        """Drop every dispatched-but-unretired tick WITHOUT fetching:
+        the engine-loss path (serving recovery) counts the discarded
+        ticks and abandons this engine — the tokens those ticks computed
+        are regenerated bitwise by the resume RNG design, never fetched
+        from a device that may be gone. Returns the number of ticks
+        discarded. The engine stays ``poisoned``-marked territory: only
+        call this when the engine is being abandoned."""
+        lost = len(self._inflight)
+        self._inflight.clear()
+        return lost
+
     def tick_stats(self) -> dict:
         """Host-overhead accounting for the tick loop: dispatch vs blocked
         milliseconds, tokens emitted / wasted past done flags, pipeline
@@ -533,7 +588,25 @@ class ContinuousBatchingEngine:
         ``pipeline_depth > 0`` a request's tokens surface up to that many
         steps after the tick that computed them; concatenating the lists
         across steps reproduces the generated stream exactly. Finished
-        requests move to ``finished()``/``result()``."""
+        requests move to ``finished()``/``result()``.
+
+        Fault surface: the ``dispatch`` fault hook fires FIRST, before
+        any state mutates — an exception there leaves the engine fully
+        consistent (``poisoned`` stays False, the caller may simply call
+        ``step()`` again). Any exception past that point — injected or
+        real, including the ``_retire`` fetch watchdog — sets
+        ``poisoned``: in-flight results may be lost and the serving
+        layer must rebuild rather than retry."""
+        if self.fault_hook is not None:
+            self.fault_hook("dispatch", {"tick": self._tick_index})
+        self._tick_index += 1
+        try:
+            return self._step_body()
+        except BaseException:
+            self.poisoned = True
+            raise
+
+    def _step_body(self) -> Dict[int, List[int]]:
         emitted: Dict[int, List[int]] = {}
         t0 = time.perf_counter()
         # FIFO with skip: a request that only fits the (full) long pool
@@ -691,6 +764,10 @@ class ContinuousBatchingEngine:
                 emit_col[aslot] = nreal - 1
                 emit_mask[aslot] = 1
                 quota[aslot] = admit.quota
+                # resume support: the sampled first token's RNG key is
+                # fold_in(rid, gen) — gen_base continues a recovered
+                # request's stream at its next token index
+                gen[aslot] = admit.gen_base
                 rids[aslot] = admit.rid
                 live[aslot] = admit
             packed, pool.cache, pool.last_tok_dev, pool.done_dev = fn(
@@ -704,7 +781,7 @@ class ContinuousBatchingEngine:
                 pool.prefill_q.popleft()
                 admit.chunks = None
                 pool.disp_pos[aslot] = cpos0 + nreal  # full prompt cached
-                pool.disp_gen[aslot] = 1              # the emitted first token
+                pool.disp_gen[aslot] = admit.gen_base + 1  # the emitted first token
             rec = _TickRecord(packed, live, 1,
                               self._row_read_bytes(pool, read_len), True)
             advance = 1
@@ -738,9 +815,23 @@ class ContinuousBatchingEngine:
         stats = self._tick_stats
         for pi, rec in recs.items():
             pool = self._pools[pi]
+            if self.fault_hook is not None:
+                self.fault_hook("retire", {"tick": self._tick_index,
+                                           "pool": pi})
             t0 = time.perf_counter()
             arr = np.asarray(rec.packed)  # the single device get per tick
-            block_ms += (time.perf_counter() - t0) * 1000.0
+            dt = time.perf_counter() - t0
+            if self.fetch_timeout_s is not None and dt > self.fetch_timeout_s:
+                # post-hoc watchdog: the fetch DID return, but far past
+                # budget — on a preempted/unhealthy device the next one
+                # may not. Poison (via step()'s wrapper) and let the
+                # serving layer rebuild; the unattributed tokens are
+                # regenerated bitwise on resume.
+                raise TimeoutError(
+                    f"tick result fetch took {dt:.3f}s "
+                    f"(> fetch_timeout_s={self.fetch_timeout_s}) — device "
+                    f"unhealthy, tick pipeline abandoned")
+            block_ms += dt * 1000.0
             k = rec.k
             for slot, req in rec.live.items():
                 if pool.active.get(slot) is not req:
@@ -825,6 +916,9 @@ class ContinuousBatchingEngine:
     def _set_row(self, pool: _Pool, slot: int, tok: int, flag: int):
         """Admission-time update of one row of the device-threaded tick
         state — dispatched against the current futures, never fetched."""
+        if self.fault_hook is not None:
+            self.fault_hook("set_row", {"tick": self._tick_index,
+                                        "slot": slot})
         pool.last_tok_dev, pool.done_dev = pool.set_row_fn(
             pool.last_tok_dev, pool.done_dev, slot, tok, flag)
 
@@ -839,9 +933,11 @@ class ContinuousBatchingEngine:
 
         pool = self._pools[pi]
         req.slot, req.pool = slot, pi
-        # placement guarantees prompt + max_new_tokens fits the pool row,
-        # so the device emission quota is exactly max_new_tokens
-        req.quota = req.max_new_tokens
+        # placement guarantees prompt + max_new_tokens fits the pool row;
+        # the device stops at gen >= quota, and gen starts at gen_base
+        # (0 for fresh requests, len(emitted) for recovery resumes) so
+        # the emission budget is exactly max_new_tokens either way
+        req.quota = req.gen_base + req.max_new_tokens
         pool.active[slot] = req
         start = 0
         toks = req.prompt
@@ -895,7 +991,7 @@ class ContinuousBatchingEngine:
         # and samples the first generated token from the resulting logits
         self._set_row(pool, slot, int(toks[-1]), 0)
         pool.disp_pos[slot] = start + m - 1
-        pool.disp_gen[slot] = 0
+        pool.disp_gen[slot] = req.gen_base
 
     def precompile_tick_programs(self, progress: Optional[Callable] = None) -> int:
         """Compile (and block on) the FULL tick-program family — every

@@ -1,10 +1,21 @@
 """SLO-aware request serving over continuous batching: admission control
 with explicit backpressure, pluggable scheduler policies (FIFO /
 priority / EDF / fair share) with anti-starvation aging, request
-lifecycle (cancel, stream, deadline shedding), and the load-test harness
-behind ``tools/ds_loadgen.py``. See docs/serving.md."""
+lifecycle (cancel, stream, deadline shedding), fault injection +
+preemption-safe recovery (serving/faults.py, serving/recovery.py), and
+the load-test harness behind ``tools/ds_loadgen.py``. See
+docs/serving.md."""
 
 from deepspeed_tpu.serving.engine import ServingEngine, TokenStream
+from deepspeed_tpu.serving.faults import (
+    EnginePreempted,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    FetchHang,
+    InjectedFault,
+    TickDispatchError,
+)
 from deepspeed_tpu.serving.policies import (
     EdfPolicy,
     FairSharePolicy,
@@ -12,6 +23,11 @@ from deepspeed_tpu.serving.policies import (
     PriorityPolicy,
     SchedulerPolicy,
     resolve_policy,
+)
+from deepspeed_tpu.serving.recovery import (
+    RecoveryConfig,
+    RecoveryFailed,
+    RecoveryLog,
 )
 from deepspeed_tpu.serving.request import (
     ADMITTED,
@@ -32,6 +48,9 @@ __all__ = [
     "SchedulerPolicy", "FifoPolicy", "PriorityPolicy", "EdfPolicy",
     "FairSharePolicy", "resolve_policy",
     "Admission", "ServeRequest",
+    "Fault", "FaultPlan", "FaultInjector",
+    "InjectedFault", "TickDispatchError", "FetchHang", "EnginePreempted",
+    "RecoveryConfig", "RecoveryFailed", "RecoveryLog",
     "ADMITTED", "QUEUED_STATUS", "SHED",
     "QUEUED", "RUNNING", "FINISHED", "CANCELLED", "EXPIRED",
     "TERMINAL_STATES",

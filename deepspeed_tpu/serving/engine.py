@@ -23,6 +23,18 @@ touching the hot path:
   scheduling/admission work; ``pipeline_depth=0`` restores the fully
   synchronous loop, token streams bitwise identical). ``tick_stats()``
   reports the dispatch/block/overlap accounting.
+- **Fault tolerance** (armed by ``engine_factory=``/``recovery=``; see
+  docs/serving.md "Fault tolerance"): a failed engine tick enters an
+  escalation ladder — bounded retry-with-backoff for clean (pre-mutation)
+  failures, then engine rebuild with every running request re-admitted
+  mid-stream (``prompt + emitted``, same engine rid,
+  ``gen_base=len(emitted)``) so recovered token streams are BITWISE
+  identical to the fault-free run; rebuilds optionally degrade to
+  smaller ``degrade_mesh_shapes`` when capacity was lost. While the
+  circuit breaker is open, new admissions shed with reason
+  ``"recovering"`` and an honest ``retry_after_s``; requests recovery
+  cannot re-admit terminate ``shed`` — never a silent drop. Terminal
+  failure (every level exhausted) raises :class:`RecoveryFailed`.
 - **Telemetry**: every lifecycle transition counts
   (``serve_admitted/shed/expired/cancelled/finished_total``,
   ``serve_deadline_met/missed_total``, ``serve_queue_depth`` /
@@ -46,9 +58,17 @@ tests exact.
 """
 
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
+from deepspeed_tpu.serving.faults import EnginePreempted
 from deepspeed_tpu.serving.policies import SchedulerPolicy, resolve_policy
+from deepspeed_tpu.serving.recovery import (
+    RecoveryConfig,
+    RecoveryFailed,
+    RecoveryLog,
+)
 from deepspeed_tpu.serving.request import (
     ADMITTED,
     CANCELLED,
@@ -62,6 +82,7 @@ from deepspeed_tpu.serving.request import (
     Admission,
     ServeRequest,
 )
+from deepspeed_tpu.utils.logging import logger
 
 
 class TokenStream:
@@ -85,9 +106,21 @@ class TokenStream:
 
     def __next__(self) -> int:
         while self._i >= len(self._request.tokens):
-            if self._request.state in TERMINAL_STATES:
+            req = self._request
+            if req.state in TERMINAL_STATES:
                 raise StopIteration
             if not self._serving.has_work():
+                raise StopIteration
+            if not self._serving._tracks(req):
+                # orphaned: the request claims to be live but the serving
+                # layer no longer holds it anywhere work could reach it
+                # (e.g. someone cancelled its engine rid directly) —
+                # stepping an engine that will never emit for this rid
+                # again would spin forever. Terminate with the full lost-
+                # request bookkeeping (counters, serving_event, recovery-
+                # log retirement), never a silent state flip.
+                self._serving._mark_lost(req, "orphaned mid-stream: the "
+                                              "engine no longer tracks it")
                 raise StopIteration
             self._serving.step()
         tok = self._request.tokens[self._i]
@@ -104,7 +137,10 @@ class ServingEngine:
     def __init__(self, engine, policy="fifo", max_queue_depth: int = 64,
                  kv_budget_tokens: Optional[int] = None,
                  aging_s: float = 30.0, clock=time.monotonic,
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 engine_factory: Optional[Callable] = None,
+                 degrade_mesh_shapes: Optional[List[dict]] = None,
+                 recovery=None, sleep=time.sleep):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
         if aging_s <= 0:
@@ -117,6 +153,49 @@ class ServingEngine:
             # (default: 1 tick in flight — docs/serving.md "Tick pipeline")
             engine.pipeline_depth = pipeline_depth
         self._cb = engine
+        # -- fault tolerance (docs/serving.md "Fault tolerance") --------
+        # Recovery is armed when a rebuild factory or an explicit
+        # RecoveryConfig is given; otherwise tick exceptions propagate
+        # raw, exactly as before this layer existed.
+        #   engine_factory(mesh_shape=None) -> ContinuousBatchingEngine
+        # builds a replacement engine after a preemption/poisoned tick
+        # (build with telemetry OFF: the serving layer re-injects its own
+        # hub so counters and the trace file stay continuous);
+        # degrade_mesh_shapes lists successively smaller mesh shapes to
+        # fall back to when the full-size rebuild fails or a preemption
+        # took capacity with it (graceful degradation).
+        self.engine_factory = engine_factory
+        self.degrade_mesh_shapes = list(degrade_mesh_shapes or [])
+        self.recovery_cfg = RecoveryConfig.parse(recovery)
+        self._recovery_enabled = (engine_factory is not None
+                                  or recovery is not None)
+        if self.recovery_cfg.fetch_timeout_s is not None:
+            engine.fetch_timeout_s = self.recovery_cfg.fetch_timeout_s
+        self._pipeline_depth = pipeline_depth
+        self._sleep = sleep
+        self._recovery_log = RecoveryLog()
+        # highest engine rid ever assigned (+1): a rebuilt engine's rid
+        # counter resumes here, so a new request after a recovery gets
+        # the same engine rid — hence the same per-request RNG stream —
+        # it would have gotten in the fault-free run
+        self._rid_watermark = 0
+        self._breaker_open = False
+        self._outage_start: Optional[float] = None
+        self._consecutive_failures = 0
+        self._fault_count = 0
+        self._retry_count = 0
+        self._rebuild_count = 0
+        self._lost_ticks = 0
+        self._lost_requests = 0
+        self._degrade_level = 0          # 0 = full mesh, i = degrade_mesh_shapes[i-1]
+        self._recovery_ms: List[float] = []
+        self._outage_ms_total = 0.0
+        self._closed = False
+        # serving-level prefix registry: stable ids that survive engine
+        # rebuilds (tokens kept host-side, re-registered on the new engine)
+        self._prefixes: Dict[int, np.ndarray] = {}
+        self._prefix_pids: Dict[int, int] = {}   # serving pid -> engine pid
+        self._next_prefix_id = 0
         self.policy: SchedulerPolicy = resolve_policy(policy, aging_s=aging_s)
         self.max_queue_depth = max_queue_depth
         # KV token budget: total prompt+output tokens committed across
@@ -147,12 +226,26 @@ class ServingEngine:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                priority: int = 0, tenant: str = "default",
                deadline_ms: Optional[float] = None,
-               on_token=None) -> Admission:
+               on_token=None, prefix_id: Optional[int] = None) -> Admission:
         """Admission-controlled submit. Malformed arguments raise
         ValueError (an oversized request can NEVER run — that is an
         error, not load); a well-formed one is admitted, queued, or shed
         with explicit backpressure. Shed requests get no id and leave no
-        state behind."""
+        state behind. With ``prefix_id`` (``register_prefix``),
+        ``prompt_ids`` is the per-request SUFFIX; admission splices the
+        registered prefix KV and only the suffix is prefilled. While the
+        circuit breaker is open (engine lost, recovery in progress) new
+        work is shed with reason ``"recovering"`` and an honest
+        ``retry_after_s`` covering the expected outage."""
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix id {prefix_id}: never "
+                               f"registered with this serving engine")
+            suffix = np.asarray(prompt_ids, np.int32).reshape(-1)
+            if suffix.size == 0:
+                raise ValueError("empty suffix (use submit without "
+                                 "prefix_id for prefix-only prompts)")
+            prompt_ids = np.concatenate([self._prefixes[prefix_id], suffix])
         prompt = self._cb.validate_request(prompt_ids, max_new_tokens)
         need = int(prompt.size) + max_new_tokens
         if need > self.kv_budget_tokens:
@@ -165,6 +258,11 @@ class ServingEngine:
         now = self._clock()
         if self._t_start is None:
             self._t_start = now
+        if self._breaker_open:
+            # honest degradation: during an outage admission answers
+            # immediately with a load-shed verdict + recovery ETA rather
+            # than queueing work behind an engine that may never return
+            return self._shed("recovering", prompt, need, now)
         if len(self._queue) >= self.max_queue_depth:
             return self._shed("queue_full", prompt, need, now)
         committed = self.committed_tokens()
@@ -176,7 +274,8 @@ class ServingEngine:
         req = ServeRequest(rid=rid, prompt=prompt,
                            max_new_tokens=max_new_tokens, priority=priority,
                            tenant=tenant, deadline_ms=deadline_ms,
-                           on_token=on_token, submit_t=now)
+                           on_token=on_token, submit_t=now,
+                           prefix_id=prefix_id)
         self._requests[rid] = req
         # empty queue + a fitting free slot: hand straight to the engine —
         # the strongest statement submit can truthfully make (with a
@@ -200,12 +299,15 @@ class ServingEngine:
         self._schedule(now)
         out: Dict[int, List[int]] = {}
         if self._cb.has_work():
-            emitted = self._cb.step()
-            # the engine admits every placeable pending request at the top
-            # of its tick, and we only hand over what fits — so after the
-            # tick the staged reservations are real slots (pool_state now
-            # counts them) or already finished-and-freed
-            self._staged.clear()
+            emitted, ticked = self._guarded_tick()
+            if ticked:
+                # the engine admits every placeable pending request at the
+                # top of its tick, and we only hand over what fits — so
+                # after the tick the staged reservations are real slots
+                # (pool_state now counts them) or already finished-and-
+                # freed. A recovered (re-admitted) tick keeps its staged
+                # reservations: the rebuilt engine has not ticked yet.
+                self._staged.clear()
             tnow = self._clock()
             for erid, toks in emitted.items():
                 req = self._running.get(erid)
@@ -214,6 +316,7 @@ class ServingEngine:
                 if req.first_token_t is None and toks:
                     req.first_token_t = tnow
                 req.tokens.extend(toks)
+                self._recovery_log.extend(req.rid, toks)
                 out[req.rid] = list(toks)
                 if req.on_token is not None:
                     for tok in toks:
@@ -222,26 +325,389 @@ class ServingEngine:
                 req = self._running.pop(erid, None)
                 if req is None:
                     continue
-                req.state = FINISHED
-                req.finish_t = tnow
-                req.result = result
-                if req.deadline_ms is not None and req.deadline_met is None:
-                    # telemetry off: the event hook didn't judge it first
-                    req.deadline_met = tnow <= req.deadline_at
-                self._tokens_done += len(req.tokens)
-                self.policy.on_finish(req, tnow)
-                if self._tele.enabled:
-                    reg = self._tele.registry
-                    reg.counter("serve_finished_total").inc()
-                    if req.deadline_met is not None:
-                        reg.counter("serve_deadline_met_total"
-                                    if req.deadline_met
-                                    else "serve_deadline_missed_total").inc()
+                self._finish_request(req, result, tnow)
         self._update_gauges()
         return out
 
+    def _finish_request(self, req: ServeRequest, result, now: float):
+        """The ONE FINISHED transition (normal retirement and recovered-
+        complete synthesis both land here): record/result state, recovery
+        log retirement, the deadline fallback verdict, rate accounting,
+        policy hook, and the finished/deadline counters."""
+        req.state = FINISHED
+        req.finish_t = now
+        req.result = result
+        self._recovery_log.retire(req.rid)
+        if req.deadline_ms is not None and req.deadline_met is None:
+            # telemetry off: the event hook didn't judge it first
+            req.deadline_met = now <= req.deadline_at
+        self._tokens_done += len(req.tokens)
+        self.policy.on_finish(req, now)
+        if self._tele.enabled:
+            reg = self._tele.registry
+            reg.counter("serve_finished_total").inc()
+            if req.deadline_met is not None:
+                reg.counter("serve_deadline_met_total" if req.deadline_met
+                            else "serve_deadline_missed_total").inc()
+
+    # -- fault tolerance ------------------------------------------------
+    def _guarded_tick(self):
+        """One engine tick under the recovery policy. Returns
+        ``(emitted, ticked)`` — ``ticked`` False when the tick was lost
+        to a fault and the engine was rebuilt (the re-admitted requests'
+        staged reservations must survive until the NEW engine ticks).
+        With recovery disarmed (no factory, no RecoveryConfig) this is a
+        bare ``engine.step()`` — exceptions propagate unchanged."""
+        if not self._recovery_enabled:
+            return self._cb.step(), True
+        try:
+            emitted = self._cb.step()
+        except Exception as e:  # noqa: BLE001 — any tick failure enters recovery
+            return self._on_tick_failure(e)
+        self._consecutive_failures = 0
+        if self._breaker_open:
+            self._close_breaker()
+        return emitted, True
+
+    def _on_tick_failure(self, exc: Exception):
+        """The escalation ladder: bounded retry-with-backoff for a CLEAN
+        failure (raised before the engine mutated state), then engine
+        rebuild — on the full mesh first, then each configured degraded
+        mesh. Ticks in flight on the lost engine are discarded, never
+        fetched; the resume RNG design regenerates their tokens bitwise."""
+        cfg = self.recovery_cfg
+        now = self._clock()
+        self._open_breaker(now)
+        self._consecutive_failures += 1
+        self._fault_count += 1
+        self._fault_event("fault", error=type(exc).__name__,
+                          detail=str(exc)[:200],
+                          poisoned=bool(self._cb.poisoned),
+                          consecutive=self._consecutive_failures)
+        if self._tele.enabled:
+            self._tele.registry.counter("serve_fault_total").inc()
+        # a poisoned engine (exception past the dispatch barrier: results
+        # lost mid-pipeline) or an explicit preemption must NOT be
+        # retried — a retried tick would leave a hole in every stream
+        retryable = not self._cb.poisoned and not isinstance(exc, EnginePreempted)
+        if retryable:
+            for attempt in range(cfg.max_tick_retries):
+                self._sleep(cfg.backoff_s * (2 ** attempt))
+                self._retry_count += 1
+                if self._tele.enabled:
+                    self._tele.registry.counter("serve_tick_retry_total").inc()
+                try:
+                    emitted = self._cb.step()
+                except Exception as e2:  # noqa: BLE001 — retry outcome feeds escalation
+                    self._consecutive_failures += 1
+                    self._fault_count += 1
+                    if self._tele.enabled:
+                        # a failed retry IS another fault: the counter,
+                        # recovery_stats()["faults"] and the trace-report
+                        # recovery section must all agree on the total
+                        self._tele.registry.counter("serve_fault_total").inc()
+                    self._fault_event("retry_failed", attempt=attempt + 1,
+                                      error=type(e2).__name__,
+                                      consecutive=self._consecutive_failures)
+                    exc = e2
+                    if self._cb.poisoned or isinstance(e2, EnginePreempted):
+                        break  # state lost mid-retry: straight to rebuild
+                else:
+                    # a real completed tick: tokens flow through the
+                    # normal attribution path, staged slots are consumed
+                    self._fault_event("retried", attempt=attempt + 1)
+                    self._consecutive_failures = 0
+                    self._close_breaker()
+                    return emitted, True
+        self._rebuild(exc)
+        return {}, False
+
+    def _rebuild(self, exc: Exception):
+        """Abandon the engine and build a replacement, re-admitting every
+        running request mid-stream (prompt + emitted, same engine rid,
+        ``gen_base=len(emitted)`` — bitwise resume). Escalates through
+        ``degrade_mesh_shapes`` when a build fails or the preemption took
+        capacity; raises :class:`RecoveryFailed` (after marking every
+        live request shed) when nothing can be built."""
+        cfg = self.recovery_cfg
+        t0 = self._clock()
+        if self.engine_factory is None:
+            self._fail_terminally(exc, "no engine_factory configured — "
+                                       "cannot rebuild the lost engine")
+        if self._rebuild_count >= cfg.max_rebuilds:
+            self._fail_terminally(exc, f"max_rebuilds={cfg.max_rebuilds} "
+                                       f"exhausted")
+        lost = self._cb.abort_inflight()
+        self._lost_ticks += lost
+        old_hook = self._cb.fault_hook
+        # degradation ladder: level 0 = the factory's full-size build,
+        # level i = degrade_mesh_shapes[i-1]. A degrading preemption
+        # advances the ladder before building; a failed build advances it
+        # and tries again.
+        shapes: List[Optional[dict]] = [None] + self.degrade_mesh_shapes
+        if isinstance(exc, EnginePreempted) and exc.degrade:
+            self._degrade_level = min(self._degrade_level + 1,
+                                      len(shapes) - 1)
+            if self._degrade_level == 0 or shapes[self._degrade_level] is None:
+                logger.warning("preemption demanded degradation but no "
+                               "degrade_mesh_shapes are configured — "
+                               "rebuilding at full size")
+        new = None
+        while new is None:
+            shape = shapes[self._degrade_level]
+            try:
+                new = self.engine_factory(mesh_shape=shape)
+            except Exception as build_err:  # noqa: BLE001 — feeds the degradation ladder
+                self._fault_event("rebuild_failed", mesh=shape,
+                                  error=type(build_err).__name__,
+                                  detail=str(build_err)[:200])
+                if self._degrade_level + 1 < len(shapes):
+                    self._degrade_level += 1
+                else:
+                    self._fail_terminally(
+                        build_err, "engine_factory failed at every "
+                                   "degradation level")
+        self._rebuild_count += 1
+        try:
+            readmitted = self._restore_onto(new, old_hook)
+        except Exception as restore_err:  # noqa: BLE001 — restore failure is terminal
+            # a replacement that cannot be restored (prefix prefill or
+            # re-admission raised something other than a size rejection)
+            # must still honour the contract: mark every live request
+            # shed and SURFACE RecoveryFailed — never a raw escape that
+            # leaves requests RUNNING against a half-restored engine
+            self._fail_terminally(restore_err,
+                                  "replacement engine could not be restored")
+        recovery_ms = (self._clock() - t0) * 1000.0
+        self._recovery_ms.append(recovery_ms)
+        shape = shapes[self._degrade_level]
+        self._fault_event("rebuild", recovery_ms=round(recovery_ms, 3),
+                          readmitted=readmitted, lost_ticks=lost,
+                          degraded=shape is not None, mesh=shape,
+                          rebuilds=self._rebuild_count)
+        if self._tele.enabled:
+            reg = self._tele.registry
+            reg.counter("serve_rebuild_total").inc()
+            if lost:
+                reg.counter("serve_lost_tick_total").inc(lost)
+            reg.histogram("recovery_ms").observe(recovery_ms)
+        logger.warning(
+            f"serving engine rebuilt after {type(exc).__name__} "
+            f"(#{self._rebuild_count}, {recovery_ms:.1f} ms, "
+            f"{readmitted} re-admitted, {lost} in-flight ticks lost"
+            + (f", degraded to mesh {shape}" if shape is not None else "")
+            + ")")
+
+    def _restore_onto(self, new, old_hook) -> int:
+        """Make the replacement engine serve where the lost one stopped:
+        adopt the telemetry hub and hooks, restore rid continuity and
+        serving-level prefixes, and re-admit every running request
+        mid-stream. Returns the re-admission count. Raises only when the
+        replacement itself is unusable (the caller converts that into
+        the terminal-failure path)."""
+        cfg = self.recovery_cfg
+        # adopt the serving hub on the replacement: ONE trace writer and
+        # metrics registry across engine generations (factories build
+        # with telemetry off; a factory-created hub would re-open the
+        # trace file and fork the counters)
+        new._eng.telemetry = self._tele
+        new.request_event_hook = self._event_hook
+        new.fault_hook = old_hook
+        if self._pipeline_depth is not None:
+            new.pipeline_depth = self._pipeline_depth
+        if cfg.fetch_timeout_s is not None:
+            new.fetch_timeout_s = cfg.fetch_timeout_s
+        self._cb = new
+        self._staged.clear()
+        # rid continuity: new requests continue the rid sequence the lost
+        # engine was on, so their RNG streams match the fault-free run
+        new._next_rid = max(new._next_rid, self._rid_watermark)
+        # serving-level prefixes survive: re-register on the new engine
+        self._prefix_pids = {spid: new.register_prefix(toks)
+                             for spid, toks in self._prefixes.items()}
+        # re-admit every running request mid-stream, in the lost engine's
+        # submission order (deterministic). The RecoveryLog — not the
+        # live records — is the source of truth here: it is exactly the
+        # jax-free state a cross-process recovery would have.
+        readmitted = 0
+        self._running = {}
+        for entry in self._recovery_log.entries():
+            req = self._requests.get(entry["rid"])
+            if req is None or req.state != RUNNING:
+                self._recovery_log.retire(entry["rid"])
+                continue
+            emitted = entry["emitted"]
+            remaining = entry["max_new_tokens"] - len(emitted)
+            if remaining < 1:
+                # every token surfaced but the finish never retired: the
+                # stream is complete, finish it host-side
+                self._finish_recovered(req, entry)
+                continue
+            full = np.concatenate([
+                np.asarray(entry["prompt"], np.int32),
+                np.asarray(emitted, np.int32)]) if emitted else req.prompt
+            try:
+                erid = new.submit(full, remaining, rid=entry["engine_rid"],
+                                  gen_base=len(emitted))
+            except ValueError as e:
+                # the degraded engine cannot hold it — shed honestly
+                self._mark_lost(req, f"readmit_failed: {e}")
+                continue
+            self._running[erid] = req
+            self._staged[erid] = req.need_tokens
+            req.recoveries += 1
+            readmitted += 1
+        return readmitted
+
+    def _finish_recovered(self, req: ServeRequest, entry: dict):
+        """A lost request whose stream was already complete host-side:
+        synthesize the result (and the ``inference_request`` event the
+        lost engine never got to retire — trace-derived finished counts
+        must match the registry counters), then run the one shared
+        FINISHED transition."""
+        if self._tele.enabled:
+            event = {"request": int(req.rid), "path": "continuous",
+                     "batch": 1, "prompt_tokens": len(entry["prompt"]),
+                     "new_tokens": len(entry["emitted"]),
+                     "recovered_finish": True}
+            # enrich through the one event-hook path (queue_ms/ttft/
+            # priority/tenant + the single SLO verdict); the hook looks
+            # requests up by engine rid, so register transiently
+            self._running[entry["engine_rid"]] = req
+            try:
+                event = self._event_hook(entry["engine_rid"], event) or event
+            finally:
+                self._running.pop(entry["engine_rid"], None)
+            self._tele.emit("inference_request", event)
+        self._finish_request(req, np.concatenate([
+            np.asarray(entry["prompt"], np.int32),
+            np.asarray(entry["emitted"], np.int32)]), self._clock())
+
+    def _mark_lost(self, req: ServeRequest, reason: str):
+        """Terminal shed for a request recovery could not re-admit: the
+        honest outcome — never a silent drop (the conservation invariant
+        admitted == finished + shed + expired + cancelled holds)."""
+        now = self._clock()
+        req.state = SHED
+        req.finish_t = now
+        self._running = {erid: r for erid, r in self._running.items()
+                         if r.rid != req.rid}
+        self._queue = [r for r in self._queue if r.rid != req.rid]
+        self._recovery_log.retire(req.rid)
+        self._lost_requests += 1
+        if self._tele.enabled:
+            self._tele.registry.counter("serve_lost_request_total").inc()
+            self._tele.emit("serving_event", {
+                "event": "shed", "reason": "engine_lost", "request": req.rid,
+                "detail": reason[:200], "tokens_emitted": len(req.tokens),
+            })
+
+    def _fail_terminally(self, exc: Exception, detail: str):
+        """Recovery exhausted: mark every live request shed (streams
+        terminate, accounting stays conservative), emit the terminal
+        fault event, and raise :class:`RecoveryFailed` — ``run()`` and
+        ``step()`` SURFACE this; nothing swallows it."""
+        # gather from the record table, not _queue/_running: a failure
+        # mid-restore leaves _running only partially rebuilt, and every
+        # live request must still be accounted for
+        live = [r for r in self._requests.values()
+                if r.state not in TERMINAL_STATES]
+        for req in live:
+            self._mark_lost(req, f"unrecoverable: {detail}")
+        self._fault_event("unrecoverable", error=type(exc).__name__,
+                          detail=detail, requests_lost=len(live))
+        self._update_gauges()
+        raise RecoveryFailed(
+            f"serving recovery failed ({detail}); last engine fault: "
+            f"{type(exc).__name__}: {exc}. {len(live)} in-flight "
+            f"request(s) marked shed.") from exc
+
+    def _open_breaker(self, now: float):
+        if self._breaker_open:
+            return
+        self._breaker_open = True
+        self._outage_start = now
+        self._fault_event("breaker", state="open")
+
+    def _close_breaker(self):
+        if not self._breaker_open:
+            return
+        now = self._clock()
+        outage_ms = ((now - self._outage_start) * 1000.0
+                     if self._outage_start is not None else 0.0)
+        self._outage_ms_total += outage_ms
+        self._breaker_open = False
+        self._outage_start = None
+        self._fault_event("breaker", state="closed",
+                          outage_ms=round(outage_ms, 3))
+
+    def _fault_event(self, event: str, **fields):
+        if self._tele.enabled:
+            payload = {"event": event}
+            payload.update(fields)
+            self._tele.emit("serving_fault", payload)
+
+    def recovery_stats(self) -> dict:
+        """In-process view of the fault/recovery accounting (what
+        ``ds_loadgen --chaos`` reports and ``ds_trace_report --serve``
+        recomputes from ``serving_fault`` trace events)."""
+        out = {
+            "faults": self._fault_count,
+            "retries": self._retry_count,
+            "rebuilds": self._rebuild_count,
+            "lost_ticks": self._lost_ticks,
+            "lost_requests": self._lost_requests,
+            "degrade_level": self._degrade_level,
+            "outage_ms_total": round(self._outage_ms_total, 3),
+            "breaker_open": self._breaker_open,
+        }
+        if self._recovery_ms:
+            # the same interpolated percentile ds_trace_report computes
+            # from the serving_fault journal — the two tools must agree
+            from deepspeed_tpu.telemetry.registry import percentile
+
+            rs = sorted(self._recovery_ms)
+            out["recovery_ms"] = {
+                "count": len(rs),
+                "p50": round(percentile(rs, 50.0), 3),
+                "max": round(rs[-1], 3),
+            }
+        return out
+
+    def register_prefix(self, prefix_ids) -> int:
+        """Serving-level prefix registration: like the engine's
+        ``register_prefix`` but with an id that stays valid across
+        engine rebuilds (the tokens are kept host-side and re-registered
+        on every replacement engine)."""
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        epid = self._cb.register_prefix(prefix)  # validates + prefills
+        spid = self._next_prefix_id
+        self._next_prefix_id += 1
+        self._prefixes[spid] = prefix
+        self._prefix_pids[spid] = epid
+        return spid
+
+    def unregister_prefix(self, prefix_id: int):
+        if prefix_id not in self._prefixes:
+            raise KeyError(f"unknown prefix id {prefix_id}")
+        self._prefixes.pop(prefix_id)
+        epid = self._prefix_pids.pop(prefix_id)
+        self._cb.unregister_prefix(epid)
+
+    def _tracks(self, req: ServeRequest) -> bool:
+        """Whether serving still holds ``req`` somewhere a ``step()`` can
+        make progress on it — the TokenStream spin guard."""
+        if req.state == QUEUED:
+            return any(r.rid == req.rid for r in self._queue)
+        if req.state == RUNNING:
+            return any(r.rid == req.rid for r in self._running.values())
+        return False
+
     def run(self, max_ticks: Optional[int] = None) -> int:
-        """Step until idle (or ``max_ticks``); returns ticks taken."""
+        """Step until idle (or ``max_ticks``); returns ticks taken.
+        A terminal recovery failure (:class:`RecoveryFailed` — retries
+        and every rebuild level exhausted) propagates to the caller; it
+        is never swallowed into a normal-looking return."""
         ticks = 0
         while self.has_work():
             if max_ticks is not None and ticks >= max_ticks:
@@ -309,9 +775,18 @@ class ServingEngine:
         return done
 
     def close(self):
-        """Flush/close the telemetry trace (the engines share one hub);
-        the load generator and servers call this at shutdown."""
-        self._tele.close()
+        """Flush/close the telemetry trace (the engines share one hub,
+        including across rebuilds); the load generator and servers call
+        this at shutdown. Idempotent and fault-safe: double close and
+        close during/after a (possibly failed) recovery are no-ops —
+        shutdown paths run from exception handlers and must never raise."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._tele.close()
+        except Exception as e:  # noqa: BLE001 — shutdown must not raise
+            logger.warning(f"serving close: telemetry close failed ({e})")
 
     def stream(self, rid: int) -> TokenStream:
         """Per-token pull iterator for an admitted/queued request; tokens
@@ -336,6 +811,7 @@ class ServingEngine:
             self._cb.cancel(req.engine_rid)
             self._running.pop(req.engine_rid, None)
             self._staged.pop(req.engine_rid, None)
+            self._recovery_log.retire(rid)
         req.state = CANCELLED
         req.finish_t = now
         if self._tele.enabled:
@@ -363,17 +839,49 @@ class ServingEngine:
             self._tele.emit("serving_event", event)
         return Admission(status=SHED, reason=reason, retry_after_s=hint)
 
-    def _retry_after(self, excess_tokens: int, now: float) -> Optional[float]:
-        """Coarse backpressure hint: how long until ``excess_tokens`` of
-        committed work drains at the observed completion rate. None until
-        any request has finished (no rate to extrapolate from)."""
+    def _completion_rate(self, now: float) -> Optional[float]:
+        """Observed completion rate (tokens/s), or None when it is not
+        yet observable — zero requests finished, or no time has elapsed
+        since the first submit. Callers must treat None as "no rate",
+        never divide by it."""
         if self._tokens_done <= 0 or self._t_start is None:
             return None
         elapsed = now - self._t_start
         if elapsed <= 0:
             return None
         rate = self._tokens_done / elapsed
-        return round(max(1, excess_tokens) / rate, 3)
+        return rate if rate > 0 else None
+
+    def _recovery_eta_s(self, now: float) -> float:
+        """Expected seconds until the current outage ends: the last
+        measured recovery time (or the configured estimate before any
+        has been observed) minus the outage time already elapsed. While
+        the breaker is STILL open past that estimate (the rebuilt engine
+        is unproven, or recovery is slower than last time) the honest
+        assumption is another full recovery cycle — the hint never decays
+        to zero mid-outage. 0.0 while healthy."""
+        if not self._breaker_open or self._outage_start is None:
+            return 0.0
+        est = (self._recovery_ms[-1] / 1000.0 if self._recovery_ms
+               else self.recovery_cfg.est_recovery_s)
+        est = max(est, self.recovery_cfg.backoff_s)
+        remaining = est - (now - self._outage_start)
+        return remaining if remaining > 0 else est
+
+    def _retry_after(self, excess_tokens: int, now: float) -> Optional[float]:
+        """Coarse backpressure hint: how long until ``excess_tokens`` of
+        committed work drains at the observed completion rate, PLUS the
+        expected remaining outage when the circuit breaker is open.
+        Well-defined in every regime — in particular, with ZERO
+        completions in the observation window (cold start, or an outage
+        before anything finished) there is no rate to divide by: the
+        hint is the recovery ETA alone, or None when healthy with
+        nothing to extrapolate from."""
+        outage = self._recovery_eta_s(now)
+        rate = self._completion_rate(now)
+        if rate is None:
+            return round(outage, 3) if outage > 0 else None
+        return round(max(1, excess_tokens) / rate + outage, 3)
 
     def _effective_pool_state(self) -> List[dict]:
         """pool_state() with staged handovers already subtracted, placed
@@ -391,11 +899,23 @@ class ServingEngine:
                    for p in self._effective_pool_state())
 
     def _handover(self, req: ServeRequest, now: float):
-        req.engine_rid = self._cb.submit(req.prompt, req.max_new_tokens)
+        if req.prefix_id is not None and req.prefix_id in self._prefixes:
+            # splice the registered prefix KV; only the suffix prefills
+            suffix = req.prompt[self._prefixes[req.prefix_id].size:]
+            req.engine_rid = self._cb.submit_with_prefix(
+                self._prefix_pids[req.prefix_id], suffix, req.max_new_tokens)
+        else:
+            # no prefix — or it was unregistered while this request sat
+            # in the queue: req.prompt already holds the FULL token
+            # sequence, so pay the full prefill instead of stranding the
+            # request (stream bitwise identical either way)
+            req.engine_rid = self._cb.submit(req.prompt, req.max_new_tokens)
         req.state = RUNNING
         req.admit_t = now
+        self._rid_watermark = max(self._rid_watermark, req.engine_rid + 1)
         self._staged[req.engine_rid] = req.need_tokens
         self._running[req.engine_rid] = req
+        self._recovery_log.admit(req)
         self.policy.on_admit(req, now)
         if self._tele.enabled:
             self._tele.registry.counter("serve_admitted_total").inc()
@@ -479,6 +999,12 @@ class ServingEngine:
             ttft if ttft is not None else (now - req.submit_t) * 1000.0, 3)
         event["priority"] = req.priority
         event["tenant"] = req.tenant
+        if req.recoveries:
+            # the rebuilt engine only generated the post-outage remainder;
+            # the client's stream is the full accumulated one — report
+            # THAT, and flag the request so SLO analysis can segment
+            event["new_tokens"] = len(req.tokens)
+            event["recoveries"] = req.recoveries
         if req.deadline_ms is not None:
             # this is the request's single SLO verdict: the counters and
             # loadgen records reuse it rather than re-reading the clock

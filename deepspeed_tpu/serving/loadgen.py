@@ -35,6 +35,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.serving.faults import FaultInjector, FaultPlan
+from deepspeed_tpu.serving.recovery import RecoveryConfig
 from deepspeed_tpu.telemetry.registry import percentile
 
 _PROCESSES = ("poisson", "uniform", "burst")
@@ -187,6 +189,12 @@ def run_load(serving, workload: List[dict], arrivals: List[float],
         rec["state"] = req.state
         rec["tokens"] = len(req.tokens)
         rec["generated"] = list(req.tokens)  # parity checks / replay diffing
+        if req.recoveries:
+            rec["recoveries"] = req.recoveries
+        if req.finish_t is not None:
+            # completion timeline (same clock as arrivals): the chaos
+            # scorecard bins these to measure the goodput dip
+            rec["finish_s"] = req.finish_t - t0
         q = req.queue_ms()
         if q is not None:
             rec["queue_ms"] = q
@@ -227,6 +235,58 @@ def host_overhead(tick_stats: dict) -> dict:
     }
     if "utilization" in tick_stats:
         out["tick_utilization"] = tick_stats["utilization"]
+    return out
+
+
+def goodput_dip(records: List[dict], wall_s: float, bins: int = 10) -> Optional[dict]:
+    """The chaos-scorecard headline: bin finished requests' output tokens
+    by completion time (``finish_s``) and compare the worst bin inside
+    the active window (first completion .. last completion — zeros in
+    between are genuine outage, not warmup/tail) against the median bin.
+    Returns ``{bin_s, baseline_tok_s, floor_tok_s, dip_frac}`` or None
+    when there are not enough completions to observe a rate."""
+    pts = [(float(r["finish_s"]), int(r.get("tokens", 0))) for r in records
+           if r.get("state") == "finished" and "finish_s" in r]
+    if not pts or wall_s <= 0 or bins < 1:
+        return None
+    width = wall_s / bins
+    if width <= 0:
+        return None
+    binned = [0.0] * bins
+    for t, tok in pts:
+        binned[min(bins - 1, max(0, int(t / width)))] += tok
+    hot = [i for i, v in enumerate(binned) if v > 0]
+    window = binned[hot[0]:hot[-1] + 1]
+    if len(window) < 2:
+        return None  # one active bin: no dip is observable
+    # baseline = the healthy completion rate (median of the BUSY bins —
+    # an outage long enough to dominate the window must read as a deep
+    # dip, not drag the baseline to zero); floor = the worst bin inside
+    # the window, zeros included
+    busy = sorted(v / width for v in window if v > 0)
+    baseline = busy[len(busy) // 2]
+    floor = min(v / width for v in window)
+    if baseline <= 0:
+        return None
+    return {"bin_s": round(width, 3),
+            "baseline_tok_s": round(baseline, 3),
+            "floor_tok_s": round(floor, 3),
+            "dip_frac": round(1.0 - floor / baseline, 4)}
+
+
+def chaos_scorecard(records: List[dict], wall_s: float, recovery: dict,
+                    injected: Optional[List[dict]] = None) -> dict:
+    """The ``--chaos`` section: the serving engine's recovery accounting
+    (``ServingEngine.recovery_stats()``) + the goodput dip measured from
+    the completion timeline + the injector's fired-fault log."""
+    out = dict(recovery)
+    if injected is not None:
+        out["injected"] = len(injected)
+    recovered = sum(1 for r in records if r.get("recoveries"))
+    out["recovered_requests"] = recovered
+    dip = goodput_dip(records, wall_s)
+    if dip is not None:
+        out["goodput_dip"] = dip
     return out
 
 
@@ -303,6 +363,29 @@ def format_summary(summary: dict) -> str:
         lines.append(f"blocked/token  {_ms(host['block_ms_per_token'])}  "
                      f"(pipeline depth {host['pipeline_depth']}, "
                      f"wasted {host['wasted_tokens']} tok)")
+    chaos = summary.get("chaos")
+    if chaos:
+        lines.append(
+            f"chaos          faults {chaos.get('faults', 0)}"
+            + (f" (injected {chaos['injected']})" if "injected" in chaos else "")
+            + f"   retries {chaos.get('retries', 0)}"
+              f"   rebuilds {chaos.get('rebuilds', 0)}"
+              f"   degrade level {chaos.get('degrade_level', 0)}")
+        lines.append(
+            f"recovery       lost ticks {chaos.get('lost_ticks', 0)}"
+            f"   lost requests {chaos.get('lost_requests', 0)}"
+            f"   recovered requests {chaos.get('recovered_requests', 0)}"
+            f"   outage {chaos.get('outage_ms_total', 0.0)} ms")
+        rms = chaos.get("recovery_ms")
+        if rms:
+            lines.append(f"recovery_ms    p50 {rms['p50']} ms   "
+                         f"max {rms['max']} ms  ({rms['count']} rebuilds)")
+        dip = chaos.get("goodput_dip")
+        if dip:
+            lines.append(f"goodput dip    {dip['dip_frac']:.1%}  "
+                         f"(floor {dip['floor_tok_s']} tok/s vs median "
+                         f"{dip['baseline_tok_s']} tok/s over "
+                         f"{dip['bin_s']}s bins)")
     return "\n".join(lines) + "\n"
 
 
@@ -467,6 +550,26 @@ def main(argv=None) -> int:
                    help="write the mesh sweep as a MULTICHIP_*-style JSON "
                         "serving record (per-width throughput + "
                         "host-blocked ms/token + winner)")
+    p.add_argument("--chaos", default=None, metavar="PLAN.jsonl",
+                   help="fault-injection plan (serving/faults.py FaultPlan "
+                        "JSONL: tick/kind lines, kinds dispatch_error|"
+                        "fetch_hang|preempt). Arms watchdog+recovery: "
+                        "failed ticks retry with backoff, lost engines "
+                        "rebuild and re-admit every in-flight request "
+                        "mid-stream (bitwise resume); the summary gains "
+                        "a recovery-time + goodput-dip scorecard")
+    p.add_argument("--chaos-degrade", default=None, metavar="D:T[,..]",
+                   help="graceful-degradation ladder for --chaos: mesh "
+                        "shape(s) to rebuild on when the full-size "
+                        "rebuild fails or a preemption took capacity, "
+                        "e.g. 1:1 after serving --mesh 1:2")
+    p.add_argument("--tick-retries", type=int, default=2,
+                   help="bounded retry budget for a clean tick failure "
+                        "before escalating to engine rebuild (--chaos)")
+    p.add_argument("--fetch-timeout-s", type=float, default=None,
+                   help="watchdog on the per-tick packed-result fetch; "
+                        "an over-budget fetch abandons the engine and "
+                        "triggers a rebuild (--chaos)")
     p.add_argument("--policy", default="fifo",
                    choices=("fifo", "priority", "edf", "fair"))
     p.add_argument("--queue-depth", type=int, default=64)
@@ -519,7 +622,17 @@ def main(argv=None) -> int:
         model = TransformerModel.from_preset(args.preset, dtype=args.dtype)
     params = model.init(jax.random.PRNGKey(args.seed))
 
-    def build_serving(depth: int, trace_out=None, mesh_shape=None):
+    chaos_plan = FaultPlan.load(args.chaos) if args.chaos else None
+    degrade_shapes = []
+    if args.chaos_degrade:
+        if not args.chaos:
+            p.error("--chaos-degrade needs --chaos (it is the rebuild "
+                    "degradation ladder for the fault-injected run)")
+        from deepspeed_tpu.parallel.partition import parse_mesh_arg as _pma
+
+        degrade_shapes = [_pma(s) for s in args.chaos_degrade.split(",")]
+
+    def build_cb(depth: int, mesh_shape=None, trace_out=None):
         cfg = {"dtype": args.dtype}
         if mesh_shape:
             cfg["mesh"] = {"shape": mesh_shape}
@@ -531,23 +644,48 @@ def main(argv=None) -> int:
         else:
             engine_kwargs["max_slots"] = args.slots
             engine_kwargs["cache_len"] = args.cache_len
-        cb = ContinuousBatchingEngine(
+        return ContinuousBatchingEngine(
             model, params=params, config=cfg,
             tokens_per_tick=args.tokens_per_tick,
             pipeline_depth=depth,
             fused_prefill=not args.no_fused_prefill,
             donate_cache=not args.no_donate,
             **engine_kwargs)
+
+    def build_serving(depth: int, trace_out=None, mesh_shape=None):
+        cb = build_cb(depth, mesh_shape=mesh_shape, trace_out=trace_out)
+        kw = {}
+        if chaos_plan is not None:
+            cb.fault_hook = FaultInjector(chaos_plan)
+
+            def factory(mesh_shape=None, _depth=depth, _orig=mesh_shape):
+                # replacement engines carry NO telemetry config — the
+                # serving layer re-injects its hub so the trace file and
+                # counters stay continuous across rebuilds; mesh_shape
+                # None = rebuild at the run's original size
+                return build_cb(_depth,
+                                mesh_shape=mesh_shape or _orig)
+
+            kw = dict(engine_factory=factory,
+                      degrade_mesh_shapes=degrade_shapes,
+                      recovery=RecoveryConfig(
+                          max_tick_retries=args.tick_retries,
+                          fetch_timeout_s=args.fetch_timeout_s))
         return ServingEngine(cb, policy=args.policy,
                              max_queue_depth=args.queue_depth,
                              kv_budget_tokens=args.kv_budget,
-                             aging_s=args.aging_s)
+                             aging_s=args.aging_s, **kw)
 
     def one_run(depth: int, trace_out=None, mesh_shape=None):
         serving = build_serving(depth, trace_out=trace_out,
                                 mesh_shape=mesh_shape)
         records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
         summary = summarize(records, wall_s, tick_stats=serving.tick_stats())
+        if chaos_plan is not None:
+            injector = serving._cb.fault_hook
+            summary["chaos"] = chaos_scorecard(
+                records, wall_s, serving.recovery_stats(),
+                injected=getattr(injector, "fired", None))
         if mesh_shape:
             summary["mesh"] = dict(mesh_shape)
         if trace_out:
@@ -569,6 +707,11 @@ def main(argv=None) -> int:
         p.error("--mesh-out records a per-width mesh sweep; it does not "
                 "combine with the depth A/B (--ab-pipeline) — run them "
                 "separately")
+    if args.chaos and (args.ab_pipeline or args.ab_mesh or args.mesh_out
+                       or len(meshes) > 1):
+        p.error("--chaos measures one fault-injected run; it does not "
+                "combine with the A/B modes or the mesh sweep (compare a "
+                "chaos run against a no-chaos run of the same workload)")
 
     def write_mesh_record(results):
         record = mesh_record(results, {

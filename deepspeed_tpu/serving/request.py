@@ -16,24 +16,30 @@ import numpy as np
 # -- request lifecycle states ------------------------------------------
 # QUEUED -> RUNNING -> FINISHED is the happy path; QUEUED requests may
 # instead terminate CANCELLED (caller) or EXPIRED (deadline blew while
-# waiting); RUNNING ones may terminate CANCELLED (slot freed mid-flight).
+# waiting); RUNNING ones may terminate CANCELLED (slot freed mid-flight)
+# or SHED (the engine was lost and recovery could not re-admit — the
+# fault-tolerance path's honest terminal state: nothing is silently
+# dropped, admitted == finished + shed + expired + cancelled).
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
 CANCELLED = "cancelled"
 EXPIRED = "expired"
-TERMINAL_STATES = (FINISHED, CANCELLED, EXPIRED)
 
 # -- admission verdicts (ServingEngine.submit) -------------------------
 # ADMITTED: handed to the batching engine immediately (a fitting slot was
 #   free and nothing queued outranked it) — the next tick prefills it.
 # QUEUED_STATUS: accepted into the bounded queue; the scheduler policy
 #   decides its turn.
-# SHED: rejected under backpressure (queue full or KV budget exceeded) —
-#   nothing was enqueued, no request id exists, retry after the hint.
+# SHED: rejected under backpressure (queue full / KV budget / recovering)
+#   — nothing was enqueued, no request id exists, retry after the hint.
+#   Doubles as the terminal STATE of an admitted request the fault-
+#   tolerance layer could not carry through an engine loss.
 ADMITTED = "admitted"
 QUEUED_STATUS = "queued"
 SHED = "shed"
+
+TERMINAL_STATES = (FINISHED, CANCELLED, EXPIRED, SHED)
 
 
 @dataclass
@@ -75,6 +81,13 @@ class ServeRequest:
     tokens: List[int] = field(default_factory=list)
     result: Optional[np.ndarray] = None  # prompt + generated, set at FINISHED
     engine_rid: Optional[int] = None     # ContinuousBatchingEngine rid once RUNNING
+    # serving-level prefix id when admission splices a registered prefix
+    # (ServingEngine.register_prefix); the RecoveryLog records it so a
+    # rebuilt engine re-registers before re-admitting
+    prefix_id: Optional[int] = None
+    # times this request was re-admitted onto a rebuilt engine (fault
+    # tolerance; 0 = never touched by a recovery)
+    recoveries: int = 0
 
     @property
     def need_tokens(self) -> int:

@@ -1,0 +1,166 @@
+"""Fault-injection plumbing (serving/faults.py) and the jax-free
+recovery state (serving/recovery.py): plan validation / synthesis /
+JSONL round-trip, injector firing semantics, RecoveryLog bookkeeping,
+RecoveryConfig parsing. No jax, no engine — these are the pieces the
+chaos tests (test_recovery.py) compose."""
+
+import dataclasses
+
+import pytest
+
+from deepspeed_tpu.serving.faults import (
+    FAULT_KINDS,
+    EnginePreempted,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    FetchHang,
+    InjectedFault,
+    TickDispatchError,
+)
+from deepspeed_tpu.serving.recovery import RecoveryConfig, RecoveryLog
+
+
+class TestFaultPlan:
+    def test_fault_validation_and_default_points(self):
+        assert Fault(tick=3, kind="dispatch_error").point == "dispatch"
+        assert Fault(tick=3, kind="fetch_hang").point == "retire"
+        assert Fault(tick=3, kind="preempt").point == "dispatch"
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            Fault(tick=1, kind="meteor_strike")
+        with pytest.raises(ValueError, match="unknown hook point"):
+            Fault(tick=1, kind="preempt", point="teatime")
+        with pytest.raises(ValueError, match="tick must be >= 0"):
+            Fault(tick=-1, kind="preempt")
+        with pytest.raises(ValueError, match="count must be >= 1"):
+            Fault(tick=1, kind="preempt", count=0)
+
+    def test_plan_sorts_and_roundtrips(self, tmp_path):
+        plan = FaultPlan([Fault(tick=9, kind="fetch_hang"),
+                          Fault(tick=2, kind="dispatch_error", count=3),
+                          Fault(tick=5, kind="preempt", degrade=True)])
+        assert [f.tick for f in plan] == [2, 5, 9]
+        path = tmp_path / "plan.jsonl"
+        plan.dump(str(path))
+        loaded = FaultPlan.load(str(path))
+        assert [dataclasses.asdict(f) for f in loaded] == \
+            [dataclasses.asdict(f) for f in plan]
+        assert loaded.faults[1].degrade is True
+        assert loaded.faults[0].count == 3
+
+    def test_load_empty_rejected(self, tmp_path):
+        path = tmp_path / "empty.jsonl"
+        path.write_text("\n")
+        with pytest.raises(ValueError, match="no fault records"):
+            FaultPlan.load(str(path))
+
+    def test_synth_seeded_and_deterministic(self):
+        a = FaultPlan.synth(seed=7, n_faults=5, first_tick=3, tick_span=50)
+        b = FaultPlan.synth(seed=7, n_faults=5, first_tick=3, tick_span=50)
+        assert [f.to_dict() for f in a] == [f.to_dict() for f in b]
+        assert len(a) == 5
+        assert all(3 <= f.tick < 53 for f in a)
+        assert all(f.kind in FAULT_KINDS for f in a)
+        c = FaultPlan.synth(seed=8, n_faults=5, first_tick=3, tick_span=50)
+        assert [f.to_dict() for f in a] != [f.to_dict() for f in c]
+        d = FaultPlan.synth(seed=7, n_faults=2, degrade_last=True)
+        assert d.faults[-1].kind == "preempt" and d.faults[-1].degrade
+
+
+class TestFaultInjector:
+    def test_fires_once_at_tick_with_exception_taxonomy(self):
+        inj = FaultInjector(FaultPlan([
+            Fault(tick=2, kind="dispatch_error"),
+            Fault(tick=4, kind="preempt", degrade=True)]))
+        inj("dispatch", {"tick": 0})        # tick 1: nothing due
+        with pytest.raises(TickDispatchError) as ei:
+            inj("dispatch", {"tick": 1})    # tick 2: due
+        assert ei.value.fault["kind"] == "dispatch_error"
+        inj("dispatch", {"tick": 2})        # exhausted: no refire
+        with pytest.raises(EnginePreempted) as ep:
+            inj("dispatch", {"tick": 3})    # tick 4
+        assert ep.value.degrade is True
+        assert inj.pending() == 0
+        assert [f["kind"] for f in inj.fired] == ["dispatch_error", "preempt"]
+
+    def test_retire_point_fires_on_first_retire_after_tick(self):
+        inj = FaultInjector(FaultPlan([Fault(tick=3, kind="fetch_hang")]))
+        for _ in range(5):                  # dispatch ticks advance the clock
+            inj("dispatch", {})
+        inj("set_row", {})                  # wrong point: no fire
+        with pytest.raises(FetchHang) as ei:
+            inj("retire", {"pool": 0})
+        assert isinstance(ei.value, TimeoutError)  # watchdog taxonomy
+        assert isinstance(ei.value, InjectedFault)
+        inj("retire", {"pool": 0})          # exhausted
+
+    def test_persistent_fault_fires_count_times(self):
+        inj = FaultInjector(FaultPlan([
+            Fault(tick=1, kind="dispatch_error", count=3)]))
+        for i in range(3):
+            with pytest.raises(TickDispatchError):
+                inj("dispatch", {"attempt": i})
+        inj("dispatch", {})  # drained
+        assert len(inj.fired) == 3
+        assert inj.fired[0]["fired_tick"] == 1
+
+
+class TestRecoveryLog:
+    class _Req:
+        def __init__(self, rid, erid, prompt, tokens=(), prefix_id=None):
+            self.rid, self.engine_rid = rid, erid
+            self.prompt, self.tokens = list(prompt), list(tokens)
+            self.max_new_tokens = 8
+            self.priority, self.tenant = 1, "t0"
+            self.deadline_ms, self.submit_t = 250.0, 1.5
+            self.prefix_id = prefix_id
+
+    def test_admit_extend_retire_and_order(self):
+        log = RecoveryLog()
+        log.admit(self._Req(5, 11, [1, 2, 3]))
+        log.admit(self._Req(3, 9, [4], tokens=[7], prefix_id=2))
+        assert len(log) == 2 and 5 in log and 4 not in log
+        log.extend(5, [42, 43])
+        log.extend(999, [1])  # untracked: ignored, not an error
+        entries = log.entries()
+        # deterministic re-admission order: by engine rid
+        assert [e["engine_rid"] for e in entries] == [9, 11]
+        assert entries[1]["emitted"] == [42, 43]
+        assert entries[0]["prefix_id"] == 2 and entries[1]["prefix_id"] is None
+        assert entries[0]["deadline_ms"] == 250.0
+        log.retire(5)
+        assert len(log) == 1 and 5 not in log
+        log.retire(5)  # idempotent
+
+    def test_snapshot_is_detached_and_jsonl_roundtrips(self, tmp_path):
+        log = RecoveryLog()
+        log.admit(self._Req(0, 0, [1, 2], tokens=[9]))
+        snap = log.snapshot()
+        snap[0]["emitted"].append(123)  # mutating the snapshot...
+        assert log.entries()[0]["emitted"] == [9]  # ...never leaks back
+        path = tmp_path / "recovery.jsonl"
+        log.to_jsonl(str(path))
+        back = RecoveryLog.from_jsonl(str(path))
+        assert back.entries() == log.entries()
+
+
+class TestRecoveryConfig:
+    def test_defaults_and_validation(self):
+        cfg = RecoveryConfig()
+        assert cfg.fetch_timeout_s is None and cfg.max_tick_retries == 2
+        with pytest.raises(ValueError, match="max_tick_retries"):
+            RecoveryConfig(max_tick_retries=-1)
+        with pytest.raises(ValueError, match="backoff_s"):
+            RecoveryConfig(backoff_s=-0.1)
+        with pytest.raises(ValueError, match="max_rebuilds"):
+            RecoveryConfig(max_rebuilds=0)
+        with pytest.raises(ValueError, match="fetch_timeout_s"):
+            RecoveryConfig(fetch_timeout_s=0.0)
+
+    def test_parse_forms(self):
+        assert RecoveryConfig.parse(None).max_tick_retries == 2
+        cfg = RecoveryConfig(max_rebuilds=3)
+        assert RecoveryConfig.parse(cfg) is cfg
+        assert RecoveryConfig.parse({"backoff_s": 0.2}).backoff_s == 0.2
+        with pytest.raises(TypeError, match="RecoveryConfig or dict"):
+            RecoveryConfig.parse("fast")

@@ -293,3 +293,86 @@ class TestSoak:
         events = [json.loads(l) for l in open(trace)]
         fin = [e for e in events if e.get("kind") == "inference_request"]
         assert len(fin) == outcomes.get("finished", 0)
+
+
+class TestChaosScorecard:
+    def test_goodput_dip_math(self):
+        """Completion timeline with a hole in the middle: the dip is
+        measured inside the active window (first..last completion), so
+        warmup/tail zeros don't inflate it."""
+        records = (
+            [{"state": "finished", "tokens": 10, "finish_s": 1.0 + 0.1 * i}
+             for i in range(5)]                       # hot bin(s) early
+            + [{"state": "finished", "tokens": 10, "finish_s": 8.0 + 0.1 * i}
+               for i in range(5)])                    # hot again late
+        dip = loadgen.goodput_dip(records, wall_s=10.0, bins=10)
+        assert dip is not None
+        assert dip["dip_frac"] == 1.0                 # a dead bin mid-window
+        assert dip["floor_tok_s"] == 0.0
+        assert dip["baseline_tok_s"] == 50.0          # the busy-bin median
+        # steady stream: no dip
+        steady = [{"state": "finished", "tokens": 5, "finish_s": 0.5 + i}
+                  for i in range(10)]
+        dip2 = loadgen.goodput_dip(steady, wall_s=10.0, bins=10)
+        assert dip2 is not None and dip2["dip_frac"] == 0.0
+        # unobservable cases are None, never a crash
+        assert loadgen.goodput_dip([], 10.0) is None
+        assert loadgen.goodput_dip(steady[:1], 10.0) is None
+        assert loadgen.goodput_dip(steady, 0.0) is None
+
+    def test_chaos_scorecard_merges_stats_and_dip(self):
+        records = [{"state": "finished", "tokens": 5, "recoveries": 1,
+                    "finish_s": 0.5 + i} for i in range(4)]
+        stats = {"faults": 2, "rebuilds": 1, "retries": 0, "lost_ticks": 1,
+                 "lost_requests": 0, "degrade_level": 0,
+                 "outage_ms_total": 12.5, "breaker_open": False}
+        card = loadgen.chaos_scorecard(records, 4.0, stats,
+                                       injected=[{"kind": "preempt"}])
+        assert card["injected"] == 1 and card["rebuilds"] == 1
+        assert card["recovered_requests"] == 4
+        assert "goodput_dip" in card
+        summary = loadgen.summarize(records, 4.0)
+        summary["chaos"] = card
+        text = loadgen.format_summary(summary)
+        assert "chaos" in text and "rebuilds 1" in text
+        assert "goodput dip" in text
+
+    def test_cli_chaos_runs_green(self, setup, tmp_path, capsys):
+        """ds_loadgen --chaos end to end: the seeded plan fires, the
+        engine rebuilds, no request is silently lost, and the summary
+        carries the recovery scorecard."""
+        from deepspeed_tpu.serving.faults import Fault, FaultPlan
+
+        plan_path = tmp_path / "plan.jsonl"
+        FaultPlan([Fault(tick=4, kind="dispatch_error"),
+                   Fault(tick=7, kind="preempt")]).dump(str(plan_path))
+        trace = tmp_path / "chaos.jsonl"
+        rc = loadgen.main([
+            "--requests", "10", "--rate", "300", "--slots", "2",
+            "--cache-len", "64", "--prompt-range", "3:6",
+            "--new-range", "3:5", "--chaos", str(plan_path),
+            "--trace-out", str(trace), "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        summary = json.loads(out[:out.rindex("}") + 1])
+        chaos = summary["chaos"]
+        assert chaos["injected"] == 2 and chaos["rebuilds"] >= 1
+        assert chaos["lost_requests"] == 0
+        # conservation: every request has a terminal outcome
+        assert sum(summary["outcomes"].values()) == 10
+        assert set(summary["outcomes"]) <= {
+            "finished", "shed", "expired", "cancelled"}
+        # the trace carries the serving_fault journal for --serve
+        kinds = {json.loads(l).get("kind")
+                 for l in trace.read_text().splitlines()}
+        assert "serving_fault" in kinds
+
+    def test_cli_chaos_rejects_ab_modes(self, setup, tmp_path):
+        from deepspeed_tpu.serving.faults import Fault, FaultPlan
+
+        plan_path = tmp_path / "plan.jsonl"
+        FaultPlan([Fault(tick=2, kind="preempt")]).dump(str(plan_path))
+        with pytest.raises(SystemExit):
+            loadgen.main(["--chaos", str(plan_path), "--ab-pipeline"])
+        with pytest.raises(SystemExit):
+            loadgen.main(["--chaos-degrade", "1:1"])  # needs --chaos

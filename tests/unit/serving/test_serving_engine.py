@@ -410,3 +410,90 @@ class TestTelemetry:
         srv.reap()
         reg = cb._eng.telemetry.registry.dump()
         assert not reg["counters"] and not reg["gauges"]
+
+
+class TestRobustnessSatellites:
+    """The fault-tolerance PR's satellite fixes: TokenStream termination,
+    retry-hint math under zero completions, idempotent close."""
+
+    def test_stream_terminates_on_cancel_and_expire_mid_stream(self, setup):
+        clock = FakeClock()
+        cb, srv = _make(setup, clock=clock, max_slots=1, cache_len=64)
+        p = _prompts((4, 5), seed=30)
+        running = srv.submit(p[0], max_new_tokens=16)
+        queued = srv.submit(p[1], max_new_tokens=2, deadline_ms=1000.0)
+        stream_r = srv.stream(running.rid)
+        stream_q = srv.stream(queued.rid)
+        next(stream_r)  # some progress
+        srv.cancel(running.rid)
+        # cancelled mid-stream: the iterator ends at the terminal state
+        # instead of stepping forever on an engine that will never emit
+        assert list(stream_r) == []
+        assert stream_r.request.state == "cancelled"
+        clock.advance(2.0)  # the queued request's deadline blows
+        assert list(stream_q) == []
+        assert stream_q.request.state == "expired"
+
+    def test_stream_on_orphaned_request_stops_not_spins(self, setup):
+        """A request cancelled at the ENGINE level behind the serving
+        layer's back (the engine will never emit for it again): the
+        stream detects the orphan and terminates it as shed instead of
+        busy-looping step()."""
+        clock = FakeClock()
+        cb, srv = _make(setup, clock=clock, max_slots=2, cache_len=64)
+        p = _prompts((4, 6), seed=31)
+        a = srv.submit(p[0], max_new_tokens=4)
+        b = srv.submit(p[1], max_new_tokens=16)
+        req_b = srv.request(b.rid)
+        cb.cancel(req_b.engine_rid)         # bypasses ServingEngine.cancel
+        srv._running.pop(req_b.engine_rid)  # serving loses track of it
+        stream = srv.stream(b.rid)
+        assert list(stream) == []           # terminates (would spin before)
+        assert req_b.state == "shed"
+        _drain(srv, clock)
+        assert srv.reap()[a.rid].state == "finished"
+
+    def test_retry_after_well_defined_with_zero_completions(self, setup):
+        clock = FakeClock()
+        cb, srv = _make(setup, clock=clock, max_slots=1, cache_len=32,
+                        kv_budget_tokens=20)
+        # healthy + nothing finished yet: no rate, no outage -> None (and
+        # no ZeroDivision anywhere on the path)
+        assert srv._completion_rate(clock()) is None
+        assert srv._retry_after(10, clock()) is None
+        p = _prompts((4, 4), seed=32)
+        srv.submit(p[0], max_new_tokens=8)
+        shed = srv.submit(p[1], max_new_tokens=12)  # 12+4 over the budget
+        assert shed.status == SHED and shed.reason == "kv_budget"
+        assert shed.retry_after_s is None  # zero completions: honest None
+        # zero ELAPSED time with completions recorded: still well-defined
+        srv._tokens_done = 5
+        srv._t_start = clock()
+        assert srv._completion_rate(clock()) is None
+        assert srv._retry_after(10, clock()) is None
+        clock.advance(2.0)  # now a rate exists: 2.5 tok/s
+        assert srv._retry_after(10, clock()) == pytest.approx(4.0)
+
+    def test_close_is_idempotent_and_fault_safe(self, setup, tmp_path):
+        trace = tmp_path / "close.jsonl"
+        clock = FakeClock()
+        cb, srv = _make(
+            setup, clock=clock,
+            config={"dtype": "float32",
+                    "telemetry": {"enabled": True, "trace_file": str(trace)}},
+            max_slots=1, cache_len=64)
+        srv.submit(_prompts((4,), seed=33)[0], max_new_tokens=2)
+        _drain(srv, clock)
+        srv.close()
+        srv.close()  # double close: no-op
+
+        class _Boom:
+            enabled = False
+
+            def close(self):
+                raise RuntimeError("writer already gone")
+
+        cb2, srv2 = _make(setup, clock=FakeClock(), max_slots=1, cache_len=64)
+        srv2._tele = _Boom()
+        srv2.close()  # a failing hub close is swallowed, not raised
+        srv2.close()

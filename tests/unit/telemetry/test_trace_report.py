@@ -80,9 +80,22 @@ def test_serve_table():
     assert table["overlap_frac"] == 0.7      # 1 - 0.6 / 2.0
     assert table["block_ms_per_token"] == 0.05
     assert table["wasted_tokens"] == 2 and table["inflight_max"] == 1
+    # recovery section from the serving_fault journal: one fault retried,
+    # one rebuild (42.5 ms, 1 in-flight tick lost, 3 re-admitted), one
+    # breaker close carrying the 55 ms outage
+    assert table["fault_events"] == 4
+    assert table["faults"] == 1 and table["fault_retries"] == 1
+    assert table["rebuilds"] == 1 and table["degraded_rebuilds"] == 0
+    assert table["lost_ticks"] == 1 and table["readmitted"] == 3
+    assert table["lost_requests"] == 0 and table["unrecoverable"] == 0
+    assert table["recovery_ms_p50"] == 42.5
+    assert table["recovery_ms_max"] == 42.5
+    assert table["outage_ms_total"] == 55.0
     text = ds_trace_report.format_serve_table(table)
     assert "serving summary" in text and "shed rate" in text
     assert "tick host" in text and "blocked/token" in text
+    assert "recovery" in text and "rebuilds 1" in text
+    assert "UNRECOVERABLE" not in text
 
 
 def test_serve_table_empty_without_serving_events():

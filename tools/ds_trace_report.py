@@ -172,7 +172,8 @@ def serve_table(events):
                 and e.get("path") == "serving"]
     lifecycle = [e for e in events if e.get("kind") == "serving_event"]
     ticks = [e for e in events if e.get("kind") == "serving_tick"]
-    if not finished and not lifecycle and not ticks:
+    faults = [e for e in events if e.get("kind") == "serving_fault"]
+    if not finished and not lifecycle and not ticks and not faults:
         return {}
     by_event = {}
     for e in lifecycle:
@@ -222,6 +223,39 @@ def serve_table(events):
                   if isinstance(e.get("inflight"), (int, float))]
         if depths:
             out["inflight_max"] = max(depths)
+    if faults:
+        # recovery section: serving_fault events are the fault-tolerance
+        # layer's journal — tick failures, retry outcomes, engine
+        # rebuilds (with recovery_ms + lost in-flight ticks), circuit-
+        # breaker transitions, terminal failures (docs/telemetry.md)
+        by_fault = {}
+        for e in faults:
+            by_fault.setdefault(e.get("event", "?"), []).append(e)
+        rebuilds = by_fault.get("rebuild", [])
+        out["fault_events"] = len(faults)
+        # a failed retry is another observed fault — this total matches
+        # serve_fault_total and ServingEngine.recovery_stats()["faults"]
+        out["faults"] = (len(by_fault.get("fault", []))
+                         + len(by_fault.get("retry_failed", [])))
+        out["fault_retries"] = (len(by_fault.get("retried", []))
+                                + len(by_fault.get("retry_failed", [])))
+        out["rebuilds"] = len(rebuilds)
+        out["degraded_rebuilds"] = sum(1 for e in rebuilds
+                                       if e.get("degraded") is True)
+        out["lost_ticks"] = sum(int(e.get("lost_ticks", 0)) for e in rebuilds)
+        out["readmitted"] = sum(int(e.get("readmitted", 0)) for e in rebuilds)
+        out["lost_requests"] = sum(1 for e in lifecycle
+                                   if e.get("reason") == "engine_lost")
+        out["unrecoverable"] = len(by_fault.get("unrecoverable", []))
+        rms = sorted(float(e["recovery_ms"]) for e in rebuilds
+                     if isinstance(e.get("recovery_ms"), (int, float))
+                     and not isinstance(e.get("recovery_ms"), bool))
+        if rms:
+            out["recovery_ms_p50"] = percentile(rms, 50.0)
+            out["recovery_ms_max"] = rms[-1]
+        out["outage_ms_total"] = round(sum(
+            float(e.get("outage_ms", 0.0)) for e in by_fault.get("breaker", [])
+            if e.get("state") == "closed"), 3)
     return out
 
 
@@ -259,6 +293,27 @@ def format_serve_table(table):
             tail.append(f"inflight<= {table['inflight_max']}")
         if tail:
             lines.append(f"                  {'   '.join(tail)}")
+    if "fault_events" in table:
+        line = (f"recovery          faults {table['faults']}"
+                f"   retries {table['fault_retries']}"
+                f"   rebuilds {table['rebuilds']}")
+        if table.get("degraded_rebuilds"):
+            line += f" ({table['degraded_rebuilds']} degraded)"
+        lines.append(line)
+        tail = []
+        if "recovery_ms_p50" in table:
+            tail.append(f"recovery_ms p50 {_fmt(table['recovery_ms_p50'])}"
+                        f" max {_fmt(table['recovery_ms_max'])}")
+        tail.append(f"lost ticks {table['lost_ticks']}")
+        tail.append(f"re-admitted {table['readmitted']}")
+        if table.get("lost_requests"):
+            tail.append(f"lost requests {table['lost_requests']}")
+        if table.get("outage_ms_total"):
+            tail.append(f"outage {_fmt(table['outage_ms_total'])} ms")
+        lines.append(f"                  {'   '.join(tail)}")
+        if table.get("unrecoverable"):
+            lines.append(f"                  UNRECOVERABLE terminal "
+                         f"failure(s): {table['unrecoverable']}")
     return "\n".join(lines) + "\n"
 
 
