@@ -153,9 +153,20 @@ class _Pool:
 
         self.n_slots = n_slots
         self.length = length
+        # the hub is resolved at FIRST DISPATCH, not here: a serving
+        # recovery factory builds replacement engines with telemetry off
+        # and injects the shared hub afterwards — jit compiles lazily, so
+        # the deferred wrap still journals the rebuild's compiles
+        from deepspeed_tpu.telemetry.compile_log import wrap_deferred
+
+        def get_tele(_engine=engine):
+            return _engine._eng.telemetry
+
         self.segment_fn, self.cache_sh, _ = compile_segment_fn(
             engine.mesh, engine.cfg, engine._eng.param_shardings, n_slots, length
         )
+        self.segment_fn = wrap_deferred(get_tele, self.segment_fn,
+                                        "pool_segment", (n_slots, length))
         self.cache = jax.device_put(
             tf.init_cache(engine.cfg, n_slots, length), self.cache_sh
         )
@@ -174,6 +185,8 @@ class _Pool:
         self.set_row_fn = compile_row_update_fn(engine.mesh, engine.cfg,
                                                 n_slots,
                                                 donate=engine.donate_cache)
+        self.set_row_fn = wrap_deferred(get_tele, self.set_row_fn,
+                                        "row_update", (n_slots,))
         # host DISPATCH mirrors: the position/emission count each row will
         # have reached once every dispatched tick retires. Exact for live
         # rows (a live row advances by exactly k per burst until done);
@@ -308,6 +321,19 @@ class ContinuousBatchingEngine:
         # rebuilds instead (bitwise-safe: see docs/serving.md recovery)
         self.poisoned = False
         self._tick_index = 0  # step() calls attempted (fault-plan clock)
+        # one memory_snapshot per engine generation: the live ops plane's
+        # HBM attribution baseline (serving recovery emits the "rebuild"
+        # one after re-injecting its hub into a replacement engine); the
+        # enabled guard keeps telemetry-off builds from walking the trees
+        if self._eng.telemetry.enabled:
+            self.memory_snapshot("build")
+
+    @property
+    def telemetry(self):
+        """The engine stack's ONE telemetry hub (owned by the inner
+        InferenceEngine; serving recovery re-injects it into replacement
+        engines so counters and the trace span generations)."""
+        return self._eng.telemetry
 
     # -- single-pool compatibility surface (tests, introspection) --------
     @property
@@ -335,6 +361,65 @@ class ContinuousBatchingEngine:
         """Total device bytes held by the slot-pool KV caches (the number
         the PERF.md bucketed-vs-fixed footprint table reports)."""
         return sum(p.kv_bytes() for p in self._pools)
+
+    def hbm_components(self) -> Dict[str, int]:
+        """PER-CHIP HBM attribution of everything this engine keeps
+        resident: params, the slot-pool KV caches plus registered prefix
+        caches (pinned KV), and the device-threaded tick state.
+        Metadata-only byte math (telemetry/memory.py leaf shard shapes —
+        a tensor-sharded cache counts 1/tp per chip), exact on the
+        virtual mesh and TPU alike; never blocks or fetches."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        kv = sum(hbm.tree_device_bytes(p.cache) for p in self._pools)
+        kv += sum(hbm.tree_device_bytes(pre["cache"])
+                  for pre in self._prefixes.values())
+        tick = sum(hbm.tree_device_bytes((p.last_tok_dev, p.done_dev))
+                   for p in self._pools)
+        return {"params": hbm.tree_device_bytes(self._eng.params),
+                "kv_cache": kv, "tick_state": tick}
+
+    def memory_snapshot(self, reason: str):
+        """Export the current HBM attribution (``hbm_bytes{component}``
+        gauges + one ``memory_snapshot`` trace event; docs/telemetry.md
+        "Live ops plane"). No-op returning None with telemetry off."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        return hbm.emit_snapshot(self._eng.telemetry, self.hbm_components(),
+                                 reason)
+
+    def analyze_program_memory(self) -> Dict[str, dict]:
+        """Per-tick-program-family ``compiled.memory_analysis()`` view
+        (temp/argument/output bytes) over every tick program built so
+        far. EXPENSIVE — one AOT lower+compile per family (the AOT cache
+        is separate from the dispatch cache), so this is an on-demand
+        diagnostic (tests, prewarm reports), never the hot path. Returns
+        {} per family on backends without the analysis (jax CPU)."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        out: Dict[str, dict] = {}
+        params_s = jax.tree.map(sds, self._eng.params)
+        key_s = sds(self._base_key)
+        for pi, pool in enumerate(self._pools):
+            cache_s = jax.tree.map(sds, pool.cache)
+            row = jax.ShapeDtypeStruct((pool.n_slots,), jnp.int32)
+            for (chunk, read_len), fn in pool.tick_fns.items():
+                args = [params_s, cache_s, row, row, row, row, row, row,
+                        key_s]
+                if chunk is not None:
+                    cvec = jax.ShapeDtypeStruct((chunk,), jnp.int32)
+                    args += [cvec, cvec, 0, row, row]
+                try:
+                    mem = hbm.program_memory(fn.lower(*args).compile())
+                except Exception:  # noqa: BLE001 — strictly best-effort AOT
+                    mem = {}
+                if mem:
+                    out[f"pool{pi}:len{pool.length}:chunk{chunk}:"
+                        f"read{read_len}"] = mem
+        return out
 
     # -- public API -----------------------------------------------------
     def validate_request(self, prompt_ids, max_new_tokens: int) -> np.ndarray:
@@ -654,6 +739,10 @@ class ContinuousBatchingEngine:
 
         tele = self._eng.telemetry
         if tele.enabled:
+            # tick-indexed jax.profiler window: profile_start_step counts
+            # SCHEDULER TICKS here (not train steps), so a device-trace
+            # capture can be pointed at the pooled-tick hot path
+            tele.maybe_capture(self._tick_index)
             reg = tele.registry
             # serving dashboards read pool pressure off this gauge: cached
             # tokens across live slots / total reserved slot capacity
@@ -711,12 +800,25 @@ class ContinuousBatchingEngine:
         evicted."""
         key = (chunk, read_len)
         if key not in pool.tick_fns:
-            pool.tick_fns[key] = compile_pool_tick_fn(
+            fn = compile_pool_tick_fn(
                 self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
                 pool.length, 1 if chunk is not None else self.tokens_per_tick,
                 self.temperature, self.top_k, self.top_p,
                 eos_token_id=self.eos_token_id, read_len=read_len,
                 chunk=chunk, donate=self.donate_cache)[0]
+            tele = self._eng.telemetry
+            if tele.enabled:
+                # compile flight recorder: the program's first dispatch
+                # journals a compile_event keyed by the full shapes key —
+                # a rebuilt engine re-compiling the family through the
+                # shared hub is flagged recompile (the runtime view of
+                # ds-lint's static recompile-hazard rule)
+                fn = tele.compile_recorder().wrap(
+                    fn, "pool_tick",
+                    (pool.length, pool.n_slots,
+                     1 if chunk is not None else self.tokens_per_tick,
+                     chunk, read_len))
+            pool.tick_fns[key] = fn
         return pool.tick_fns[key]
 
     def _dispatch_tick(self, pool: _Pool) -> Optional[_TickRecord]:

@@ -836,21 +836,28 @@ def cached_fn(holder, kind: str, key, builder, slots: int = 4):
     Hit/miss accounting rides along for telemetry: ``holder`` grows
     ``_compile_hits``/``_compile_misses`` ints (request events diff the
     miss count to tag compile-triggering requests), and a holder carrying
-    an enabled ``telemetry`` hub gets per-family labeled counters."""
+    an enabled ``telemetry`` hub gets per-family labeled counters. A miss
+    additionally arms the compile flight recorder (telemetry/
+    compile_log.py) on the fresh entry: its first dispatch — the one that
+    pays tracing + XLA compile — emits a ``compile_event`` keyed
+    (family=``kind``, shapes key), flagged ``recompile`` when this hub
+    compiled the same key before (LRU eviction churn made visible)."""
     cache = getattr(holder, "_fn_cache", None)
     if cache is None:
         cache = holder._fn_cache = {}
     family = cache.setdefault(kind, {})
     miss = key not in family
+    tele = getattr(holder, "telemetry", None)
     if miss:
         if len(family) >= slots:
             family.pop(next(iter(family)))  # evict least-recently-used
-        family[key] = builder()
+        from deepspeed_tpu.telemetry.compile_log import wrap_compiled
+
+        family[key] = wrap_compiled(tele, kind, key, builder())
     else:
         family[key] = family.pop(key)  # refresh recency (LRU, not FIFO)
     attr = "_compile_misses" if miss else "_compile_hits"
     setattr(holder, attr, getattr(holder, attr, 0) + 1)
-    tele = getattr(holder, "telemetry", None)
     if tele is not None and tele.enabled:
         tele.registry.counter(
             "compile_cache", {"kind": kind, "outcome": "miss" if miss else "hit"}

@@ -207,6 +207,15 @@ class InferenceEngine:
         # (B, max_len, alloc-bucket) shapes the migrating decode loop has
         # already traced — compile_cache_hit accounting (see generate())
         self._traced_geoms = set()
+        if self.telemetry.enabled:
+            # HBM baseline for the live ops plane: params are the only
+            # resident allocation at build time (decode caches are
+            # per-request; bucket migrations emit their own snapshots)
+            from deepspeed_tpu.telemetry import memory as hbm
+
+            hbm.emit_snapshot(self.telemetry,
+                              {"params": hbm.tree_device_bytes(self.params)},
+                              "build")
         log_dist(
             f"InferenceEngine ready: dtype={cfg.dtype} quant={self._weight_quant} "
             f"mesh={dict(mesh.shape)}",
@@ -277,6 +286,12 @@ class InferenceEngine:
             compile_decode_fns(self.mesh, self.cfg, self.param_shardings, batch_size, max_len)
         )
         self._compiled_shape = (batch_size, max_len)
+        if self.telemetry.enabled:
+            rec = self.telemetry.compile_recorder()
+            self._prefill_fn = rec.wrap(self._prefill_fn, "decode_prefill",
+                                        self._compiled_shape)
+            self._decode_fn = rec.wrap(self._decode_fn, "decode_step",
+                                       self._compiled_shape)
         # fresh jit objects hold no traces — geoms recorded against the
         # discarded pair must not claim their shapes are still compiled
         self._traced_geoms = set()
@@ -552,6 +567,7 @@ class InferenceEngine:
         final_alloc = (max_len if floor is None else
                        min(read_bucket(max(S + 1, total - 1), max_len, floor),
                            max_len))
+        fresh_allocs: set = set()
         if floor is not None:
             # honest compile accounting: the prefill/decode jit OBJECTS are
             # keyed (B, max_len), but migration retraces them per allocation
@@ -565,8 +581,14 @@ class InferenceEngine:
             if fresh:
                 self._compile_misses += 1
                 self._traced_geoms |= fresh
+                # allocation buckets whose migration dispatch will pay a
+                # real re-trace this request — the flight recorder only
+                # journals those (an already-traced bucket re-migrated by
+                # a later request dispatches from the jit cache)
+                fresh_allocs = {g[2] for g in fresh}
         decode_fn = (self._decode_fn if floor is None
-                     else self._migrating_decode_fn(max_len, floor))
+                     else self._migrating_decode_fn(max_len, floor,
+                                                    fresh_allocs))
         cache = jax.device_put(tf.init_cache(self.cfg, B, alloc), self._cache_sharding)
         t0 = time.time()
         result = decode_loop(
@@ -588,7 +610,8 @@ class InferenceEngine:
         """The tight-read bucket floor, or None when the knob is off."""
         return self.config.kv_read_floor if self.config.kv_tight_read else None
 
-    def _migrating_decode_fn(self, max_len: int, floor: int):
+    def _migrating_decode_fn(self, max_len: int, floor: int,
+                             fresh_allocs: Optional[set] = None):
         """Wrap the compiled decode step with bucket-migrated cache growth:
         when the write position reaches the current allocation, one jitted
         pad (memoized per target length) migrates the cache to the next
@@ -598,13 +621,75 @@ class InferenceEngine:
         from deepspeed_tpu.inference.decoding import read_bucket
         from deepspeed_tpu.models.transformer import cache_alloc_len
 
+        fresh = set() if fresh_allocs is None else fresh_allocs
+        first = True
+
         def dispatch(params, tok, cache, pos):
+            nonlocal first
             if pos + 1 > cache_alloc_len(cache):
-                cache = self._grow_cache(
-                    cache, min(read_bucket(pos + 1, max_len, floor), max_len))
+                new_len = min(read_bucket(pos + 1, max_len, floor), max_len)
+                cache = self._grow_cache(cache, new_len)
+                if self.telemetry.enabled:
+                    # every migration snapshots the grown allocation; the
+                    # decode jit RE-TRACES only at an untraced bucket —
+                    # that runtime recompile is what the flight recorder
+                    # journals (each fresh bucket compiles exactly once)
+                    retrace = new_len in fresh
+                    fresh.discard(new_len)
+                    return self._migrated_decode(params, tok, cache, pos,
+                                                 new_len, retrace)
+            if first:
+                # a request can also pay a re-trace at its STARTING bucket
+                # (a longer prompt opening an untraced allocation, no
+                # migration involved) — journal that compile too, unless
+                # the decode fn's own first-call timer is still armed (the
+                # genuine first compile, which records itself)
+                first = False
+                start_alloc = cache_alloc_len(cache)
+                if (start_alloc in fresh and self.telemetry.enabled
+                        and getattr(self._decode_fn, "_done", True)):
+                    fresh.discard(start_alloc)
+                    return self._timed_decode_retrace(params, tok, cache,
+                                                      pos, start_alloc)
+                fresh.discard(start_alloc)
             return self._decode_fn(params, tok, cache, pos)
 
         return dispatch
+
+    def _migrated_decode(self, params, tok, cache, pos, new_len: int,
+                         retrace: bool):
+        """First decode dispatch after a bucket migration: emit the
+        ``memory_snapshot`` (reason ``migration``) for the grown
+        allocation and — when this bucket is genuinely untraced — journal
+        the decode re-trace as a compile_event under the same family+key
+        as the original ``decode_step`` compile, so the event is
+        recompile-flagged (the visible counter behind runtime recompile
+        storms)."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        hbm.emit_snapshot(self.telemetry, {
+            "params": hbm.tree_device_bytes(self.params),
+            "kv_cache": hbm.tree_device_bytes(cache),
+        }, "migration")
+        if not retrace:
+            return self._decode_fn(params, tok, cache, pos)
+        return self._timed_decode_retrace(params, tok, cache, pos, new_len)
+
+    def _timed_decode_retrace(self, params, tok, cache, pos, alloc: int):
+        """Dispatch one decode step that is known to pay a runtime
+        re-trace (an untraced allocation bucket) and journal it as a
+        compile_event under the same family+key as the original
+        ``decode_step`` compile — recompile-flagged, ``cache_alloc``
+        attached (the visible counter behind runtime recompile storms)."""
+        rec = self.telemetry.compile_recorder()
+        t0 = time.perf_counter()
+        out = self._decode_fn(params, tok, cache, pos)
+        # dispatch blocks through the re-trace + XLA compile and returns
+        # futures — the span is compile cost, not execution, by design
+        rec.record("decode_step", self._compiled_shape,
+                   # ds-lint: disable=unsynced-timing
+                   (time.perf_counter() - t0) * 1000.0, cache_alloc=alloc)
+        return out
 
     def _grow_cache(self, cache, new_len: int):
         """Migrate a KV cache to a longer time axis (zero-padded tail; the

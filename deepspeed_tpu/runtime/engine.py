@@ -527,6 +527,13 @@ class TpuEngine:
         _act_ckpt.configure(deepspeed_config=config)
 
         self._compile_step_fns()
+        if self.telemetry.enabled:
+            try:
+                # HBM baseline for the live ops plane (params / optimizer
+                # state / grad accumulators, per chip)
+                self.memory_snapshot("build")
+            except Exception as e:  # noqa: BLE001 — telemetry must never kill engine build
+                logger.warning(f"telemetry memory snapshot failed: {e}")
         log_dist(
             f"TpuEngine ready: zero_stage={self.zero_stage} dtype={self.model_dtype.__name__} "
             f"mesh={dict(mesh.shape)} micro_bs={self.train_micro_batch_size_per_gpu} "
@@ -766,7 +773,7 @@ class TpuEngine:
                 new_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32) / predivide, grad_acc, grads)
                 return loss / scale, new_acc
 
-            return jax.jit(
+            fn = jax.jit(
                 micro_fn,
                 donate_argnums=(1,),
                 in_shardings=(
@@ -774,6 +781,14 @@ class TpuEngine:
                 ),
                 out_shardings=(self.replicated, self.grad_shardings),
             )
+            if self.telemetry.enabled:
+                # compile flight recorder: the first dispatch of each
+                # (ltd grid point) micro program journals a compile_event
+                # (LTD shape churn shows up as train_micro recompiles)
+                fn = self.telemetry.compile_recorder().wrap(
+                    fn, "train_micro",
+                    (self.train_micro_batch_size_per_gpu, gas, ltd_keep_len))
+            return fn
 
         self._micro_builder = build_micro
         self._micro_jits = {None: build_micro(None)}
@@ -854,6 +869,47 @@ class TpuEngine:
                 self.replicated,
             ),
         )
+        if self.telemetry.enabled:
+            self._apply_fn = self.telemetry.compile_recorder().wrap(
+                self._apply_fn, "train_apply",
+                (self.train_micro_batch_size_per_gpu, gas))
+
+    # ------------------------------------------------------------------
+    # HBM accounting (telemetry/memory.py — the live ops plane)
+    # ------------------------------------------------------------------
+    def hbm_components(self) -> dict:
+        """PER-CHIP HBM attribution of the training state: params,
+        optimizer state (fp32 masters + optimizer moments), and the
+        gradient accumulators. Metadata-only shard-shape byte math —
+        host-offloaded trees (numpy leaves) contribute 0, which is
+        exactly right: they are not HBM."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        comps = {"params": hbm.tree_device_bytes(self.params)}
+        opt = (hbm.tree_device_bytes(getattr(self, "master_params", None))
+               + hbm.tree_device_bytes(getattr(self, "opt_state", None)))
+        if opt:
+            comps["optimizer_state"] = opt
+        grads = hbm.tree_device_bytes(getattr(self, "grad_acc", None))
+        if grads:
+            comps["grads"] = grads
+        return comps
+
+    def memory_snapshot(self, reason: str = "build"):
+        """Export the training-state HBM attribution as
+        ``hbm_bytes{component}`` gauges + one ``memory_snapshot`` trace
+        event. When the AOT micro-program artifact exists (the flops/MFU
+        path built it), its ``memory_analysis()`` rides along as the
+        per-program scratch view. No-op with telemetry off."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        programs = None
+        if self._micro_cost_cache is not None:
+            mem = hbm.program_memory(self._micro_cost_cache[1])
+            if mem:
+                programs = {"train_micro": mem}
+        return hbm.emit_snapshot(self.telemetry, self.hbm_components(),
+                                 reason, programs=programs)
 
     # ------------------------------------------------------------------
     # data plumbing

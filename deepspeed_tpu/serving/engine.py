@@ -209,6 +209,9 @@ class ServingEngine:
             raise ValueError("kv_budget_tokens must be >= 1")
         self.aging_s = aging_s
         self._clock = clock
+        self._created = clock()   # uptime zero for /statusz
+        self._draining = False    # drain(): admission closed, work finishes
+        self._ops_server = None   # live ops plane (start_ops_server)
         self._tele = engine._eng.telemetry
         self._queue: List[ServeRequest] = []
         self._running: Dict[int, ServeRequest] = {}   # engine rid -> request
@@ -258,6 +261,10 @@ class ServingEngine:
         now = self._clock()
         if self._t_start is None:
             self._t_start = now
+        if self._draining:
+            # the replica is being removed from the fleet: no retry hint —
+            # the client must go to another replica, not wait for this one
+            return self._shed("draining", prompt, need, now, no_hint=True)
         if self._breaker_open:
             # honest degradation: during an outage admission answers
             # immediately with a load-shed verdict + recovery ETA rather
@@ -513,6 +520,10 @@ class ServingEngine:
         new._eng.telemetry = self._tele
         new.request_event_hook = self._event_hook
         new.fault_hook = old_hook
+        # the replacement's HBM attribution, through the adopted hub (its
+        # own build snapshot went to the factory's disabled telemetry):
+        # a degraded-mesh rebuild's changed per-chip footprint is visible
+        new.memory_snapshot("rebuild")
         if self._pipeline_depth is not None:
             new.pipeline_depth = self._pipeline_depth
         if cfg.fetch_timeout_s is not None:
@@ -722,6 +733,126 @@ class ServingEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    # -- live ops plane (docs/telemetry.md "Live ops plane") -------------
+    def drain(self):
+        """Stop admission while queued + running work runs to completion
+        — the fleet-router precondition for removing a replica: after
+        ``drain()``, ``submit`` sheds with reason ``"draining"`` (no
+        retry hint: clients must go elsewhere), ``/healthz`` answers 503,
+        and ``step()`` keeps serving until ``has_work()`` is False —
+        in-flight streams finish bitwise-intact. Idempotent; ``resume()``
+        reopens admission."""
+        if self._draining:
+            return
+        self._draining = True
+        if self._tele.enabled:
+            self._tele.emit("serving_event", {
+                "event": "drain", "queue_depth": len(self._queue),
+                "running": len(self._running)})
+
+    def resume(self):
+        """Reopen admission after :meth:`drain` (replica back in rotation)."""
+        if not self._draining:
+            return
+        self._draining = False
+        if self._tele.enabled:
+            self._tele.emit("serving_event", {"event": "resume"})
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def health(self) -> str:
+        """One-word replica health for ``/healthz``:
+
+        - ``"recovering"`` — the circuit breaker is open (engine lost,
+          the PR 7 recovery ladder is running); closes on the first
+          healthy tick of a replacement.
+        - ``"poisoned"`` — the engine marked its state untrustworthy and
+          NO recovery is armed to replace it: operator intervention.
+        - ``"draining"`` — admission closed, in-flight work finishing.
+        - ``"ok"`` — take traffic.
+
+        Only ``"ok"`` answers HTTP 200 on ``/healthz``."""
+        if self._breaker_open:
+            return "recovering"
+        if getattr(self._cb, "poisoned", False):
+            return "poisoned"
+        if self._draining:
+            return "draining"
+        return "ok"
+
+    def statusz(self) -> dict:
+        """One JSON-shaped snapshot for ``/statusz``: health, uptime,
+        pool occupancy, queue depth, committed KV tokens, in-flight tick
+        depth, tick overlap accounting, recovery generation, and the
+        per-chip HBM attribution. Read-only and safe to call from the
+        ops-server thread: every shared container is atomically copied
+        (dict/list copies are single C-level ops under the GIL) before
+        iteration, so a concurrent ``step()`` can never torn-read it."""
+        now = self._clock()
+        queue = list(self._queue)
+        running = list(dict(self._running).values())
+        requests = list(dict(self._requests).values())
+        counts: Dict[str, int] = {}
+        for r in requests:
+            counts[r.state] = counts.get(r.state, 0) + 1
+        stats = self.tick_stats()
+        out = {
+            "health": self.health(),
+            "uptime_s": round(now - self._created, 3),
+            "draining": self._draining,
+            "pools": self._cb.pool_state(),
+            "queue_depth": len(queue),
+            "running": len(running),
+            "requests": counts,
+            "committed_kv_tokens": (sum(r.need_tokens for r in queue)
+                                    + sum(r.need_tokens for r in running)),
+            "kv_budget_tokens": self.kv_budget_tokens,
+            "inflight_depth": len(self._cb._inflight),
+            "pipeline_depth": self._cb.pipeline_depth,
+            "ticks": stats.get("ticks", 0),
+            "overlap_frac": stats.get("overlap_frac"),
+            "block_ms_per_token": stats.get("block_ms_per_token"),
+            "recovery_generation": self._rebuild_count,
+            "breaker_open": self._breaker_open,
+        }
+        try:
+            from deepspeed_tpu.telemetry import memory as hbm
+
+            comps = self._cb.hbm_components()
+            out["hbm_bytes"] = comps
+            headroom = hbm.headroom_bytes(self._tele, comps)
+            if headroom is not None:
+                out["hbm_headroom_bytes"] = headroom
+        except Exception:  # noqa: BLE001 — status must render even mid-rebuild
+            pass
+        return out
+
+    def hbm_headroom_bytes(self) -> Optional[int]:
+        """Per-chip HBM headroom (configured/backend limit minus the live
+        attribution) — the number an admission policy or the fleet router
+        consults before placing more KV on this replica. None when no
+        limit is known (the CPU virtual mesh without an override)."""
+        from deepspeed_tpu.telemetry import memory as hbm
+
+        return hbm.headroom_bytes(self._tele, self._cb.hbm_components())
+
+    def start_ops_server(self, port: int = 0, host: str = "127.0.0.1"):
+        """Serve ``/metrics`` (Prometheus), ``/healthz`` and ``/statusz``
+        for this replica on a daemon thread (telemetry/ops_server.py).
+        ``port=0`` binds an ephemeral port — read it from the returned
+        server's ``.port``/``.url``. Idempotent (returns the live
+        server); ``close()`` shuts it down."""
+        if self._ops_server is not None:
+            return self._ops_server
+        from deepspeed_tpu.telemetry.ops_server import OpsServer
+
+        self._ops_server = OpsServer(
+            registry=self._tele.registry, health=self.health,
+            status=self.statusz, host=host, port=port).start()
+        return self._ops_server
+
     def committed_tokens(self) -> int:
         """Prompt+output tokens committed by queued + running requests —
         what admission weighs against ``kv_budget_tokens``."""
@@ -783,6 +914,9 @@ class ServingEngine:
         if self._closed:
             return
         self._closed = True
+        if self._ops_server is not None:
+            self._ops_server.close()  # never raises
+            self._ops_server = None
         try:
             self._tele.close()
         except Exception as e:  # noqa: BLE001 — shutdown must not raise
@@ -826,8 +960,9 @@ class ServingEngine:
 
     # -- internals ------------------------------------------------------
     def _shed(self, reason: str, prompt, need: int, now: float,
-              excess: Optional[int] = None) -> Admission:
-        hint = self._retry_after(need if excess is None else excess, now)
+              excess: Optional[int] = None, no_hint: bool = False) -> Admission:
+        hint = (None if no_hint
+                else self._retry_after(need if excess is None else excess, now))
         if self._tele.enabled:
             self._tele.registry.counter("serve_shed_total").inc()
             event = {"event": "shed", "reason": reason,

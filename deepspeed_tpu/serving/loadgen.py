@@ -576,6 +576,12 @@ def main(argv=None) -> int:
     p.add_argument("--kv-budget", type=int, default=None,
                    help="KV token budget (default: 2x pool capacity)")
     p.add_argument("--aging-s", type=float, default=30.0)
+    p.add_argument("--ops-port", type=int, default=None, metavar="PORT",
+                   help="serve the live ops plane (/metrics /healthz "
+                        "/statusz — docs/telemetry.md) during the run; "
+                        "0 binds an ephemeral port (printed at start). "
+                        "The exporter runs on a daemon thread and never "
+                        "blocks the tick loop")
     p.add_argument("--trace-out", default=None,
                    help="telemetry JSONL destination; summarize with "
                         "`ds_trace_report.py --serve`")
@@ -638,6 +644,11 @@ def main(argv=None) -> int:
             cfg["mesh"] = {"shape": mesh_shape}
         if trace_out:
             cfg["telemetry"] = {"enabled": True, "trace_file": trace_out}
+        elif args.ops_port is not None:
+            # --ops-port without --trace-out: /metrics still needs a live
+            # registry (gauges/counters/histograms are telemetry-gated),
+            # so enable the hub registry-only — no trace file written
+            cfg["telemetry"] = {"enabled": True, "trace_file": ""}
         engine_kwargs = {}
         if args.buckets:
             engine_kwargs["cache_buckets"] = _parse_buckets(args.buckets)
@@ -679,6 +690,10 @@ def main(argv=None) -> int:
     def one_run(depth: int, trace_out=None, mesh_shape=None):
         serving = build_serving(depth, trace_out=trace_out,
                                 mesh_shape=mesh_shape)
+        if args.ops_port is not None:
+            ops = serving.start_ops_server(port=args.ops_port)
+            print(f"ops server live at {ops.url} "
+                  f"(/metrics /healthz /statusz)")
         records, wall_s = run_load(serving, workload, arrivals, seed=args.seed)
         summary = summarize(records, wall_s, tick_stats=serving.tick_stats())
         if chaos_plan is not None:
@@ -688,7 +703,9 @@ def main(argv=None) -> int:
                 injected=getattr(injector, "fired", None))
         if mesh_shape:
             summary["mesh"] = dict(mesh_shape)
-        if trace_out:
+        if trace_out or args.ops_port is not None:
+            # close releases the exporter port so the next A/B side (or a
+            # fixed --ops-port rerun) can bind it again
             serving.close()
         return summary
 

@@ -10,10 +10,16 @@ Entry points:
     (``"schema": 1``) consumed by ``tools/ds_trace_report.py``.
 """
 
+from deepspeed_tpu.telemetry.compile_log import CompileRecorder
 from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.ops_server import OpsServer, render_prometheus
 from deepspeed_tpu.telemetry.registry import MetricsRegistry, metric_key, percentile
 from deepspeed_tpu.telemetry.telemetry import Telemetry
 from deepspeed_tpu.telemetry.trace import SCHEMA_VERSION, TraceWriter, read_trace
+
+# deepspeed_tpu.telemetry.memory (the HBM accountant) is deliberately NOT
+# imported here: it touches jax, and this package must stay importable by
+# the jax-free tools (ds_trace_report, the ops-server tests).
 
 __all__ = [
     "Telemetry",
@@ -24,4 +30,7 @@ __all__ = [
     "metric_key",
     "percentile",
     "SCHEMA_VERSION",
+    "OpsServer",
+    "render_prometheus",
+    "CompileRecorder",
 ]

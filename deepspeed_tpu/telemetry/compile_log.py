@@ -1,0 +1,158 @@
+"""Compile flight recorder: every compiled-program build in the decode /
+serving / training stacks emits a ``compile_event`` trace record plus a
+``compile_ms{family=...}`` histogram, so runtime recompile storms — the
+thing ds-lint's static recompile-hazard rule can only guess at — become
+a visible counter on ``/metrics``.
+
+Mechanism: ``jax.jit`` compiles lazily at the first dispatch, so the
+recorder wraps a freshly built jitted callable and times that FIRST call
+(dispatch blocks through tracing + XLA compile, then returns futures —
+the measured span is compile cost, not execution). Every later call goes
+straight through with one flag check of overhead. The ``recompile`` flag
+is keyed on ``(family, shapes key)`` per telemetry hub: the hub survives
+serving-engine rebuilds (PR 7 re-injects it into replacement engines),
+so an LRU-evicted-and-rebuilt program family or a rebuilt engine's
+re-compiles are flagged ``recompile: true`` while genuinely new shapes
+are first compiles.
+"""
+
+import time
+from typing import Optional
+
+
+class _FirstCallTimer:
+    """Callable wrapper timing only the first invocation (the one that
+    pays tracing + XLA compile). Forwards attribute access to the wrapped
+    function so AOT surfaces (``.lower``) keep working."""
+
+    __slots__ = ("_fn", "_recorder", "_family", "_key", "_fields", "_done")
+
+    def __init__(self, fn, recorder, family, key, fields):
+        self._fn = fn
+        self._recorder = recorder
+        self._family = family
+        self._key = key
+        self._fields = fields
+        self._done = False
+
+    def __call__(self, *args, **kwargs):
+        if self._done:
+            return self._fn(*args, **kwargs)
+        self._done = True
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        # the first dispatch of a jitted fn blocks through trace + XLA
+        # compile and returns execution FUTURES — the unsynced span IS the
+        # compile cost, by design
+        self._recorder.record(self._family, self._key,
+                              # ds-lint: disable=unsynced-timing
+                              (time.perf_counter() - t0) * 1000.0,
+                              **self._fields)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+
+class _DeferredFirstCallTimer:
+    """Like :class:`_FirstCallTimer`, but resolves the telemetry hub at
+    FIRST CALL instead of wrap time — for programs built before a shared
+    hub is injected. Serving recovery builds replacement engines with the
+    factory's telemetry off and re-injects the serving hub afterwards;
+    ``jax.jit`` compiles lazily, so the first dispatch (the compile this
+    recorder exists to journal) lands after injection. A hub still
+    disabled at first call records nothing and the wrapper degrades to a
+    plain passthrough."""
+
+    __slots__ = ("_fn", "_get_tele", "_family", "_key", "_done")
+
+    def __init__(self, fn, get_tele, family, key):
+        self._fn = fn
+        self._get_tele = get_tele
+        self._family = family
+        self._key = key
+        self._done = False
+
+    def __call__(self, *args, **kwargs):
+        if self._done:
+            return self._fn(*args, **kwargs)
+        self._done = True
+        tele = self._get_tele()
+        if tele is None or not tele.enabled:
+            return self._fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        tele.compile_recorder().record(
+            self._family, self._key,
+            # first dispatch blocks through trace + XLA compile, returns
+            # futures — the unsynced span IS the compile cost, by design
+            # ds-lint: disable=unsynced-timing
+            (time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+
+def wrap_deferred(get_telemetry, fn, family: str, key):
+    """Arm ``fn`` to journal its first dispatch against whatever hub
+    ``get_telemetry()`` resolves to AT THAT MOMENT (see
+    :class:`_DeferredFirstCallTimer`)."""
+    return _DeferredFirstCallTimer(fn, get_telemetry, family, key)
+
+
+class CompileRecorder:
+    """Per-telemetry-hub compile journal. ``record`` emits one
+    ``compile_event`` (family, shapes key, compile_ms, first-vs-recompile)
+    and folds the duration into ``compile_ms{family=...}``; ``wrap`` arms
+    a freshly built jitted callable so its first dispatch records
+    itself."""
+
+    def __init__(self, telemetry):
+        self._tele = telemetry
+        self._seen = set()
+
+    def record(self, family: str, key, compile_ms: float, **fields) -> bool:
+        """Journal one compile. Returns the recompile flag (True when
+        this (family, key) compiled before under this hub)."""
+        ident = (family, str(key))
+        recompile = ident in self._seen
+        self._seen.add(ident)
+        tele = self._tele
+        if tele.enabled:
+            reg = tele.registry
+            reg.histogram("compile_ms", {"family": family}).observe(compile_ms)
+            reg.counter("compile_event_total", {"family": family}).inc()
+            if recompile:
+                reg.counter("recompile_total", {"family": family}).inc()
+            event = {"family": family, "key": str(key),
+                     "compile_ms": round(compile_ms, 3),
+                     "recompile": recompile}
+            event.update(fields)
+            tele.emit("compile_event", event)
+        return recompile
+
+    def wrap(self, fn, family: str, key, **fields):
+        """Arm ``fn`` (a freshly built jitted callable) to record its
+        first dispatch as a compile. With telemetry disabled the function
+        is returned untouched — zero hot-path cost."""
+        if not self._tele.enabled:
+            return fn
+        return _FirstCallTimer(fn, self, family, key, fields)
+
+
+def wrap_compiled(telemetry, family: str, key, value):
+    """Arm the recorder on a compiled-fn cache entry as ``cached_fn``
+    builds it: a bare callable wraps directly; a tuple entry wraps its
+    leading callable (the convention every cached_fn builder follows —
+    ``(fn, cache_sharding, ...)``). Anything else passes through."""
+    if telemetry is None or not telemetry.enabled:
+        return value
+    rec = telemetry.compile_recorder()
+    if isinstance(value, tuple):
+        if value and callable(value[0]):
+            return (rec.wrap(value[0], family, key),) + value[1:]
+        return value
+    if callable(value):
+        return rec.wrap(value, family, key)
+    return value

@@ -37,8 +37,16 @@ class TelemetryConfig:
     # for anything else.
     peak_tflops_per_device: float = 0.0
     # jax.profiler device-trace capture window: start at this global step
-    # (0 = never) and run for profile_num_steps steps. The xplane dump
-    # lands in profile_dir (default: alongside the trace file).
+    # (0 = never) and run for profile_num_steps steps. On the serving
+    # tick loop the window is TICK-indexed (the continuous engine drives
+    # maybe_capture once per scheduler tick), so a capture can be pointed
+    # at the pooled-tick hot path. The xplane dump lands in profile_dir
+    # (default: alongside the trace file).
     profile_start_step: int = 0
     profile_num_steps: int = 1
     profile_dir: str = ""
+    # per-device HBM capacity override (bytes) for the hbm_headroom_bytes
+    # gauge and memory_snapshot events. 0 = use the backend allocator's
+    # bytes_limit when it reports one (TPU), else headroom is unknown
+    # and the gauge is simply absent (the CPU virtual mesh).
+    hbm_limit_bytes: int = 0

@@ -1,0 +1,153 @@
+"""HBM memory accounting: attribute device bytes to named components
+(params / KV cache / optimizer state / scratch) from live arrays, and
+export the attribution as ``hbm_bytes{component=...}`` gauges plus a
+``memory_snapshot`` trace event (emitted on engine build, rebuild, and
+bucket migration).
+
+All byte math here is PER-CHIP and metadata-only: a leaf's contribution
+is its shard shape under its actual sharding (``sharding.shard_shape``)
+times the dtype width — no device buffer is touched, no fetch happens,
+and the numbers are exact on both the virtual CPU mesh and real TPUs
+(each device of a NamedSharding holds exactly one shard; replicated
+leaves contribute their full size). Per-chip is the quantity that
+matters: HBM pressure is per device, and the admission headroom a
+serving replica consults is the headroom of its fullest chip.
+
+``scratch`` is the live residual ``bytes_in_use - sum(components)`` when
+the backend reports allocator stats (TPU does; the CPU backend does
+not), i.e. everything the accountant cannot attribute — XLA temp
+buffers, donated-copy slack, other engines in the process. On backends
+without allocator stats the component is simply absent rather than
+guessed. :func:`program_memory` additionally reads a compiled program's
+``memory_analysis()`` (temp/argument/output bytes) where the backend
+implements it — the per-program-family view of scratch.
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def leaf_device_bytes(leaf) -> int:
+    """Bytes ONE device holds of ``leaf`` — the per-shard footprint under
+    the leaf's actual sharding. 0 for host (numpy) leaves and anything
+    without a device placement. Metadata-only: never blocks, never
+    fetches, safe on in-flight (async-dispatched) arrays."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or not hasattr(leaf, "dtype"):
+        return 0  # host array / non-array leaf: not HBM
+    try:
+        shard_shape = sharding.shard_shape(leaf.shape)
+    except Exception:  # noqa: BLE001 — exotic shardings fall back to global
+        shard_shape = leaf.shape
+    return int(np.prod(shard_shape, dtype=np.int64)) * np.dtype(leaf.dtype).itemsize
+
+
+def tree_device_bytes(tree) -> int:
+    """Per-chip bytes of a whole pytree (params, a KV cache, opt state);
+    int8-quantized ``{"q8", "s"}`` leaves are plain leaves here."""
+    import jax
+
+    return sum(leaf_device_bytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def device_memory_limit(override_bytes: int = 0) -> Optional[int]:
+    """Per-device memory capacity for the headroom gauge: the explicit
+    telemetry override when set, else the backend allocator's
+    ``bytes_limit`` (TPU), else None (unknown — the CPU virtual mesh has
+    no meaningful HBM limit unless the config declares one)."""
+    if override_bytes:
+        return int(override_bytes)
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        return limit or None
+    except Exception:  # noqa: BLE001 — stats are strictly best-effort
+        return None
+
+
+def device_bytes_in_use() -> Optional[int]:
+    """Live allocator ``bytes_in_use`` on device 0, or None where the
+    backend keeps no stats (CPU) — feeds the ``scratch`` residual."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        used = int(stats.get("bytes_in_use", 0))
+        return used or None
+    except Exception:  # noqa: BLE001 — stats are strictly best-effort
+        return None
+
+
+def program_memory(compiled) -> Dict[str, int]:
+    """Per-program memory attribution from a compiled executable's
+    ``memory_analysis()`` — temp (scratch), argument, output, and code
+    bytes. Empty dict where the backend does not implement the analysis
+    (jax CPU) so callers can merge it opportunistically."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — optional backend surface
+        return {}
+    if mem is None:
+        return {}
+    out = {}
+    for field, name in (("temp_size_in_bytes", "temp_bytes"),
+                        ("argument_size_in_bytes", "argument_bytes"),
+                        ("output_size_in_bytes", "output_bytes"),
+                        ("generated_code_size_in_bytes", "code_bytes")):
+        v = getattr(mem, field, None)
+        if isinstance(v, int):
+            out[name] = v
+    return out
+
+
+def emit_snapshot(telemetry, components: Dict[str, int], reason: str,
+                  programs: Optional[Dict[str, dict]] = None) -> Optional[dict]:
+    """Export one HBM attribution: set the ``hbm_bytes{component=...}`` /
+    ``hbm_total_bytes`` / ``hbm_headroom_bytes`` gauges and emit a
+    ``memory_snapshot`` trace event. ``reason`` names the trigger
+    (``build`` / ``rebuild`` / ``migration``). When the allocator reports
+    live usage above the attributed total, the residual lands in a
+    ``scratch`` component. Returns the emitted event (None when
+    telemetry is disabled)."""
+    if telemetry is None or not telemetry.enabled:
+        return None
+    components = {k: int(v) for k, v in components.items()}
+    total = sum(components.values())
+    in_use = device_bytes_in_use()
+    if in_use is not None and in_use > total:
+        components["scratch"] = in_use - total
+        total = in_use
+    reg = telemetry.registry
+    for name, b in components.items():
+        reg.gauge("hbm_bytes", {"component": name}).set(b)
+    reg.gauge("hbm_total_bytes").set(total)
+    event = {"reason": reason, "total_bytes": total, "components": components}
+    limit = device_memory_limit(getattr(telemetry.cfg, "hbm_limit_bytes", 0))
+    if limit:
+        headroom = limit - total
+        event["limit_bytes"] = limit
+        event["headroom_bytes"] = headroom
+        reg.gauge("hbm_headroom_bytes").set(headroom)
+    if programs:
+        event["programs"] = programs
+    telemetry.emit("memory_snapshot", event)
+    return event
+
+
+def headroom_bytes(telemetry, components: Dict[str, int]) -> Optional[int]:
+    """Point-in-time headroom (limit - attributed-or-live bytes) for the
+    admission path / ``/statusz`` — same math as :func:`emit_snapshot`
+    without touching gauges or the trace. None when no limit is known."""
+    limit = device_memory_limit(
+        getattr(getattr(telemetry, "cfg", None), "hbm_limit_bytes", 0)
+        if telemetry is not None else 0)
+    if not limit:
+        return None
+    total = sum(int(v) for v in components.values())
+    in_use = device_bytes_in_use()
+    if in_use is not None and in_use > total:
+        total = in_use
+    return limit - total

@@ -51,8 +51,10 @@ class Telemetry:
         self.monitor = monitor
         self.registry = MetricsRegistry()
         self._writer = None
+        self._write_warned = False
         self._profiling = False
         self._peak_flops_per_device = None
+        self._compile_recorder = None
         if self.enabled and self.cfg.trace_file:
             import jax
 
@@ -79,8 +81,21 @@ class Telemetry:
             try:
                 self._writer.write(kind, event)
             except OSError as e:  # telemetry must never kill the step loop
-                logger.warning(f"telemetry trace write failed: {e}")
-                self._writer = None
+                # a transient disk hiccup must not permanently blind the
+                # trace: count it, warn ONCE (not per event), and drop the
+                # file handle so the NEXT emit retries through the lazy
+                # reopen — while the disk stays broken each emit fails
+                # into this branch again (counter grows, no log spam)
+                self.registry.counter("trace_write_errors").inc()
+                if not self._write_warned:
+                    logger.warning(
+                        f"telemetry trace write failed (will retry on the "
+                        f"next event; trace_write_errors counts drops): {e}")
+                    self._write_warned = True
+                try:
+                    self._writer.close()
+                except OSError:
+                    self._writer._fh = None  # force the lazy reopen anyway
         if (monitor_prefix and self.cfg.emit_to_monitor
                 and self.monitor is not None and self.monitor.enabled):
             step = int(monitor_step if monitor_step is not None
@@ -92,6 +107,18 @@ class Telemetry:
         event.setdefault("schema", SCHEMA_VERSION)
         event.setdefault("kind", kind)
         return event
+
+    # ------------------------------------------------------------------
+    def compile_recorder(self):
+        """The hub's compile flight recorder (telemetry/compile_log.py),
+        created lazily and shared across engine generations — a serving
+        rebuild re-injects this hub, so the replacement engine's compiles
+        are correctly flagged as recompiles."""
+        if self._compile_recorder is None:
+            from deepspeed_tpu.telemetry.compile_log import CompileRecorder
+
+            self._compile_recorder = CompileRecorder(self)
+        return self._compile_recorder
 
     # ------------------------------------------------------------------
     def peak_flops_per_device(self) -> float:

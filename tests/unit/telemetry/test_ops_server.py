@@ -1,0 +1,166 @@
+"""Live ops plane (telemetry/ops_server.py): golden Prometheus text
+rendering (escaping, label ordering, quantile gauges), the threaded HTTP
+exporter's three endpoints + error behavior, and the trace-writer
+resilience satellite (a transient OSError must not permanently blind the
+trace)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.telemetry import MetricsRegistry, OpsServer, render_prometheus
+from deepspeed_tpu.telemetry.ops_server import _parse_key, _sanitize
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# -- rendering ---------------------------------------------------------
+def test_render_prometheus_golden():
+    """Exact text: counters, labeled counters, gauges, and histograms as
+    summaries — names sorted, labels sorted, quantile appended last."""
+    reg = MetricsRegistry()
+    reg.counter("serve_admitted_total").inc(3)
+    reg.counter("compile_cache", {"outcome": "miss", "kind": "decode"}).inc()
+    reg.gauge("hbm_bytes", {"component": "params"}).set(1048576)
+    reg.gauge("hbm_bytes", {"component": "kv_cache"}).set(262144)
+    h = reg.histogram("tick_block_ms")
+    h.observe(1.0)
+    h.observe(3.0)
+    assert render_prometheus(reg.dump()) == (
+        "# TYPE compile_cache counter\n"
+        'compile_cache{kind="decode",outcome="miss"} 1\n'
+        "# TYPE serve_admitted_total counter\n"
+        "serve_admitted_total 3\n"
+        "# TYPE hbm_bytes gauge\n"
+        'hbm_bytes{component="kv_cache"} 262144\n'
+        'hbm_bytes{component="params"} 1048576\n'
+        "# TYPE tick_block_ms summary\n"
+        'tick_block_ms{quantile="0.5"} 2\n'
+        'tick_block_ms{quantile="0.95"} 2.8999999999999995\n'
+        "tick_block_ms_sum 4\n"
+        "tick_block_ms_count 2\n"
+    )
+
+
+def test_render_escapes_and_sanitizes():
+    # dotted histogram names (the <kind>.<field> registry convention)
+    # sanitize to the Prometheus charset; label values escape
+    # backslash/quote/newline per the exposition format
+    reg = MetricsRegistry()
+    reg.histogram("inference_request.total_ms").observe(5.0)
+    reg.counter("weird", {"k": 'a"b\\c\nd'}).inc()
+    text = render_prometheus(reg.dump())
+    assert "inference_request_total_ms_count 1" in text
+    assert 'weird{k="a\\"b\\\\c\\nd"} 1' in text
+    assert _sanitize("9lives.x") == "_9lives_x"
+
+
+def test_parse_key_roundtrip():
+    assert _parse_key("plain") == ("plain", {})
+    assert _parse_key("m{a=1,b=x}") == ("m", {"a": "1", "b": "x"})
+
+
+# -- the HTTP exporter -------------------------------------------------
+def test_endpoints_end_to_end():
+    reg = MetricsRegistry()
+    reg.counter("serve_finished_total").inc(7)
+    health = {"status": "ok"}
+    srv = OpsServer(registry=reg,
+                    health=lambda: health["status"],
+                    status=lambda: {"queue_depth": 2, "uptime_s": 1.5}).start()
+    try:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        assert "serve_finished_total 7" in body
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body) == {"status": "ok"}
+        # every non-ok status must fail the readiness probe with 503
+        for status in ("recovering", "poisoned", "draining"):
+            health["status"] = status
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.url + "/healthz")
+            assert e.value.code == 503
+            assert json.loads(e.value.read().decode()) == {"status": status}
+        code, body = _get(srv.url + "/statusz")
+        assert code == 200
+        assert json.loads(body) == {"queue_depth": 2, "uptime_s": 1.5}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/nope")
+        assert e.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_broken_callback_answers_500_not_crash():
+    def boom():
+        raise RuntimeError("snapshot raced a rebuild")
+
+    srv = OpsServer(status=boom).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/statusz")
+        assert e.value.code == 500
+        assert "RuntimeError" in e.value.read().decode()
+        # the server survives: the next scrape still answers
+        assert _get(srv.url + "/healthz")[0] == 200
+    finally:
+        srv.close()
+
+
+def test_close_idempotent_and_port_reusable():
+    srv = OpsServer(registry=MetricsRegistry()).start()
+    port = srv.port
+    srv.close()
+    srv.close()  # double close is a no-op
+    srv2 = OpsServer(registry=MetricsRegistry(), port=port).start()
+    try:
+        assert srv2.port == port  # the port was actually released
+    finally:
+        srv2.close()
+
+
+def test_metrics_without_registry_is_empty_but_valid():
+    srv = OpsServer().start()
+    try:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and body == "\n"
+        assert _get(srv.url + "/healthz")[0] == 200  # default health: ok
+    finally:
+        srv.close()
+
+
+# -- trace-writer resilience (Telemetry.emit satellite) ----------------
+def test_trace_write_error_counts_and_reopens(tmp_path):
+    """An OSError mid-write must not permanently blind the trace: the
+    event is dropped and counted (``trace_write_errors``), the warning
+    logs once, and the NEXT emit reopens the file and keeps writing."""
+    from deepspeed_tpu.telemetry import Telemetry, TelemetryConfig, read_trace
+
+    trace = tmp_path / "t.jsonl"
+    tele = Telemetry(TelemetryConfig(enabled=True, trace_file=str(trace)))
+    tele.emit("k", {"x": 1.0})
+    writer = tele._writer
+    assert writer is not None
+    orig_write = writer.write
+    calls = {"fail": 2}
+
+    def flaky(kind, payload):
+        if calls["fail"] > 0:
+            calls["fail"] -= 1
+            raise OSError("disk hiccup")
+        return orig_write(kind, payload)
+
+    writer.write = flaky
+    tele.emit("k", {"x": 2.0})   # dropped, counted, warned
+    tele.emit("k", {"x": 3.0})   # dropped, counted (no second warning)
+    assert tele._writer is writer  # never discarded
+    assert tele.registry.dump()["counters"]["trace_write_errors"] == 2.0
+    tele.emit("k", {"x": 4.0})   # disk recovered: lazy reopen, written
+    tele.close()
+    xs = [e["x"] for e in read_trace(str(trace)) if e["kind"] == "k"]
+    assert xs == [1.0, 4.0]

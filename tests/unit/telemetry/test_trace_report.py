@@ -103,6 +103,37 @@ def test_serve_table_empty_without_serving_events():
     assert ds_trace_report.serve_table(events) == {}
 
 
+def test_memory_table():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.memory_table(events)
+    assert table["snapshots"] == 2
+    assert table["reasons"] == {"build": 1, "migration": 1}
+    # per-component peak + latest: the migration doubled the KV bytes
+    assert table["components"]["params"] == {"peak": 1048576,
+                                             "latest": 1048576}
+    assert table["components"]["kv_cache"] == {"peak": 524288,
+                                               "latest": 524288}
+    assert table["total_peak"] == 1572864 and table["total_latest"] == 1572864
+    assert table["headroom_latest"] == 14427136
+    text = ds_trace_report.format_memory_table(table)
+    assert "memory (memory_snapshot" in text and "headroom" in text
+    assert ds_trace_report.memory_table([{"kind": "train_step"}]) == {}
+
+
+def test_compile_table():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.compile_table(events)
+    assert table["count"] == 3
+    assert table["compile_ms_total"] == 910.7
+    assert table["recompiles"] == 1  # the pool_tick rebuild re-compile
+    assert table["families"]["pool_tick"] == {
+        "count": 2, "compile_ms": 815.5, "recompiles": 1}
+    assert table["families"]["decode_step"]["recompiles"] == 0
+    text = ds_trace_report.format_compile_table(table)
+    assert "compiles (compile_event)" in text and "recompiles 1" in text
+    assert ds_trace_report.compile_table([{"kind": "train_step"}]) == {}
+
+
 def test_kind_filter_and_skip_fields():
     events, _ = ds_trace_report.load_events(FIXTURE)
     report = ds_trace_report.aggregate(events, kinds=["train_step"])

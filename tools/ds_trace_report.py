@@ -317,6 +317,113 @@ def format_serve_table(table):
     return "\n".join(lines) + "\n"
 
 
+def memory_table(events):
+    """Per-component HBM table over ``memory_snapshot`` events (the live
+    ops plane's attribution — docs/telemetry.md): peak and latest bytes
+    per component, snapshot count per reason (build/rebuild/migration),
+    and the latest total/headroom. Empty dict when the trace carries no
+    snapshots."""
+    snaps = [e for e in events if e.get("kind") == "memory_snapshot"]
+    if not snaps:
+        return {}
+    comps = {}
+    reasons = {}
+    for e in snaps:
+        reasons[e.get("reason", "?")] = reasons.get(e.get("reason", "?"), 0) + 1
+        for name, b in (e.get("components") or {}).items():
+            if isinstance(b, bool) or not isinstance(b, (int, float)):
+                continue
+            c = comps.setdefault(name, {"peak": 0, "latest": 0})
+            c["peak"] = max(c["peak"], b)
+            c["latest"] = b
+    out = {"snapshots": len(snaps), "reasons": reasons, "components": comps}
+    last = snaps[-1]
+    if isinstance(last.get("total_bytes"), (int, float)):
+        out["total_latest"] = last["total_bytes"]
+    out["total_peak"] = max((e["total_bytes"] for e in snaps
+                             if isinstance(e.get("total_bytes"), (int, float))),
+                            default=0)
+    if isinstance(last.get("headroom_bytes"), (int, float)):
+        out["headroom_latest"] = last["headroom_bytes"]
+    return out
+
+
+def format_memory_table(table):
+    if not table:
+        return ""
+    reasons = " ".join(f"{k}={v}" for k, v in sorted(table["reasons"].items()))
+    lines = ["== memory (memory_snapshot, bytes per chip) ==",
+             f"snapshots         {table['snapshots']}  ({reasons})"]
+    name_w = max(len("component"), max((len(n) for n in table["components"]),
+                                       default=0))
+    col_w = 14
+    header = "component".ljust(name_w) + "peak".rjust(col_w) + "latest".rjust(col_w)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(table["components"]):
+        c = table["components"][name]
+        lines.append(name.ljust(name_w) + _fmt(c["peak"]).rjust(col_w)
+                     + _fmt(c["latest"]).rjust(col_w))
+    lines.append("total".ljust(name_w) + _fmt(table["total_peak"]).rjust(col_w)
+                 + _fmt(table.get("total_latest", 0)).rjust(col_w))
+    if "headroom_latest" in table:
+        lines.append(f"headroom (latest) {_fmt(table['headroom_latest'])}")
+    return "\n".join(lines) + "\n"
+
+
+def compile_table(events):
+    """Compile flight-recorder totals over ``compile_event`` events:
+    count, total compile_ms, and recompiles — overall and per program
+    family. A non-zero recompile count at serve time is the runtime
+    recompile storm ds-lint can only guess at statically. Empty dict
+    when the trace carries no compile events."""
+    evs = [e for e in events if e.get("kind") == "compile_event"]
+    if not evs:
+        return {}
+    families = {}
+    for e in evs:
+        fam = families.setdefault(e.get("family", "?"),
+                                  {"count": 0, "compile_ms": 0.0,
+                                   "recompiles": 0})
+        fam["count"] += 1
+        ms = e.get("compile_ms")
+        if isinstance(ms, (int, float)) and not isinstance(ms, bool):
+            fam["compile_ms"] += float(ms)
+        if e.get("recompile") is True:
+            fam["recompiles"] += 1
+    return {
+        "count": len(evs),
+        "compile_ms_total": round(sum(f["compile_ms"]
+                                      for f in families.values()), 3),
+        "recompiles": sum(f["recompiles"] for f in families.values()),
+        "families": {k: {"count": v["count"],
+                         "compile_ms": round(v["compile_ms"], 3),
+                         "recompiles": v["recompiles"]}
+                     for k, v in families.items()},
+    }
+
+
+def format_compile_table(table):
+    if not table:
+        return ""
+    lines = ["== compiles (compile_event) ==",
+             f"compiles          {table['count']}   total "
+             f"{_fmt(table['compile_ms_total'])} ms   recompiles "
+             f"{table['recompiles']}"]
+    name_w = max(len("family"), max(len(n) for n in table["families"]))
+    col_w = 14
+    header = ("family".ljust(name_w) + "count".rjust(col_w)
+              + "compile_ms".rjust(col_w) + "recompiles".rjust(col_w))
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(table["families"]):
+        f = table["families"][name]
+        lines.append(name.ljust(name_w) + str(f["count"]).rjust(col_w)
+                     + _fmt(f["compile_ms"]).rjust(col_w)
+                     + str(f["recompiles"]).rjust(col_w))
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v):
     if v == 0:
         return "0"
@@ -368,6 +475,9 @@ def main(argv=None):
                     help="only the serving summary (queue-wait/TTFT "
                          "percentiles, shed rate, deadline-met fraction, "
                          "goodput over ServingEngine events)")
+    ap.add_argument("--memory", action="store_true",
+                    help="only the per-component HBM table (peak + latest "
+                         "bytes per chip over memory_snapshot events)")
     args = ap.parse_args(argv)
 
     try:
@@ -408,6 +518,17 @@ def main(argv=None):
             sys.stdout.write(format_serve_table(table))
         return 0
 
+    if args.memory:
+        table = memory_table(events)
+        if not table:
+            print("no memory_snapshot events in the trace", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"memory": table}, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_memory_table(table))
+        return 0
+
     report = aggregate(events, kinds=args.kind, all_fields=args.all_fields)
     if args.as_json:
         print(json.dumps(report, indent=2, sort_keys=True))
@@ -421,6 +542,12 @@ def main(argv=None):
             table = serve_table(events)
             if table:
                 sys.stdout.write("\n" + format_serve_table(table))
+            table = memory_table(events)
+            if table:
+                sys.stdout.write("\n" + format_memory_table(table))
+            table = compile_table(events)
+            if table:
+                sys.stdout.write("\n" + format_compile_table(table))
     return 0
 
 
