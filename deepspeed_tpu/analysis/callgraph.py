@@ -1,0 +1,446 @@
+"""Package-wide symbol table and call graph — the substrate under the
+interprocedural rule families (thread-shared-state, donation-flow,
+jit-boundary-sync).
+
+Everything here is pure AST over the *set* of modules handed to one
+analyzer run (:class:`PackageContext`): no imports of the linted code,
+stdlib-only, relative imports only — the same portability contract as the
+per-module layer, so ``tools/ds_lint.py`` keeps working without jax.
+
+Resolution is deliberately best-effort and sound-ish rather than complete:
+
+- a module is addressed by the '/'-joined dotted form of its path; import
+  targets match by dotted-suffix (``from deepspeed_tpu.serving.policies
+  import X`` finds any linted module whose dotted path ends with
+  ``deepspeed_tpu.serving.policies``), and relative imports resolve
+  against the importing file's directory;
+- call edges are recorded where the callee is statically nameable: a
+  plain ``Name`` (module function, nested def, or imported symbol),
+  ``self.method(...)`` inside a class, ``alias.attr(...)`` through an
+  import alias, and attribute calls on locals whose class is known from
+  an annotation or a constructor assignment (``srv = ServingEngine(...)``
+  / ``ops: "OpsServer" = ...``);
+- anything else is simply not an edge. Interprocedural rules therefore
+  under-approximate reachability — they miss exotic indirection, they do
+  not invent it.
+"""
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import dotted_name, terminal_name
+
+
+def module_key(path: str) -> str:
+    """Dotted module address for a file path: ``a/b/c.py`` -> ``a.b.c``
+    (``__init__.py`` collapses onto its package directory)."""
+    parts = path.replace("\\", "/").rstrip("/").split("/")
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][: -len(".py")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p not in ("", "."))
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method definition in the package."""
+
+    fid: str          # "<module_key>::<qualname>" — the graph node id
+    module: str       # module_key of the defining module
+    path: str         # ModuleContext.path (finding anchor)
+    qualname: str     # "f", "Class.method", "outer.inner"
+    node: object      # ast.FunctionDef | ast.AsyncFunctionDef
+    class_name: str = ""   # "" for plain functions
+
+    def param_names(self):
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    @property
+    def is_method(self) -> bool:
+        return bool(self.class_name)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    node: object
+    methods: dict = field(default_factory=dict)   # method name -> fid
+    bases: tuple = ()                             # terminal base-class names
+
+
+@dataclass
+class ModuleSymbols:
+    """Per-module symbol table: top-level defs, classes, and the import
+    map (local name -> what it refers to)."""
+
+    key: str
+    path: str
+    functions: dict = field(default_factory=dict)  # qualname -> FunctionInfo
+    classes: dict = field(default_factory=dict)    # class name -> ClassInfo
+    # local name -> ("module", dotted) | ("symbol", dotted_module, symbol)
+    imports: dict = field(default_factory=dict)
+
+    def top_level(self, name: str):
+        """FunctionInfo or ClassInfo bound to ``name`` at module scope."""
+        if name in self.classes:
+            return self.classes[name]
+        return self.functions.get(name)
+
+
+@dataclass
+class CallEdge:
+    caller: str   # fid
+    callee: str   # fid
+    call: object  # the ast.Call node at the call site
+
+
+class PackageSymbols:
+    """Symbol tables for every module in one analyzer run, plus the
+    cross-module name resolution the call graph builds on."""
+
+    def __init__(self, contexts):
+        self.modules = {}        # module_key -> ModuleSymbols
+        self.by_path = {}        # ctx.path -> ModuleSymbols
+        self.functions = {}      # fid -> FunctionInfo
+        for ctx in contexts:
+            syms = _collect_module(ctx)
+            self.modules[syms.key] = syms
+            self.by_path[ctx.path] = syms
+            for info in syms.functions.values():
+                self.functions[info.fid] = info
+
+    def display(self, key_or_fid: str) -> str:
+        """Human-oriented name for a module key or ``module::qualname``
+        fid: the leading path components every linted module shares are
+        stripped (an absolute lint path otherwise leaks ``root.repo...``
+        into every message)."""
+        key, _, qual = key_or_fid.partition("::")
+        if not hasattr(self, "_common"):
+            comps = [k.split(".") for k in self.modules if k]
+            common = comps[0][:] if comps else []
+            for c in comps[1:]:
+                n = 0
+                while n < len(common) and n < len(c) and common[n] == c[n]:
+                    n += 1
+                del common[n:]
+            # every module keeps at least its own name
+            while common and any(len(c) <= len(common) for c in comps):
+                common.pop()
+            self._common = len(common)
+        short = ".".join(key.split(".")[self._common:]) or key
+        return f"{short}.{qual}" if qual else short
+
+    # -- module / symbol resolution ------------------------------------
+    def resolve_module(self, dotted: str):
+        """ModuleSymbols whose key ends with ``dotted`` (exact component
+        suffix), or None. Ambiguity resolves to the longest key — the
+        most specific match — deterministically."""
+        if not dotted:
+            return None
+        if dotted in self.modules:
+            return self.modules[dotted]
+        suffix = "." + dotted
+        hits = [k for k in self.modules if k.endswith(suffix)]
+        if not hits:
+            return None
+        return self.modules[max(hits, key=lambda k: (len(k), k))]
+
+    def resolve_import(self, syms: ModuleSymbols, local: str):
+        """What a module-local name imported into ``syms`` refers to:
+        ("module", ModuleSymbols) | ("symbol", ModuleSymbols, name) |
+        None when not an import or the target module is outside the
+        linted set."""
+        target = syms.imports.get(local)
+        if target is None:
+            return None
+        if target[0] == "module":
+            mod = self.resolve_module(target[1])
+            return ("module", mod) if mod is not None else None
+        mod = self.resolve_module(target[1])
+        if mod is None:
+            return None
+        return ("symbol", mod, target[2])
+
+    def resolve_name(self, syms: ModuleSymbols, name: str):
+        """FunctionInfo/ClassInfo a bare name refers to in ``syms``'s
+        module scope, following one import hop."""
+        obj = syms.top_level(name)
+        if obj is not None:
+            return obj
+        imp = self.resolve_import(syms, name)
+        if imp is not None and imp[0] == "symbol":
+            return imp[1].top_level(imp[2])
+        return None
+
+
+class CallGraph:
+    """Call edges between package functions, with per-edge call sites."""
+
+    def __init__(self, symbols: PackageSymbols, contexts):
+        self.symbols = symbols
+        self.edges = []            # list[CallEdge]
+        self.out = {}              # fid -> [CallEdge]
+        self.into = {}             # fid -> [CallEdge]
+        for ctx in contexts:
+            syms = symbols.by_path[ctx.path]
+            for info in syms.functions.values():
+                for call, callee in _resolve_calls(symbols, syms, info):
+                    edge = CallEdge(info.fid, callee.fid, call)
+                    self.edges.append(edge)
+                    self.out.setdefault(edge.caller, []).append(edge)
+                    self.into.setdefault(edge.callee, []).append(edge)
+
+    def callees(self, fid: str):
+        return [e.callee for e in self.out.get(fid, ())]
+
+    def callers(self, fid: str):
+        return [e.caller for e in self.into.get(fid, ())]
+
+    def reachable(self, roots):
+        """Transitive closure of call edges from ``roots`` (fids),
+        roots included."""
+        seen = set()
+        stack = [r for r in roots if r in self.symbols.functions]
+        while stack:
+            fid = stack.pop()
+            if fid in seen:
+                continue
+            seen.add(fid)
+            stack.extend(self.callees(fid))
+        return seen
+
+
+class PackageContext:
+    """Everything a :class:`~.core.PackageRule` may inspect about one
+    analyzer run: the module contexts plus lazily built (and shared)
+    symbol table / call graph indexes."""
+
+    def __init__(self, contexts):
+        self.contexts = list(contexts)
+        self.by_path = {ctx.path: ctx for ctx in self.contexts}
+        self._cache = {}
+
+    def cached(self, key, builder):
+        if key not in self._cache:
+            self._cache[key] = builder(self)
+        return self._cache[key]
+
+    def symbols(self) -> PackageSymbols:
+        return self.cached("symbols", lambda p: PackageSymbols(p.contexts))
+
+    def callgraph(self) -> CallGraph:
+        return self.cached(
+            "callgraph", lambda p: CallGraph(p.symbols(), p.contexts))
+
+
+# -- collection ---------------------------------------------------------
+
+def own_statements(fn):
+    """Walk a function body excluding nested function/class scopes (their
+    statements belong to the nested definition)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _collect_module(ctx) -> ModuleSymbols:
+    syms = ModuleSymbols(key=module_key(ctx.path), path=ctx.path)
+    pkg_parts = syms.key.split(".")[:-1] if syms.key else []
+
+    def register_function(node, qual_parts, class_name=""):
+        qualname = ".".join(qual_parts)
+        info = FunctionInfo(
+            fid=f"{syms.key}::{qualname}", module=syms.key, path=ctx.path,
+            qualname=qualname, node=node, class_name=class_name)
+        syms.functions[qualname] = info
+        # lazy (function-body) imports resolve at module scope too — the
+        # repo's deferred-import idiom must not blind the call graph.
+        # setdefault: a module-level binding of the same name wins.
+        for stmt in own_statements(node):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    syms.imports.setdefault(local, ("module", alias.name))
+            elif isinstance(stmt, ast.ImportFrom):
+                base = stmt.module or ""
+                if stmt.level:
+                    up = pkg_parts[: len(pkg_parts) - (stmt.level - 1)]
+                    base = ".".join(up + ([base] if base else []))
+                for alias in stmt.names:
+                    if alias.name != "*":
+                        syms.imports.setdefault(
+                            alias.asname or alias.name,
+                            ("symbol", base, alias.name))
+        return info
+
+    def walk_body(body, qual_parts, class_name=""):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                register_function(stmt, qual_parts + [stmt.name], class_name)
+                # nested defs one level down (thread pumps, closures)
+                walk_body(stmt.body, qual_parts + [stmt.name], "")
+            elif isinstance(stmt, ast.ClassDef):
+                if qual_parts:
+                    continue  # nested classes: out of scope
+                cls = ClassInfo(
+                    name=stmt.name, module=syms.key, node=stmt,
+                    bases=tuple(terminal_name(b) for b in stmt.bases
+                                if terminal_name(b)))
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info = register_function(
+                            sub, [stmt.name, sub.name], stmt.name)
+                        cls.methods[sub.name] = info.fid
+                        # nested defs one level down inside methods (the
+                        # thread-pump closure a method hands to
+                        # Thread(target=...)) — they carry the class name
+                        # so self.<attr> reads audit against the class
+                        walk_body(sub.body, [stmt.name, sub.name],
+                                  stmt.name)
+                syms.classes[stmt.name] = cls
+            elif isinstance(stmt, ast.Import):
+                if qual_parts:
+                    continue  # function-body import: register_function
+                    # already recorded it with setdefault — assigning here
+                    # would let a lazy local import shadow the module-level
+                    # binding for the whole module's resolution.
+                for alias in stmt.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    syms.imports[local] = ("module", alias.name)
+            elif isinstance(stmt, ast.ImportFrom):
+                if qual_parts:
+                    continue  # see ast.Import above
+                base = stmt.module or ""
+                if stmt.level:
+                    up = pkg_parts[: len(pkg_parts) - (stmt.level - 1)]
+                    base = ".".join(up + ([base] if base else []))
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    syms.imports[local] = ("symbol", base, alias.name)
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With, ast.AsyncWith)):
+                # imports/defs guarded by TYPE_CHECKING / try blocks
+                for sub_body in _compound_bodies(stmt):
+                    walk_body(sub_body, qual_parts, class_name)
+
+    walk_body(ctx.tree.body, [])
+    return syms
+
+
+def _compound_bodies(stmt):
+    if isinstance(stmt, ast.If):
+        return [stmt.body, stmt.orelse]
+    if isinstance(stmt, ast.Try):
+        return ([stmt.body, stmt.orelse, stmt.finalbody]
+                + [h.body for h in stmt.handlers])
+    return [stmt.body]
+
+
+def _local_types(symbols: PackageSymbols, syms: ModuleSymbols, info):
+    """{local name: ClassInfo} for locals whose class is statically known:
+    ``x = KnownClass(...)`` constructor assignments and ``x: "KnownClass"``
+    annotations (string or bare-name form)."""
+    out = {}
+    for stmt in own_statements(info.node):
+        target = None
+        ann = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+            ann = stmt.annotation
+        if target is None:
+            continue
+        cls = None
+        if ann is not None:
+            name = (ann.value if isinstance(ann, ast.Constant)
+                    and isinstance(ann.value, str) else terminal_name(ann))
+            if name:
+                obj = symbols.resolve_name(syms, name.strip("'\""))
+                cls = obj if isinstance(obj, ClassInfo) else None
+        value = getattr(stmt, "value", None)
+        if cls is None and isinstance(value, ast.Call):
+            obj = _resolve_callable(symbols, syms, value.func)
+            cls = obj if isinstance(obj, ClassInfo) else None
+        if cls is not None:
+            out[target] = cls
+    return out
+
+
+def _resolve_callable(symbols: PackageSymbols, syms: ModuleSymbols, func):
+    """FunctionInfo/ClassInfo for a call's ``func`` node resolvable at
+    module scope (bare name or import-alias attribute chain)."""
+    if isinstance(func, ast.Name):
+        return symbols.resolve_name(syms, func.id)
+    dn = dotted_name(func)
+    if not dn or "." not in dn:
+        return None
+    head, rest = dn.split(".", 1)
+    imp = symbols.resolve_import(syms, head)
+    if imp is None:
+        return None
+    if imp[0] == "module":
+        mod = imp[1]
+        # alias.sub.attr: the tail name within (a submodule of) the alias
+        parts = rest.split(".")
+        obj = mod.top_level(parts[-1])
+        if obj is not None and len(parts) == 1:
+            return obj
+        sub = symbols.resolve_module(
+            ".".join([mod.key] + parts[:-1])) if len(parts) > 1 else None
+        return sub.top_level(parts[-1]) if sub is not None else obj
+    mod, name = imp[1], imp[2]
+    obj = mod.top_level(name)
+    if isinstance(obj, ClassInfo) and "." in rest:
+        return None  # attribute on an imported class: not a plain callable
+    return obj
+
+
+def _resolve_calls(symbols: PackageSymbols, syms: ModuleSymbols, info):
+    """Yield (call_node, callee FunctionInfo) for every statically
+    resolvable call in ``info``'s own statements."""
+    local_types = None  # built lazily: most functions never need it
+    cls = syms.classes.get(info.class_name) if info.class_name else None
+    for node in own_statements(info.node):
+        if isinstance(node, ast.Call):
+            func = node.func
+            callee = None
+            if isinstance(func, ast.Name):
+                # nearest enclosing nested def shadows module scope
+                nested = syms.functions.get(f"{info.qualname}.{func.id}")
+                obj = nested or symbols.resolve_name(syms, func.id)
+                if isinstance(obj, FunctionInfo):
+                    callee = obj
+                elif isinstance(obj, ClassInfo):
+                    init = obj.methods.get("__init__")
+                    callee = symbols.functions.get(init) if init else None
+            elif isinstance(func, ast.Attribute):
+                recv = func.value
+                if isinstance(recv, ast.Name) and recv.id == "self" and cls:
+                    fid = cls.methods.get(func.attr)
+                    callee = symbols.functions.get(fid) if fid else None
+                elif isinstance(recv, ast.Name):
+                    if local_types is None:
+                        local_types = _local_types(symbols, syms, info)
+                    rcls = local_types.get(recv.id)
+                    if rcls is not None:
+                        fid = rcls.methods.get(func.attr)
+                        callee = symbols.functions.get(fid) if fid else None
+                    else:
+                        obj = _resolve_callable(symbols, syms, func)
+                        callee = obj if isinstance(obj, FunctionInfo) else None
+                else:
+                    obj = _resolve_callable(symbols, syms, func)
+                    callee = obj if isinstance(obj, FunctionInfo) else None
+            if callee is not None:
+                yield node, callee

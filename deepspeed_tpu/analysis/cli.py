@@ -6,6 +6,8 @@ state: it rewrites the baseline with every present finding and exits 0.
 
     ds-lint deepspeed_tpu/                      # text report
     ds-lint --format json deepspeed_tpu/        # machine-readable
+    ds-lint --format sarif deepspeed_tpu/       # code-host annotations
+    ds-lint --changed origin/main               # only files in the diff
     ds-lint --rule host-sync-in-jit file.py     # one rule only
     ds-lint --baseline tools/ds_lint_baseline.json --write-baseline ...
 """
@@ -13,10 +15,11 @@ state: it rewrites the baseline with every present finding and exits 0.
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from .baseline import Baseline
-from .core import Analyzer
+from .core import AnalysisResult, Analyzer
 from .rules import make_rules, rules_by_id
 
 _DEFAULT_BASELINE = os.path.join("tools", "ds_lint_baseline.json")
@@ -33,8 +36,18 @@ def build_parser():
              "package next to this checkout's tools/)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt",
-        help="report format (default: text)",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt",
+        help="report format (default: text; sarif emits SARIF 2.1.0 for "
+             "code-host PR annotation)",
+    )
+    parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="report only findings in files changed vs merge-base(REF, "
+             "HEAD) (default HEAD; untracked files included) — "
+             "the per-PR gate mode. The whole scope is still ANALYZED so "
+             "interprocedural rules and suppression liveness see the full "
+             "call graph; the diff only filters what is reported",
     )
     parser.add_argument(
         "--rule", action="append", default=None, metavar="RULE_ID",
@@ -118,6 +131,51 @@ def main(argv=None) -> int:
         return 2
 
     root = os.path.abspath(args.root) if args.root else _infer_root(paths)
+
+    changed = None
+    if args.changed is not None:
+        if args.changed != "HEAD" and os.path.exists(args.changed):
+            # nargs="?" makes `--changed some/path.py` bind the PATH as
+            # the git REF (linting the default scope against a bogus —
+            # or worse, coincidentally valid — revision). Refuse loudly;
+            # a legitimate ref named like an existing path can be
+            # spelled unambiguously (refs/heads/<name>).
+            print(f"ds-lint: --changed got {args.changed!r}, which is an "
+                  f"existing path, not a git ref — use '--changed REF "
+                  f"PATH...' or bare '--changed' for HEAD",
+                  file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("ds-lint: --write-baseline cannot be combined with "
+                  "--changed (a diff-filtered write would drop every other "
+                  "file's entries)", file=sys.stderr)
+            return 2
+        try:
+            changed = _changed_files(root, args.changed)
+        except RuntimeError as exc:
+            print(f"ds-lint: {exc}", file=sys.stderr)
+            return 2
+        # the diff scoped to the linted paths is what gets REPORTED; the
+        # full paths are still analyzed (package rules + stale-suppression
+        # judge against the whole call graph, not the diff slice)
+        changed = {p for p in changed if _path_in_scope(p, paths)}
+        if not changed:
+            # still honour --format: a machine consumer (the SARIF CI
+            # pairing) must get a valid empty document, not a prose line
+            if args.fmt == "text":
+                print(f"ds-lint: 0 changed python file(s) vs "
+                      f"{args.changed} — clean")
+                return 0
+            report = _build_report(AnalysisResult(), [], [], root)
+            report["summary"]["changed_files"] = 0
+            if args.fmt == "sarif":
+                from .sarif import render_sarif
+
+                print(json.dumps(render_sarif(report, rules), indent=2))
+            else:
+                print(json.dumps(report, indent=2))
+            return 0
+
     result = Analyzer(rules).check_paths(paths)
 
     baseline_path = args.baseline
@@ -167,18 +225,85 @@ def main(argv=None) -> int:
     else:
         new, baselined = result.findings, []
 
+    if changed is not None:
+        new = [f for f in new if os.path.realpath(f.path) in changed]
+        baselined = [f for f in baselined
+                     if os.path.realpath(f.path) in changed]
+        result.parse_errors = [
+            (p, e) for p, e in result.parse_errors
+            if os.path.realpath(p) in changed]
+
     report = _build_report(result, new, baselined, root)
+    if changed is not None:
+        report["summary"]["changed_files"] = len(changed)
     if args.fmt == "json":
         print(json.dumps(report, indent=2))
+    elif args.fmt == "sarif":
+        from .sarif import render_sarif
+
+        print(json.dumps(render_sarif(report, rules), indent=2))
     else:
         _print_text(report)
     return 1 if new or result.parse_errors else 0
 
 
+def _changed_files(root, ref):
+    """Tracked .py files changed vs ``merge-base(ref, HEAD)`` plus
+    untracked .py files — the per-PR lint scope. Raises RuntimeError with git's own message on
+    failure (bad ref, not a repository). All git output is resolved
+    against the repository TOPLEVEL, never the lint root: ``diff
+    --name-only`` prints toplevel-relative paths, so joining them onto a
+    lint root nested below the toplevel would drop every file and
+    silently report the diff clean."""
+    def run(base, *argv):
+        try:
+            proc = subprocess.run(
+                # quotepath=off: git C-quotes non-ASCII names by default
+                # ("t\303\253st.py"), which would fail the .py check and
+                # silently drop the file from the per-PR gate
+                ["git", "-C", base, "-c", "core.quotepath=off", *argv],
+                capture_output=True, text=True, timeout=60)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            # git missing or hung: a usage/environment error (exit 2),
+            # never a traceback that exits 1 ("new findings") in CI
+            raise RuntimeError(f"git unavailable: {exc}") from exc
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(argv[:2])} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return [line for line in proc.stdout.splitlines() if line.strip()]
+
+    top = run(root, "rev-parse", "--show-toplevel")[0]
+    try:
+        # merge-base semantics: on a feature branch, `--changed master`
+        # must scope to THIS branch's changes — a plain two-dot diff
+        # would also report files changed only upstream since the fork
+        # point (failing the per-PR gate on code the PR never touched)
+        base = run(top, "merge-base", ref, "HEAD")[0]
+    except RuntimeError:
+        base = ref  # detached HEAD / no common ancestor: diff the ref itself
+    names = run(top, "diff", "--name-only", base, "--")
+    names += run(top, "ls-files", "--others", "--exclude-standard")
+    out = []
+    seen = set()
+    for name in names:
+        if not name.endswith(".py") or name in seen:
+            continue
+        seen.add(name)
+        full = os.path.join(top, name)
+        if os.path.exists(full):  # deleted files have nothing to lint
+            # realpath: --show-toplevel is symlink-resolved while the lint
+            # paths may not be — an unresolved mismatch would empty the
+            # intersection and report the diff clean (the CI bypass the
+            # docstring above warns about)
+            out.append(os.path.realpath(full))
+    return sorted(out)
+
+
 def _path_in_scope(abs_path, scope_paths):
-    abs_path = os.path.abspath(abs_path)
+    abs_path = os.path.realpath(abs_path)
     for p in scope_paths:
-        p = os.path.abspath(p)
+        p = os.path.realpath(p)
         if abs_path == p or abs_path.startswith(p.rstrip(os.sep) + os.sep):
             return True
     return False

@@ -26,8 +26,9 @@ SEVERITY_INFO = "info"
 
 _SEVERITY_ORDER = {SEVERITY_ERROR: 0, SEVERITY_WARNING: 1, SEVERITY_INFO: 2}
 
-# `# ds-lint: disable=rule-a,rule-b` — trailing on the flagged line, or a
-# standalone comment line directly above it. `disable=all` mutes every rule.
+# Suppression comments ("ds-lint:" prefix, then "disable=" and a comma-
+# separated rule list) — trailing on the flagged line, or a standalone
+# comment line directly above it. A list of "all" mutes every rule.
 _SUPPRESS_RE = re.compile(r"#\s*ds-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*ds-lint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
 
@@ -75,6 +76,11 @@ class Rule:
     id = "abstract-rule"
     severity = SEVERITY_WARNING
     description = ""
+    package_level = False  # True: check_package(pkg) instead of check(ctx)
+    needs_raw = False      # True: check_raw(ctx, raw, active, ...) post-pass
+    # False: `disable=all` does NOT mute this rule (only its explicit id
+    # does) — meta rules auditing suppressions themselves need this
+    suppress_by_all = True
 
     def check(self, ctx: "ModuleContext"):
         raise NotImplementedError
@@ -92,6 +98,22 @@ class Rule:
             message=message,
             code=ctx.code_at(line),
         )
+
+
+class PackageRule(Rule):
+    """A rule that needs the whole linted file set at once — the call
+    graph, the cross-module symbol table. Implement ``check_package(pkg)``
+    (``pkg`` is an :class:`~.callgraph.PackageContext`) yielding Findings
+    whose ``path`` names one of the linted modules; per-line suppressions
+    and the baseline apply exactly as for per-module rules."""
+
+    package_level = True
+
+    def check(self, ctx: "ModuleContext"):
+        return ()  # package rules run once per analysis, not per module
+
+    def check_package(self, pkg):
+        raise NotImplementedError
 
 
 @dataclass
@@ -131,26 +153,74 @@ class ModuleContext:
         table = self.cached("_suppress", lambda c: c._build_suppressions())
         return table["file"] | table["lines"].get(line, set())
 
+    def suppression_records(self):
+        """Structured view of every suppression comment in the file:
+        ``{"line", "rules", "form" ("file"|"trailing"|"standalone"),
+        "governed" (line list; empty for file-level)}`` — what the
+        stale-suppression rule audits."""
+        table = self.cached("_suppress", lambda c: c._build_suppressions())
+        return table["records"]
+
+    def _iter_comments(self):
+        """(line, col, text) for every real comment token. Tokenizing
+        (rather than regex over raw lines) keeps suppression syntax
+        *mentioned* inside docstrings/string literals from registering as
+        a live suppression."""
+        import io
+        import tokenize
+
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # unterminated-string style corner cases: fall back to raw
+            # lines (the pre-v2 behavior) rather than dropping suppressions
+            for idx, text in enumerate(self.lines, start=1):
+                pos = text.find("#")
+                if pos >= 0:
+                    yield idx, pos, text[pos:]
+            return
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.start[1], tok.string
+
     def _build_suppressions(self):
         lines_table = {}
         file_level = set()
-        for idx, text in enumerate(self.lines, start=1):
+        records = []
+        for idx, col, text in self._iter_comments():
             m = _SUPPRESS_FILE_RE.search(text)
             if m:
-                file_level |= _split_rule_list(m.group(1))
+                rules = _split_rule_list(m.group(1))
+                file_level |= rules
+                records.append({"line": idx, "rules": rules, "form": "file",
+                                "governed": []})
             m = _SUPPRESS_RE.search(text)
             if not m:
                 continue
             rules = _split_rule_list(m.group(1))
             lines_table.setdefault(idx, set()).update(rules)
-            if text.lstrip().startswith("#"):
+            standalone = not self.lines[idx - 1][:col].strip() \
+                if 1 <= idx <= len(self.lines) else False
+            governed = [idx]
+            if standalone:
                 # standalone comment line: applies to the next line too
                 lines_table.setdefault(idx + 1, set()).update(rules)
-        return {"file": file_level, "lines": lines_table}
+                governed.append(idx + 1)
+            records.append({
+                "line": idx, "rules": rules,
+                "form": "standalone" if standalone else "trailing",
+                "governed": governed,
+            })
+        return {"file": file_level, "lines": lines_table, "records": records}
 
-    def is_suppressed(self, finding: Finding) -> bool:
+    def is_suppressed(self, finding: Finding, by_all: bool = True) -> bool:
+        """Whether a suppression comment mutes ``finding``. ``by_all``
+        False excludes the ``disable=all`` form — the analyzer passes
+        ``Rule.suppress_by_all`` here so meta rules auditing suppression
+        comments cannot be muted by the comment under audit."""
         active = self.suppressed_rules_for_line(finding.line)
-        return "all" in active or finding.rule_id in active
+        return finding.rule_id in active or (by_all and "all" in active)
 
 
 def _split_rule_list(raw: str):
@@ -185,30 +255,103 @@ class Analyzer:
 
     def check_source(self, source: str, path: str = "<string>") -> AnalysisResult:
         result = AnalysisResult()
-        self._check_ctx_into(ModuleContext.from_source(source, path=path), result)
+        self._run([ModuleContext.from_source(source, path=path)], result)
         result.files_checked = 1
         return result
 
     def check_paths(self, paths) -> AnalysisResult:
         result = AnalysisResult()
+        contexts = []
+        seen = set()
         for filename in iter_python_files(paths):
+            # overlapping path args (`ds-lint dir dir/pkg`, or the same
+            # dir through a symlink) must not load a file twice:
+            # duplicate contexts share one raw-findings list keyed by
+            # path and would report every finding quadratically
+            key = os.path.realpath(filename)
+            if key in seen:
+                continue
+            seen.add(key)
             try:
-                ctx = ModuleContext.from_file(filename)
+                contexts.append(ModuleContext.from_file(filename))
             except (SyntaxError, UnicodeDecodeError, OSError) as exc:
                 result.parse_errors.append((filename, str(exc)))
                 continue
-            result.files_checked += 1
-            self._check_ctx_into(ctx, result)
+        result.files_checked = len(contexts)
+        self._run(contexts, result)
         result.findings = result.sorted_findings()
         return result
 
-    def _check_ctx_into(self, ctx: ModuleContext, result: AnalysisResult):
-        for rule in self.rules:
-            for finding in rule.check(ctx):
-                if ctx.is_suppressed(finding):
+    def _run(self, contexts, result: AnalysisResult):
+        """Three passes: per-module rules, package-level rules (over one
+        shared :class:`~.callgraph.PackageContext`), then raw-findings
+        post-passes (stale-suppression). Suppression filtering happens
+        once at the end so a post-pass can see findings that per-line
+        comments will mute."""
+        module_rules = [r for r in self.rules
+                        if not r.package_level and not r.needs_raw]
+        package_rules = [r for r in self.rules if r.package_level]
+        raw_rules = [r for r in self.rules if r.needs_raw]
+        active_ids = {r.id for r in self.rules}
+        raw = {ctx.path: [] for ctx in contexts}
+        for ctx in contexts:
+            for rule in module_rules:
+                raw[ctx.path].extend(rule.check(ctx))
+        if package_rules:
+            from .callgraph import PackageContext
+
+            pkg = PackageContext(contexts)
+            for rule in package_rules:
+                for finding in rule.check_package(pkg):
+                    # a package rule must anchor findings in linted files;
+                    # anything else would dodge suppressions and baselines
+                    if finding.path in raw:
+                        raw[finding.path].append(finding)
+        analyzed = {os.path.abspath(ctx.path) for ctx in contexts}
+        complete_cache: dict = {}
+
+        def scope_complete(ctx):
+            """Whether THIS run analyzed every module of the file's
+            package — the evidence a post-pass needs before judging a
+            package-level rule's (non-)firing as meaningful (a
+            single-file run misses the cross-module callers that keep a
+            jit-boundary-sync suppression live)."""
+            root = _package_root(ctx.path)
+            if root is None:
+                return True  # standalone file: its package IS the run
+            if root not in complete_cache:
+                complete_cache[root] = all(
+                    os.path.abspath(p) in analyzed
+                    for p in iter_python_files([root]))
+            return complete_cache[root]
+
+        for rule in raw_rules:
+            for ctx in contexts:
+                raw[ctx.path].extend(
+                    rule.check_raw(ctx, raw[ctx.path], active_ids,
+                                   package_scope_complete=scope_complete(ctx)))
+        all_muted = {r.id for r in self.rules if r.suppress_by_all}
+        for ctx in contexts:
+            for finding in raw[ctx.path]:
+                if ctx.is_suppressed(finding,
+                                     by_all=finding.rule_id in all_muted):
                     result.suppressed += 1
                 else:
                     result.findings.append(finding)
+
+
+def _package_root(path):
+    """Topmost directory of the package ``path`` belongs to (walking up
+    while ``__init__.py`` is present), or None for a standalone file."""
+    d = os.path.dirname(os.path.abspath(path))
+    root = None
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        root = d
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return root
 
 
 def iter_python_files(paths):

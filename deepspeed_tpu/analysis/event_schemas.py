@@ -1,0 +1,208 @@
+"""Checked-in telemetry event schema registry.
+
+One entry per trace-event ``kind`` the stack emits (``telemetry.emit``
+sites across runtime/, inference/, serving/, telemetry/). The
+telemetry-schema rule lints every emit site against this registry —
+unknown kinds, missing required fields, type-inconsistent fields — and
+``tests/unit/analysis/test_event_schemas.py`` asserts docs/telemetry.md
+documents every field registered here, so the schema, the emit sites,
+and the docs can only move together.
+
+Types are names from :data:`TYPE_NAMES`; ``"number"`` accepts int or
+float. A field may list alternatives as a tuple (``("dict", "null")``).
+``required`` fields appear in every event of the kind; ``optional``
+fields are conditional. The envelope fields the hub/writer stamp on
+every event (``role``/``ts``/``schema``/``kind``) live in
+:data:`ENVELOPE_FIELDS`, not per-kind.
+"""
+
+TYPE_NAMES = frozenset(
+    {"int", "float", "number", "str", "bool", "dict", "list", "null"})
+
+# stamped by Telemetry.emit / TraceWriter.write, never by emit sites
+ENVELOPE_FIELDS = {
+    "role": "str",      # "train" | "inference"
+    "ts": "number",     # wall-clock seconds
+    "schema": "int",    # trace schema version (trace.SCHEMA_VERSION)
+    "kind": "str",
+}
+
+EVENT_SCHEMAS = {
+    "train_step": {
+        "required": {
+            "step": "int",
+            "micro_steps": "int",
+            "samples": "int",
+            "fwd_ms": "number",
+            "bwd_ms": "number",
+            "step_ms": "number",
+            "iter_ms": "number",
+            "samples_per_sec": "number",
+            "avg_samples_per_sec": "number",
+            "lr": "number",
+            "loss_scale": "number",
+            "grad_norm": "number",
+            "overflow": "bool",
+            "skipped_steps": "int",
+            "mfu": "number",
+            "model_flops_per_step": "number",
+            "comm_bytes": "dict",
+            "comm_bytes_total": "number",
+        },
+        "optional": {
+            "loss": "number",
+            "tokens_per_sec": "number",
+        },
+    },
+    "comm_summary": {
+        "required": {"step": "int", "ops": "dict"},
+        "optional": {},
+    },
+    "inference_request": {
+        "required": {
+            "request": "int",
+            "path": "str",
+            "batch": "int",
+            "prompt_tokens": "int",
+            "new_tokens": "int",
+        },
+        "optional": {
+            "total_ms": "number",
+            "ttft_ms": "number",
+            "decode_tokens_per_sec": "number",
+            "tokens_per_sec": "number",
+            "cache_len": "int",
+            "compile_cache_hit": "bool",
+            "kv_dtype": "str",
+            "kv_bytes_read": "int",
+            "kv_bytes_per_token": "number",
+            "cache_utilization": "number",
+            "queue_ms": "number",
+            "priority": "int",
+            "tenant": "str",
+            "deadline_ms": "number",
+            "deadline_met": "bool",
+            "recoveries": "int",
+            "recovered_finish": "bool",
+        },
+    },
+    "serving_event": {
+        # discriminated by "event": shed | expired | cancelled | drain |
+        # resume; every other field is event-specific
+        "required": {"event": "str"},
+        "optional": {
+            "reason": "str",
+            "request": "int",
+            "detail": "str",
+            "queue_ms": "number",
+            "retry_after_s": "number",
+            "queue_depth": "int",
+            "running": "int",
+            "committed_tokens": "int",
+            "prompt_tokens": "int",
+            "need_tokens": "int",
+            "tokens_emitted": "int",
+            "deadline_ms": "number",
+        },
+    },
+    "serving_tick": {
+        "required": {
+            "dispatch_ms": "number",
+            "block_ms": "number",
+            "inflight": "int",
+            "emitted": "int",
+            "wasted": "int",
+            "fused_prefill": "bool",
+        },
+        "optional": {},
+    },
+    "serving_fault": {
+        # discriminated by "event": fault | retried | retry_failed |
+        # rebuild | rebuild_failed | breaker | unrecoverable
+        "required": {"event": "str"},
+        "optional": {
+            "error": "str",
+            "detail": "str",
+            "poisoned": "bool",
+            "consecutive": "int",
+            "attempt": "int",
+            "recovery_ms": "number",
+            "readmitted": "int",
+            "lost_ticks": "int",
+            "degraded": "bool",
+            "mesh": ("dict", "null"),
+            "rebuilds": "int",
+            "state": "str",
+            "outage_ms": "number",
+            "requests_lost": "int",
+        },
+    },
+    "memory_snapshot": {
+        "required": {
+            "reason": "str",
+            "total_bytes": "int",
+            "components": "dict",
+        },
+        "optional": {
+            "limit_bytes": "int",
+            "headroom_bytes": "int",
+            "programs": "dict",
+        },
+    },
+    "compile_event": {
+        "required": {
+            "family": "str",
+            "key": "str",
+            "compile_ms": "number",
+            "recompile": "bool",
+        },
+        "optional": {},
+    },
+}
+
+
+def known_kinds():
+    return frozenset(EVENT_SCHEMAS)
+
+
+def schema_for(kind: str):
+    """{"required": {...}, "optional": {...}} or None for unknown kinds."""
+    return EVENT_SCHEMAS.get(kind)
+
+
+def field_types(kind: str, name: str):
+    """Accepted concrete type names for ``kind.name`` (``"number"``
+    expanded), or None when the field is not registered. Envelope fields
+    resolve for every kind."""
+    schema = EVENT_SCHEMAS.get(kind)
+    if schema is None:
+        return None
+    declared = schema["required"].get(name, schema["optional"].get(name))
+    if declared is None:
+        declared = ENVELOPE_FIELDS.get(name)
+    if declared is None:
+        return None
+    names = (declared,) if isinstance(declared, str) else tuple(declared)
+    out = set()
+    for t in names:
+        out |= {"int", "float"} if t == "number" else {t}
+    return frozenset(out)
+
+
+def validate_registry():
+    """Internal consistency: every declared type name is known, required
+    and optional never overlap. Raises ValueError on violations (the
+    registry test calls this)."""
+    for kind, schema in EVENT_SCHEMAS.items():
+        overlap = set(schema["required"]) & set(schema["optional"])
+        if overlap:
+            raise ValueError(f"{kind}: fields both required and optional: "
+                             f"{sorted(overlap)}")
+        for section in ("required", "optional"):
+            for name, declared in schema[section].items():
+                names = ((declared,) if isinstance(declared, str)
+                         else tuple(declared))
+                unknown = [t for t in names if t not in TYPE_NAMES]
+                if unknown:
+                    raise ValueError(
+                        f"{kind}.{name}: unknown type name(s) {unknown}")

@@ -1,0 +1,63 @@
+"""A small forward dataflow framework over the package call graph.
+
+Facts attach to call-graph nodes (function ids) and propagate along
+edges until fixpoint, worklist-style. The framework is direction-
+agnostic: rules hand it a ``successors`` function, so "forward along
+call edges" (jit-boundary taint: a traced caller taints its callees) and
+"forward along *reverse* edges" (donation summaries: a callee that
+donates its parameter taints the caller's argument) are both one call.
+
+Facts must be joinable: ``join(old, incoming) -> (merged, changed)``.
+The default join treats facts as frozensets under union — enough for the
+reachability/taint shapes the v2 rules need. Termination: ``join`` must
+be monotone (merged only ever grows); the worklist then visits each node
+at most O(height of the fact lattice) times.
+"""
+
+
+def set_join(old, incoming):
+    """Union join over set-like facts. ``old`` may be None (no fact yet)."""
+    incoming = frozenset(incoming)
+    if old is None:
+        return incoming, True  # first fact at this node always counts
+    merged = old | incoming
+    return merged, merged != old
+
+
+def propagate(seeds, successors, join=set_join):
+    """Run a worklist fixpoint.
+
+    - ``seeds``: {node: fact} initial assignment.
+    - ``successors(node, fact)``: iterable of ``(next_node, out_fact)``
+      pairs — the transfer function applied edge-by-edge.
+    - ``join(old_fact, incoming_fact) -> (merged, changed)``.
+
+    Returns the final {node: fact} map (seeds included)."""
+    facts = {}
+    work = []
+    for node, fact in seeds.items():
+        merged, _ = join(facts.get(node), fact)
+        facts[node] = merged
+        work.append(node)
+    while work:
+        node = work.pop()
+        for nxt, out in successors(node, facts[node]):
+            merged, changed = join(facts.get(nxt), out)
+            if changed or nxt not in facts:
+                facts[nxt] = merged
+                work.append(nxt)
+    return facts
+
+
+def reach(graph, roots):
+    """Plain reachability over ``graph.callees`` edges from ``roots``:
+    the degenerate single-fact instance of :func:`propagate`. Returns the
+    set of reachable function ids (roots included when they exist in the
+    graph)."""
+    known = graph.symbols.functions
+    seeds = {fid: frozenset(("reached",)) for fid in roots if fid in known}
+    facts = propagate(
+        seeds,
+        lambda fid, fact: ((c, fact) for c in graph.callees(fid)),
+    )
+    return set(facts)

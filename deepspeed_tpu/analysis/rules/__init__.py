@@ -3,11 +3,16 @@ module here and append it to ``_RULE_CLASSES`` (docs/static_analysis.md
 walks through it)."""
 
 from .donation import DonatedBufferReuseRule
+from .donation_flow import DonationFlowRule
 from .host_sync import HostSyncInJitRule
+from .jit_boundary import JitBoundarySyncRule
 from .module_state import ModuleMutableStateRule
 from .partition_spec import PartitionSpecAxisRule
 from .pyhygiene import BareExceptRule, MutableDefaultArgRule
 from .recompile import RecompileHazardRule
+from .stale import StaleSuppressionRule
+from .telemetry_schema import TelemetrySchemaRule
+from .thread_shared import ThreadSharedStateRule
 from .timing import UnsyncedTimingRule
 
 _RULE_CLASSES = [
@@ -19,6 +24,12 @@ _RULE_CLASSES = [
     MutableDefaultArgRule,
     BareExceptRule,
     ModuleMutableStateRule,
+    # -- interprocedural v2 families (docs/static_analysis.md) ----------
+    ThreadSharedStateRule,
+    DonationFlowRule,
+    JitBoundarySyncRule,
+    TelemetrySchemaRule,
+    StaleSuppressionRule,
 ]
 
 

@@ -1,0 +1,248 @@
+"""donation-flow: donated buffers tracked across call boundaries.
+
+The per-function ``donated-buffer-reuse`` rule only sees donations where
+the jitted callable is called *directly*. Real code indirects:
+
+    step = jax.jit(tick, donate_argnums=(1,))
+
+    def _dispatch(params, state):
+        return step(params, state)        # donates its 'state' param
+
+    def loop(params, state):
+        out = _dispatch(params, state)    # state donated transitively
+        x = state.sum()                   # deleted buffer — missed today
+
+This package-level pass closes the gap:
+
+1. every module's jit index contributes its donating callables;
+2. a **donation summary** is computed per function — the set of its own
+   parameter positions that flow (as bare names) into a donated position
+   of a donating callable — and propagated to callers over the call
+   graph until fixpoint (``flow.propagate`` along reverse call edges);
+3. each function is then re-scanned with the *extended* donating-callee
+   map (imported jit callables + summarized helpers, ``self.helper``
+   methods included); a read of a name after a call that donated it is
+   flagged, exactly like the per-function rule.
+
+Entries already covered by the module-local rule (direct calls to the
+module's own jit-wrapped callables) are excluded, so each defect is
+reported by exactly one rule.
+"""
+
+import ast
+
+from ..core import PackageRule, SEVERITY_ERROR
+from ..jit_index import build_jit_index
+from .donation import _scoped_events
+
+_SELF_OFFSET = 1  # method summaries index params including 'self'
+
+
+class DonationFlowRule(PackageRule):
+    id = "donation-flow"
+    severity = SEVERITY_ERROR
+    description = (
+        "variable donated through a helper call chain (donate_argnums "
+        "reached indirectly) is read after the donating call"
+    )
+
+    def check_package(self, pkg):
+        symbols = pkg.symbols()
+        graph = pkg.callgraph()
+        jit_donors = _jit_donor_map(pkg, symbols)
+        summaries = _donation_summaries(symbols, graph, jit_donors)
+        for path in sorted(symbols.by_path):
+            syms = symbols.by_path[path]
+            ctx = pkg.by_path[path]
+            local_jit = build_jit_index(ctx).donating_callables
+            for qualname in sorted(syms.functions):
+                info = syms.functions[qualname]
+                donating = _donating_map_for(
+                    symbols, syms, info, jit_donors, summaries)
+                # the module-local rule already reports direct calls to
+                # this module's own jit callables — drop them here
+                donating = {name: spec for name, spec in donating.items()
+                            if name not in local_jit}
+                if not donating:
+                    continue
+                yield from self._scan(ctx, info, donating)
+
+    def _scan(self, ctx, info, donating):
+        """The same linear source-order scan as donated-buffer-reuse,
+        against the interprocedural donating map."""
+        donated = {}
+        for exprs, assigned in _scoped_events(info.node):
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if (isinstance(node, ast.Name)
+                            and isinstance(node.ctx, ast.Load)
+                            and node.id in donated):
+                        line, callee, root = donated.pop(node.id)
+                        via = f" (donation reaches jit via {root})" if root else ""
+                        yield self.finding(
+                            ctx, node,
+                            f"'{node.id}' was donated through '{callee}' on "
+                            f"line {line}{via} — its device buffer is "
+                            f"deleted; rebind the result instead of reusing "
+                            f"the input",
+                        )
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = _callee_key(node)
+                    spec = donating.get(callee)
+                    if not spec:
+                        continue
+                    positions, root = spec
+                    for pos in positions:
+                        if 0 <= pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name):
+                            name = node.args[pos].id
+                            if name not in assigned:  # x = f(x) rebinds
+                                donated[name] = (node.lineno, callee, root)
+            for name in assigned:
+                donated.pop(name, None)
+
+
+def _callee_key(call):
+    """Lookup key for a call site: bare name, or 'self.<m>' for method
+    calls on self. Attribute calls on anything else return None — the
+    donating map keys are LOCAL bindings, and collapsing ``other.step``
+    to "step" would convict an unrelated method that happens to share a
+    name with an imported donating callable."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if (isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name)
+            and func.value.id == "self"):
+        return f"self.{func.attr}"
+    return None
+
+
+def _jit_donor_map(pkg, symbols):
+    """{fid-like key "<module>::<name>": positions} of jit-level donating
+    callables per module (from each module's jit index)."""
+    out = {}
+    for ctx in pkg.contexts:
+        syms = symbols.by_path[ctx.path]
+        for name, positions in build_jit_index(ctx).donating_callables.items():
+            out[f"{syms.key}::{name}"] = tuple(positions)
+    return out
+
+
+def _donation_summaries(symbols, graph, jit_donors):
+    """{fid: frozenset(param positions donated by the function's body)}
+    via fixpoint along reverse call edges: a callee whose summary grows
+    can newly donate its callers' arguments."""
+
+    def direct_summary(info, extra):
+        """Param positions donated by calls in ``info``'s body given the
+        current summaries ``extra``."""
+        params = info.param_names()
+        index = {p: i for i, p in enumerate(params)}
+        syms = symbols.modules[info.module]
+        donated = set()
+        from ..callgraph import own_statements
+
+        for node in own_statements(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for pos_list in _donor_positions_at(symbols, syms, info, node,
+                                                jit_donors, extra):
+                for pos in pos_list:
+                    if 0 <= pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name):
+                        i = index.get(node.args[pos].id)
+                        if i is not None:
+                            donated.add(i)
+        return frozenset(donated)
+
+    # fixpoint: start from jit-direct summaries, re-run callers on change
+    summaries = {}
+    work = list(symbols.functions)
+    while work:
+        fid = work.pop()
+        info = symbols.functions[fid]
+        new = direct_summary(info, summaries)
+        if new != summaries.get(fid, frozenset()):
+            summaries[fid] = new
+            work.extend(graph.callers(fid))
+    return {fid: s for fid, s in summaries.items() if s}
+
+
+def _donor_positions_at(symbols, syms, info, call, jit_donors, summaries):
+    """Donated argument-position tuples applying at one call site, from
+    jit donors and function summaries (self-method calls shift by 1)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        name = func.id
+        key = f"{syms.key}::{name}"
+        if key in jit_donors:
+            yield jit_donors[key]
+        obj = symbols.resolve_name(syms, name)
+        from ..callgraph import FunctionInfo
+
+        if isinstance(obj, FunctionInfo):
+            if obj.fid in summaries:
+                yield tuple(summaries[obj.fid])
+        else:
+            imp = symbols.resolve_import(syms, name)
+            if imp is not None and imp[0] == "symbol":
+                key = f"{imp[1].key}::{imp[2]}"
+                if key in jit_donors:
+                    yield jit_donors[key]
+    elif (isinstance(func, ast.Attribute)
+          and isinstance(func.value, ast.Name) and func.value.id == "self"
+          and info.class_name):
+        cls = syms.classes.get(info.class_name)
+        fid = cls.methods.get(func.attr) if cls else None
+        if fid and fid in summaries:
+            yield tuple(p - _SELF_OFFSET for p in summaries[fid]
+                        if p >= _SELF_OFFSET)
+
+
+def _donating_map_for(symbols, syms, info, jit_donors, summaries):
+    """{callee key: (positions, root description)} visible inside one
+    function: imported jit donors, module functions with summaries,
+    imported functions with summaries, and self-methods with summaries.
+
+    Direct calls to this module's OWN jit donors are deliberately absent:
+    the module-local donated-buffer-reuse rule already reports those
+    (check_package strips them by local_jit anyway), and indirect local
+    chains arrive through the function summaries, not this map."""
+    from ..callgraph import FunctionInfo
+
+    out = {}
+    # imported names -> jit donors or summarized functions elsewhere
+    for local, target in syms.imports.items():
+        if target[0] != "symbol":
+            continue
+        imp = symbols.resolve_import(syms, local)
+        if imp is None or imp[0] != "symbol":
+            continue
+        key = f"{imp[1].key}::{imp[2]}"
+        if key in jit_donors:
+            out[local] = (jit_donors[key],
+                          f"{symbols.display(imp[1].key)}.{imp[2]}")
+            continue
+        obj = imp[1].top_level(imp[2])
+        if isinstance(obj, FunctionInfo) and obj.fid in summaries:
+            out[local] = (tuple(sorted(summaries[obj.fid])),
+                          f"{symbols.display(imp[1].key)}.{imp[2]}")
+    # module functions with summaries
+    for qualname, fn in syms.functions.items():
+        if fn.fid in summaries and not fn.class_name and "." not in qualname:
+            out.setdefault(
+                qualname, (tuple(sorted(summaries[fn.fid])), fn.qualname))
+    # self-method calls with summaries (positions shifted past 'self')
+    if info.class_name and info.class_name in syms.classes:
+        cls = syms.classes[info.class_name]
+        for m, fid in cls.methods.items():
+            if fid in summaries:
+                shifted = tuple(sorted(p - _SELF_OFFSET
+                                       for p in summaries[fid]
+                                       if p >= _SELF_OFFSET))
+                if shifted:
+                    out[f"self.{m}"] = (shifted, f"{cls.name}.{m}")
+    return out

@@ -1,0 +1,143 @@
+"""jit-boundary-sync: host syncs in helpers reachable from traced code.
+
+``host-sync-in-jit`` flags ``.item()`` / casts / ``np.asarray`` /
+``print`` *lexically inside* a jit-wrapped function. But tracing follows
+plain Python calls: a helper that ``.item()``s is just as much a
+trace-time host sync when its caller is jitted — and the helper may live
+in another module entirely. This pass:
+
+1. seeds a "traced" taint at every function called from inside a jit
+   context body (resolved through the package symbol table — module
+   functions, ``self.method``, imported symbols);
+2. propagates the taint forward along call edges to fixpoint
+   (``flow.propagate``);
+3. flags every host-sync call inside a tainted function that is not
+   itself a jit context (those are host-sync-in-jit's findings).
+
+The finding names the jit context the taint entered from, so the fix
+(hoist the sync out of the traced path, or ``jax.debug.print``) has its
+root cause attached.
+"""
+
+import ast
+
+from ..core import PackageRule, SEVERITY_ERROR
+from ..callgraph import FunctionInfo, own_statements
+from ..flow import propagate
+from ..jit_index import build_jit_index
+from .host_sync import HostSyncInJitRule
+
+
+class JitBoundarySyncRule(PackageRule):
+    id = "jit-boundary-sync"
+    severity = SEVERITY_ERROR
+    description = (
+        "host-synchronizing call in a helper reachable from a jit/pjit/"
+        "shard_map-traced caller (cross-function, cross-module)"
+    )
+
+    def check_package(self, pkg):
+        symbols = pkg.symbols()
+        graph = pkg.callgraph()
+        jit_nodes = {}       # id(func node) -> (ctx, JitContext)
+        indexes = []
+        # two passes: jit_nodes must be COMPLETE before any seeding — a
+        # jit body calling a jit-wrapped function from a later-processed
+        # module would otherwise seed it as a plain helper and every
+        # downstream finding would name the wrong jit root
+        for ctx in pkg.contexts:
+            index = build_jit_index(ctx)
+            indexes.append((ctx, index))
+            for jc in index.contexts:
+                jit_nodes[id(jc.node)] = (ctx, jc)
+        seeds = {}
+        for ctx, index in indexes:
+            syms = symbols.by_path[ctx.path]
+            for jc in index.contexts:
+                root = f"{symbols.display(syms.key)}.{jc.name or '<lambda>'}"
+                for callee in _called_functions(symbols, syms, jc):
+                    if id(callee.node) in jit_nodes:
+                        continue  # calling another jit program: a new trace
+                    seeds.setdefault(callee.fid, set()).add(root)
+        if not seeds:
+            return
+        facts = propagate(
+            {fid: frozenset(roots) for fid, roots in seeds.items()},
+            lambda fid, fact: (
+                (e.callee, fact) for e in graph.out.get(fid, ())
+                if id(symbols.functions[e.callee].node) not in jit_nodes
+            ),
+        )
+        sync = HostSyncInJitRule._host_sync_call
+        for fid in sorted(facts):
+            info = symbols.functions[fid]
+            if id(info.node) in jit_nodes:
+                continue
+            ctx = pkg.by_path.get(info.path)
+            if ctx is None:
+                continue
+            roots = sorted(facts[fid])
+            shown = roots[0] + (f" (+{len(roots) - 1} more)"
+                                if len(roots) > 1 else "")
+            seen = set()
+            for node in own_statements(info.node):
+                hit = sync(node)
+                if hit is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, node,
+                    f"{hit} in '{info.qualname}' runs under trace — it is "
+                    f"called (transitively) from jit-compiled '{shown}'; "
+                    f"hoist the sync out of the traced path or use "
+                    f"jax.debug primitives",
+                )
+
+
+def _called_functions(symbols, syms, jc):
+    """FunctionInfos called from a jit context's body (best-effort name
+    resolution; ``self.method`` resolves when the jitted def is a class
+    method)."""
+    body = jc.node.body if isinstance(jc.node.body, list) else [jc.node.body]
+    cls = None
+    # a jitted method: find its class via the symbol table
+    for info in syms.functions.values():
+        if info.node is jc.node and info.class_name:
+            cls = syms.classes.get(info.class_name)
+            break
+    for stmt in body:
+        for node in _walk_excluding_scopes(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            obj = None
+            if isinstance(func, ast.Name):
+                obj = symbols.resolve_name(syms, func.id)
+            elif (isinstance(func, ast.Attribute)
+                  and isinstance(func.value, ast.Name)):
+                if func.value.id == "self" and cls is not None:
+                    fid = cls.methods.get(func.attr)
+                    obj = symbols.functions.get(fid) if fid else None
+                else:
+                    from ..callgraph import _resolve_callable
+
+                    obj = _resolve_callable(symbols, syms, func)
+            if isinstance(obj, FunctionInfo):
+                yield obj
+
+
+def _walk_excluding_scopes(stmt):
+    """ast.walk that does not descend into nested function/class defs —
+    a def *inside* a jit body only traces when called, and if it is
+    called from the body the call edge carries the taint."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,130 @@
+"""stale-suppression: ``# ds-lint: disable=`` comments that mute nothing.
+
+The mirror of the gate's stale-baseline-entry test: a suppression whose
+rule no longer fires on the governed line is paid-off debt that should
+be deleted — leaving it in place silently licenses the defect to come
+back without review.
+
+Runs as a post-pass over the *raw* (pre-suppression) findings of the
+rules active in this run:
+
+- ``disable=<rule>`` (trailing or standalone) is live when a raw finding
+  of that rule lands on a governed line;
+- ``disable=all`` is live when *any* raw finding lands there;
+- ``disable-file=<rule>`` is live when the rule fires anywhere in the
+  file.
+
+Rules named in a suppression but not active in this run are skipped —
+``ds-lint --rule X`` must not declare every other rule's suppressions
+stale. Unknown rule ids are flagged (typos hide real suppressions).
+Package-level rules are additionally skipped when the run analyzed only
+part of the file's package (``package_scope_complete``): a single-file
+lint misses the cross-module callers that keep e.g. a
+jit-boundary-sync suppression live, and incomplete evidence must not
+read as staleness.
+"""
+
+from ..core import Rule, SEVERITY_WARNING
+
+
+class StaleSuppressionRule(Rule):
+    id = "stale-suppression"
+    severity = SEVERITY_WARNING
+    description = (
+        "ds-lint suppression comment whose rule no longer fires on the "
+        "suppressed line (or names an unknown rule id)"
+    )
+    needs_raw = True
+    # disable=all must not mute the rule auditing the disable comment
+    suppress_by_all = False
+
+    def check(self, ctx):
+        return ()  # driven by the analyzer post-pass (check_raw)
+
+    def check_raw(self, ctx, raw_findings, active_ids,
+                  package_scope_complete=True):
+        from . import rules_by_id
+
+        catalog = rules_by_id()
+        known = set(catalog) | {"all"}
+        # package rules' (non-)firing is only evidence when the whole
+        # package was analyzed; under partial scope their suppressions
+        # are unjudgeable, not stale
+        judgeable_ids = set(active_ids) if package_scope_complete else {
+            r for r in active_ids
+            if r in catalog and not catalog[r].package_level}
+        by_line = {}
+        all_rules_in_file = set()
+        for f in raw_findings:
+            if f.rule_id == self.id:
+                continue
+            by_line.setdefault(f.line, set()).add(f.rule_id)
+            all_rules_in_file.add(f.rule_id)
+        for rec in ctx.suppression_records():
+            anchor = _Anchor(rec["line"])
+            unknown = sorted(r for r in rec["rules"] if r not in known)
+            if unknown:
+                yield self.finding(
+                    ctx, anchor,
+                    f"suppression names unknown rule id(s) {unknown} — "
+                    f"typo? (see --list-rules)",
+                )
+            checkable = {r for r in rec["rules"]
+                         if r in judgeable_ids and r != self.id}
+            if rec["form"] == "file":
+                stale = sorted(r for r in checkable
+                               if r not in all_rules_in_file)
+                if stale:
+                    yield self.finding(
+                        ctx, anchor,
+                        f"disable-file suppression for {stale} is stale — "
+                        f"the rule(s) no longer fire anywhere in this file",
+                    )
+                if "all" in rec["rules"]:
+                    # a file-wide mute-EVERYTHING comment deserves the
+                    # same audit as line-form disable=all (same full-run
+                    # evidence bar)
+                    full_run = ((known - {"all", self.id})
+                                <= set(active_ids)
+                                and package_scope_complete)
+                    if full_run and not all_rules_in_file:
+                        yield self.finding(
+                            ctx, anchor,
+                            "disable-file=all suppression is stale — no "
+                            "rule fires anywhere in this file",
+                        )
+                continue
+            governed = set()
+            for line in rec["governed"]:
+                governed |= by_line.get(line, set())
+            if "all" in rec["rules"]:
+                # only judge disable=all when the full catalog ran — under
+                # --rule filtering an inactive rule may be what it mutes —
+                # AND the package scope is complete (a partial run may
+                # hide the package-rule finding it mutes)
+                full_run = ((known - {"all", self.id}) <= set(active_ids)
+                            and package_scope_complete)
+                if full_run and not governed:
+                    yield self.finding(
+                        ctx, anchor,
+                        "disable=all suppression is stale — no rule fires "
+                        "on the suppressed line",
+                    )
+                continue
+            stale = sorted(r for r in checkable if r not in governed)
+            if stale:
+                yield self.finding(
+                    ctx, anchor,
+                    f"suppression for {stale} is stale — the rule(s) no "
+                    f"longer fire on the suppressed line; delete the "
+                    f"comment (or fix the id)",
+                )
+
+
+class _Anchor:
+    """Minimal lineno/col carrier so Rule.finding anchors at the
+    suppression comment itself."""
+
+    def __init__(self, line: int, col: int = 0):
+        self.lineno = line
+        self.col_offset = col

@@ -1,0 +1,247 @@
+"""telemetry-schema: emit sites checked against the event registry.
+
+Every ``telemetry.emit("<kind>", payload)`` in the stack must agree with
+the checked-in registry (``analysis/event_schemas.py``): the kind must be
+registered, the payload must carry every required field, and literal
+field values must type-check. The payload is resolved statically with a
+linear scan of the enclosing function:
+
+- a dict literal (inline or assigned to the payload name) contributes
+  its string keys and value type guesses;
+- ``payload["k"] = v`` / ``payload.setdefault("k", v)`` and
+  ``payload.update({literal})`` contribute keys (conditionally added
+  keys count — required-field checking asks "is the field mentioned on
+  *some* path", the honest static question);
+- ``payload.update(var)`` / ``**spread`` / rebinding the name to a
+  non-literal marks the payload *open*: unknown-key and missing-field
+  checks are skipped (type checks on the keys that were seen still run).
+
+Only receivers that look like a telemetry hub count (``telemetry`` /
+``tele`` / ``_tele`` terminal names), so unrelated ``.emit()`` APIs are
+not captured.
+"""
+
+import ast
+
+from ..core import Rule, SEVERITY_ERROR, dotted_name
+from .. import event_schemas
+
+_HUB_NAMES = {"telemetry", "tele", "_tele"}
+
+# literal/builtin-call value -> type-name guess; None = don't know
+_CAST_TYPES = {"int": "int", "float": "float", "bool": "bool", "str": "str",
+               "len": "int", "round": "number", "dict": "dict",
+               "list": "list", "sorted": "list"}
+
+
+def _is_hub_emit(call) -> bool:
+    func = call.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "emit"):
+        return False
+    recv = dotted_name(func.value)
+    return bool(recv) and recv.rsplit(".", 1)[-1] in _HUB_NAMES
+
+
+def _value_type(node):
+    """Static type-name guess for a payload value, or None."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int"
+        if isinstance(v, float):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        if v is None:
+            return "null"
+        return None
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, ast.JoinedStr):
+        return "str"
+    if isinstance(node, ast.Call):
+        name = ""
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        return _CAST_TYPES.get(name)
+    if isinstance(node, (ast.Compare, ast.BoolOp)):
+        return "bool"
+    return None
+
+
+class _PayloadFacts:
+    """What one emit site's payload statically contains."""
+
+    def __init__(self):
+        self.fields = {}   # key -> value node (last literal write wins)
+        self.open = False  # non-literal content possible
+
+    def add_dict(self, node):
+        for key, value in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                self.fields[key.value] = value
+            else:
+                self.open = True  # **spread or computed key
+
+
+class TelemetrySchemaRule(Rule):
+    id = "telemetry-schema"
+    severity = SEVERITY_ERROR
+    description = (
+        "telemetry.emit() site disagrees with the event-schema registry: "
+        "unknown kind, missing required field, or type-inconsistent field"
+    )
+
+    def check(self, ctx):
+        for func in ast.walk(ctx.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, func)
+
+    def _check_function(self, ctx, func):
+        from ..callgraph import own_statements
+
+        emits = []
+        for node in own_statements(func):
+            if isinstance(node, ast.Call) and _is_hub_emit(node):
+                emits.append(node)
+        if not emits:
+            return
+        for call in emits:
+            if not call.args:
+                continue
+            kind_node = call.args[0]
+            if not (isinstance(kind_node, ast.Constant)
+                    and isinstance(kind_node.value, str)):
+                continue  # dynamic kind: nothing to check statically
+            kind = kind_node.value
+            schema = event_schemas.schema_for(kind)
+            if schema is None:
+                known = ", ".join(sorted(event_schemas.known_kinds()))
+                yield self.finding(
+                    ctx, call,
+                    f"unknown telemetry event kind '{kind}' — register it "
+                    f"in analysis/event_schemas.py (known: {known})",
+                )
+                continue
+            payload = call.args[1] if len(call.args) > 1 else None
+            facts = _resolve_payload(func, call, payload)
+            if facts is None:
+                continue
+            yield from self._check_fields(ctx, call, kind, facts)
+
+    def _check_fields(self, ctx, call, kind, facts):
+        schema = event_schemas.schema_for(kind)
+        if not facts.open:
+            missing = [f for f in schema["required"] if f not in facts.fields]
+            if missing:
+                yield self.finding(
+                    ctx, call,
+                    f"'{kind}' emit is missing required field(s) "
+                    f"{missing} (analysis/event_schemas.py)",
+                )
+            unknown = [
+                f for f in facts.fields
+                if event_schemas.field_types(kind, f) is None
+            ]
+            if unknown:
+                yield self.finding(
+                    ctx, call,
+                    f"'{kind}' emit carries unregistered field(s) "
+                    f"{sorted(unknown)} — add them to the schema registry "
+                    f"and document them in docs/telemetry.md",
+                )
+        for name in sorted(facts.fields):
+            allowed = event_schemas.field_types(kind, name)
+            if allowed is None:
+                continue  # reported above (or payload is open)
+            guess = _value_type(facts.fields[name])
+            if guess is None:
+                continue
+            ok = guess in allowed or (
+                guess == "number" and ({"int", "float"} & allowed)
+            ) or (guess == "int" and "float" in allowed)
+            if not ok:
+                yield self.finding(
+                    ctx, facts.fields[name],
+                    f"'{kind}.{name}' should be "
+                    f"{'/'.join(sorted(allowed))}, this emit passes a "
+                    f"{guess} value",
+                )
+
+
+def _resolve_payload(func, call, payload):
+    """:class:`_PayloadFacts` for an emit's payload argument, or None
+    when nothing useful is statically known."""
+    facts = _PayloadFacts()
+    if isinstance(payload, ast.Dict):
+        facts.add_dict(payload)
+        return facts
+    if not isinstance(payload, ast.Name):
+        return None
+    name = payload.id
+    from ..callgraph import own_statements
+
+    # linear scan of the function in source order up to the emit line:
+    # the last assignment wins; augmentation accumulates
+    events = sorted(
+        (node for node in own_statements(func)
+         if getattr(node, "lineno", 0) <= call.lineno),
+        key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+    )
+    seen_binding = False
+    for node in events:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    facts = _PayloadFacts()
+                    seen_binding = True
+                    if isinstance(node.value, ast.Dict):
+                        facts.add_dict(node.value)
+                    else:
+                        facts.open = True
+                elif (isinstance(target, ast.Subscript)
+                      and isinstance(target.value, ast.Name)
+                      and target.value.id == name):
+                    key = target.slice
+                    if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str):
+                        facts.fields[key.value] = node.value
+                    else:
+                        facts.open = True
+        elif (isinstance(node, ast.AugAssign)
+              and isinstance(node.target, ast.Name)
+              and node.target.id == name):
+            facts.open = True
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if not (isinstance(recv, ast.Name) and recv.id == name):
+                continue
+            if node.func.attr == "update":
+                arg = node.args[0] if node.args else None
+                if isinstance(arg, ast.Dict):
+                    facts.add_dict(arg)
+                elif node.keywords and all(kw.arg for kw in node.keywords):
+                    for kw in node.keywords:
+                        facts.fields[kw.arg] = kw.value
+                else:
+                    facts.open = True
+            elif node.func.attr == "setdefault":
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    if len(node.args) > 1:
+                        facts.fields.setdefault(node.args[0].value,
+                                                node.args[1])
+                else:
+                    facts.open = True
+    if not seen_binding:
+        if not facts.fields:
+            return None
+        # the name was never bound locally (a parameter / closure): the
+        # caller may have set any field — augmentations seen here only
+        # add to it, so type-check those but skip missing/unknown checks
+        facts.open = True
+    return facts

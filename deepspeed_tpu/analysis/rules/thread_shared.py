@@ -1,0 +1,347 @@
+"""thread-shared-state: engine state read from a thread, written by the
+main loop, with no lock and no snapshot.
+
+The serving stack runs real threads: the ops exporter
+(``ThreadingHTTPServer`` handler threads calling the ``health``/
+``status`` callbacks registered on :class:`OpsServer`), launcher output
+pumps (``threading.Thread(target=...)``), and anything a future fleet
+layer adds. A per-function rule cannot see that ``statusz()`` executes
+on a scrape thread while ``step()`` mutates the dicts it reads — this
+package-level pass can:
+
+1. **Thread entry points**, found package-wide:
+   - ``threading.Thread(target=f)`` — ``f`` resolved through the symbol
+     table (module function, nested def, ``self.method``);
+   - handler classes passed to a ``*HTTPServer(...)`` constructor —
+     their ``do_*``/``handle*`` methods run per-connection threads;
+   - function/method references passed as arguments to the constructor
+     of a *thread-owning* class (a class any of whose methods spawns a
+     ``threading.Thread`` or builds a ``*HTTPServer``) — the
+     ``OpsServer(health=self.health, status=self.statusz)`` callback
+     escape.
+2. The **thread-reachable closure** of those entries over the call graph.
+3. For every class with methods on both sides of the boundary: a
+   ``self.<attr>`` READ in thread-reachable code of an attribute the
+   main-side methods WRITE is flagged, unless the read is
+   - inside a ``with self.<lock>:`` region (any context-manager whose
+     dotted name contains ``lock``/``mutex``/``_mu``), or
+   - an **atomic-copy snapshot**: the sole argument of
+     ``list``/``dict``/``tuple``/``set``/``frozenset``/``len``/``sorted``
+     or the receiver of ``.copy()`` — a single C-level op under the GIL.
+
+Writes counted: rebinds (``self.x = ...`` outside ``__init__``),
+subscript/attribute stores through the attr, ``del``, aug-assigns, and
+in-place mutator calls (``.append``/``.update``/...). When the main side
+*rebinds* the attribute the message says so explicitly — an object swap
+(the recovery-rebuild ``self._cb`` replacement) under a live reader is
+the worst instance of this bug class.
+"""
+
+import ast
+
+from ..core import PackageRule, SEVERITY_WARNING, dotted_name, terminal_name
+from ..callgraph import ClassInfo, FunctionInfo, own_statements
+from ..flow import reach
+
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear",
+}
+_SNAPSHOT_CALLS = {"list", "dict", "tuple", "set", "frozenset", "len",
+                   "sorted", "bool"}
+_LOCK_TOKENS = ("lock", "mutex", "_mu")
+
+
+def _is_lock_name(dotted: str) -> bool:
+    last = dotted.rsplit(".", 1)[-1].lower()
+    return any(tok in last for tok in _LOCK_TOKENS) or last == "mu"
+
+
+def _lock_regions(func_node):
+    """(start, end) line spans of ``with <lock-ish>:`` bodies in a
+    function, nested scopes excluded."""
+    spans = []
+    for node in own_statements(func_node):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func  # lock.acquire_timeout(...) style
+            name = dotted_name(expr)
+            if name and _is_lock_name(name):
+                spans.append((node.lineno, node.end_lineno))
+                break
+    return spans
+
+
+def _in_spans(node, spans) -> bool:
+    return any(a <= node.lineno <= b for a, b in spans)
+
+
+class ThreadSharedStateRule(PackageRule):
+    id = "thread-shared-state"
+    severity = SEVERITY_WARNING
+    description = (
+        "instance attribute read on a thread (Thread target / HTTP "
+        "handler / ops-server callback) while main-side methods write it, "
+        "with no lock guard and no atomic-copy snapshot"
+    )
+
+    def check_package(self, pkg):
+        symbols = pkg.symbols()
+        graph = pkg.callgraph()
+        entries = _thread_entries(pkg, symbols)
+        if not entries:
+            return
+        threaded = reach(graph, set(entries))
+        # entry names for messages: fid -> how it became threaded
+        for finding in self._check_classes(pkg, symbols, threaded, entries):
+            yield finding
+
+    def _check_classes(self, pkg, symbols, threaded, entries):
+        for path in sorted(symbols.by_path):
+            syms = symbols.by_path[path]
+            ctx = pkg.by_path[path]
+            for cls_name in syms.classes:
+                cls = syms.classes[cls_name]
+                # every function scoped to this class: methods PLUS defs
+                # nested inside them (the thread pump a method hands to
+                # Thread(target=...) reads self via its closure), keyed
+                # by their class-relative name ("start.pump")
+                scoped = dict(cls.methods)
+                for qualname, finfo in syms.functions.items():
+                    if (finfo.class_name == cls_name
+                            and qualname.startswith(cls_name + ".")):
+                        scoped.setdefault(
+                            qualname[len(cls_name) + 1:], finfo.fid)
+                thread_methods = {
+                    m: fid for m, fid in scoped.items() if fid in threaded
+                }
+                main_methods = {
+                    m: fid for m, fid in scoped.items()
+                    if (fid not in threaded and m != "__init__"
+                        and not m.startswith("__init__."))
+                }
+                if not thread_methods or not main_methods:
+                    continue
+                writes = {}
+                rebinders = {}
+                for m, fid in main_methods.items():
+                    info = symbols.functions[fid]
+                    for attr, rebind in _attr_writes(info.node):
+                        writes.setdefault(attr, m)
+                        if rebind:
+                            rebinders.setdefault(attr, m)
+                if not writes:
+                    continue
+                for m in sorted(thread_methods):
+                    info = symbols.functions[thread_methods[m]]
+                    locks = _lock_regions(info.node)
+                    parents = _parent_map(info.node)
+                    reported = set()
+                    for attr, node in _attr_reads(info.node):
+                        if attr in reported or attr not in writes:
+                            continue
+                        if _in_spans(node, locks) or _is_snapshot_read(
+                                node, parents):
+                            continue
+                        reported.add(attr)
+                        entry = entries.get(thread_methods[m])
+                        via = (f" (thread entry: {entry})"
+                               if entry and entry != f"{cls_name}.{m}" else "")
+                        if attr in rebinders:
+                            how = (f"'{rebinders[attr]}' REBINDS it (object "
+                                   f"swap under a live reader)")
+                        else:
+                            how = f"'{writes[attr]}' mutates it"
+                        yield self.finding(
+                            ctx, node,
+                            f"'{cls_name}.{m}' reads 'self.{attr}' on a "
+                            f"thread{via} while {how} — guard both sides "
+                            f"with one lock or read an atomic copy "
+                            f"(docs/static_analysis.md 'Interprocedural "
+                            f"passes')",
+                        )
+
+
+def _thread_entries(pkg, symbols):
+    """{fid: description} for every function that runs on a non-main
+    thread: Thread targets, HTTP handler methods, and callables escaping
+    into thread-owning constructors."""
+    entries = {}
+    owning = _thread_owning_classes(symbols)
+    for path in sorted(symbols.by_path):
+        syms = symbols.by_path[path]
+        for info in syms.functions.values():
+            cls = syms.classes.get(info.class_name) if info.class_name else None
+            for node in own_statements(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                head = terminal_name(node.func)
+                if head == "Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            fid = _resolve_func_ref(symbols, syms, info, cls,
+                                                    kw.value)
+                            if fid:
+                                entries.setdefault(
+                                    fid, f"Thread target in {info.qualname}")
+                elif head.endswith("HTTPServer"):
+                    for arg in node.args:
+                        handler = _resolve_class_ref(symbols, syms, arg)
+                        if handler is None:
+                            continue
+                        for m, fid in handler.methods.items():
+                            if m.startswith("do_") or m.startswith("handle"):
+                                entries.setdefault(
+                                    fid, f"{handler.name}.{m} HTTP handler")
+                target_cls = _callee_class(symbols, syms, node)
+                if target_cls is not None and target_cls.name in owning:
+                    for arg in list(node.args) + [kw.value
+                                                  for kw in node.keywords]:
+                        fid = _resolve_func_ref(symbols, syms, info, cls, arg)
+                        if fid:
+                            entries.setdefault(
+                                fid,
+                                f"callback handed to thread-owning "
+                                f"'{target_cls.name}'")
+    return entries
+
+
+def _thread_owning_classes(symbols):
+    """Names of classes whose methods spawn a Thread or build an HTTP
+    server — objects that will run callables handed to them on their own
+    threads."""
+    owning = set()
+    for info in symbols.functions.values():
+        if not info.class_name:
+            continue
+        for node in own_statements(info.node):
+            if isinstance(node, ast.Call):
+                head = terminal_name(node.func)
+                if head == "Thread" or head.endswith("HTTPServer"):
+                    owning.add(info.class_name)
+                    break
+    return owning
+
+
+def _resolve_func_ref(symbols, syms, info, cls, node):
+    """fid for a *reference* to a package function/method: bare name
+    (module function or def nested in ``info``), or ``self.method``."""
+    if isinstance(node, ast.Name):
+        nested = syms.functions.get(f"{info.qualname}.{node.id}")
+        if nested is not None:
+            return nested.fid
+        obj = symbols.resolve_name(syms, node.id)
+        return obj.fid if isinstance(obj, FunctionInfo) else None
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and cls is not None):
+        return cls.methods.get(node.attr)
+    return None
+
+
+def _resolve_class_ref(symbols, syms, node):
+    obj = None
+    if isinstance(node, ast.Name):
+        obj = symbols.resolve_name(syms, node.id)
+    return obj if isinstance(obj, ClassInfo) else None
+
+
+def _callee_class(symbols, syms, call):
+    """ClassInfo when ``call`` instantiates a package class."""
+    if isinstance(call.func, ast.Name):
+        obj = symbols.resolve_name(syms, call.func.id)
+        if isinstance(obj, ClassInfo):
+            return obj
+    return None
+
+
+def _attr_reads(func_node):
+    """(attr, node) for every ``self.<attr>`` load in the function's own
+    statements."""
+    for node in own_statements(func_node):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            yield node.attr, node
+
+
+def _attr_writes(func_node):
+    """(attr, is_rebind) for every write through ``self.<attr>``:
+    rebinds, del, aug-assign, stores through a subscript/attribute of it,
+    and in-place mutator method calls."""
+    def self_attr(node):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def self_attr_root(node):
+        # peel .attr / [key] layers so self._cfg.timeout = v and
+        # self._d[k].x = v both count as mutations THROUGH the root
+        # attribute (not rebinds of it)
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            attr = self_attr(node)
+            if attr is not None:
+                return attr
+            node = node.value
+        return None
+
+    for node in own_statements(func_node):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            attr = self_attr(node)
+            if attr:
+                yield attr, isinstance(node.ctx, ast.Store)
+            else:
+                attr = self_attr_root(node.value)
+                if attr:
+                    yield attr, False
+        elif isinstance(node, ast.AugAssign):
+            attr = self_attr(node.target)
+            if attr:
+                yield attr, True
+            elif isinstance(node.target, (ast.Subscript, ast.Attribute)):
+                attr = self_attr_root(node.target.value)
+                if attr:
+                    yield attr, False
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            attr = self_attr_root(node.value)
+            if attr:
+                yield attr, False
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATOR_METHODS:
+                attr = self_attr_root(node.func.value)
+                if attr:
+                    yield attr, False
+
+
+def _parent_map(func_node):
+    """{id(child): parent} over the function's own statements (nested
+    scopes excluded — their reads are theirs)."""
+    parents = {}
+    for node in own_statements(func_node):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _is_snapshot_read(node, parents):
+    """True when the ``self.<attr>`` read is itself an atomic-copy
+    snapshot: sole argument of a copying builtin, or receiver of
+    ``.copy()``."""
+    parent = parents.get(id(node))
+    if parent is None:
+        return False
+    if (isinstance(parent, ast.Call) and len(parent.args) == 1
+            and parent.args[0] is node and not parent.keywords
+            and terminal_name(parent.func) in _SNAPSHOT_CALLS):
+        return True
+    if (isinstance(parent, ast.Attribute) and parent.attr == "copy"
+            and isinstance(parents.get(id(parent)), ast.Call)):
+        return True
+    return False

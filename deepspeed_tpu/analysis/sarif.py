@@ -1,0 +1,85 @@
+"""SARIF 2.1.0 rendering for ds-lint findings.
+
+SARIF (Static Analysis Results Interchange Format) is what code hosts
+ingest for per-PR annotation — ``ds-lint --changed --format sarif`` is
+the CI-gate pairing. Only the small, universally consumed core of the
+format is produced: one run, the rule catalog in
+``tool.driver.rules``, one ``result`` per *new* (non-baselined) finding
+with a physical location. Severities map error -> ``error``, warning ->
+``warning``, info -> ``note``.
+"""
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def render_sarif(report: dict, rules) -> dict:
+    """SARIF log dict from a ds-lint report (``cli._build_report``
+    shape: findings already root-relative) and the active rule
+    instances."""
+    catalog = [
+        {
+            "id": rule.id,
+            "shortDescription": {"text": rule.description or rule.id},
+            "defaultConfiguration": {
+                "level": _LEVELS.get(rule.severity, "warning")},
+        }
+        for rule in sorted(rules, key=lambda r: r.id)
+    ]
+    index = {entry["id"]: i for i, entry in enumerate(catalog)}
+    results = []
+    for f in report["findings"]:
+        result = {
+            "ruleId": f["rule"],
+            "level": _LEVELS.get(f["severity"], "warning"),
+            "message": {"text": f["message"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f["path"]},
+                    "region": {
+                        "startLine": f["line"],
+                        # SARIF columns are 1-based; ast's are 0-based
+                        "startColumn": f["col"] + 1,
+                    },
+                },
+            }],
+        }
+        if f["rule"] in index:
+            result["ruleIndex"] = index[f["rule"]]
+        if f.get("code"):
+            result["locations"][0]["physicalLocation"]["region"]["snippet"] \
+                = {"text": f["code"]}
+        results.append(result)
+    for err in report.get("parse_errors", ()):
+        results.append({
+            "ruleId": "parse-error",
+            "level": "error",
+            "message": {"text": err["error"]},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": err["path"]},
+                },
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "ds-lint",
+                    # informationUri is omitted: SARIF 2.1.0 §3.19.2
+                    # requires an ABSOLUTE URI and this repo has no
+                    # canonical public URL; strict ingesters reject the
+                    # whole run over a relative value. The rule catalog's
+                    # helpUri-free shortDescription entries carry the docs
+                    # pointer instead.
+                    "rules": catalog,
+                },
+            },
+            "results": results,
+        }],
+    }

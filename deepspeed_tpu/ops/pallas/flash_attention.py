@@ -475,7 +475,9 @@ def flash_attention(
         assert causal, "sliding-window flash attention requires causal=True"
         assert q.shape[1] == k.shape[1], (
             "sliding-window flash attention requires equal q/k sequence lengths")
-        window = int(window)
+        # static kernel-geometry int (never a traced array): the cast
+        # normalizes np.int64-style configs at trace time, no host sync
+        window = int(window)  # ds-lint: disable=jit-boundary-sync
         assert window >= 1, f"window must be >= 1, got {window}"
     interpret = _auto_interpret(interpret)
     vma = tuple(vma) if vma else None

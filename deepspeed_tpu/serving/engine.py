@@ -57,6 +57,7 @@ tests exact.
             ...                   # pulls srv.step() under the hood
 """
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -212,6 +213,19 @@ class ServingEngine:
         self._created = clock()   # uptime zero for /statusz
         self._draining = False    # drain(): admission closed, work finishes
         self._ops_server = None   # live ops plane (start_ops_server)
+        # Ops-plane read lock (docs/static_analysis.md "Interprocedural
+        # passes", docs/telemetry.md "Live ops plane"): the exporter's
+        # handler threads call health()/statusz()/tick_stats() while the
+        # tick loop runs. The ONE discipline: those readers hold this
+        # RLock; the tick loop takes it only around the engine swap in
+        # _restore_onto (the single multi-step mutation whose
+        # intermediate states — half-restored engine, cleared _running —
+        # must never be scraped). Everything else the readers touch is
+        # either read under the lock as an atomic copy (list/dict of a
+        # container the main thread mutates in place) or a single
+        # attribute load. step() itself never takes the lock: a scrape
+        # can never block the hot path on device work.
+        self._ops_lock = threading.RLock()
         self._tele = engine._eng.telemetry
         self._queue: List[ServeRequest] = []
         self._running: Dict[int, ServeRequest] = {}   # engine rid -> request
@@ -474,8 +488,12 @@ class ServingEngine:
                     self._fail_terminally(
                         build_err, "engine_factory failed at every "
                                    "degradation level")
-        self._rebuild_count += 1
         try:
+            # device-heavy restore (prefix re-prefill + re-admission) runs
+            # against the replacement OFF the ops lock — a /healthz probe
+            # must answer 503 "recovering" instantly, not block for the
+            # whole rebuild; only the final multi-reference swap inside
+            # _restore_onto takes _ops_lock (see the commit block there)
             readmitted = self._restore_onto(new, old_hook)
         except Exception as restore_err:  # noqa: BLE001 — restore failure is terminal
             # a replacement that cannot be restored (prefix prefill or
@@ -511,7 +529,18 @@ class ServingEngine:
         serving-level prefixes, and re-admit every running request
         mid-stream. Returns the re-admission count. Raises only when the
         replacement itself is unusable (the caller converts that into
-        the terminal-failure path)."""
+        the terminal-failure path).
+
+        Lock discipline: the device-heavy work (prefix re-prefill,
+        re-admission prefills) targets only the replacement engine and
+        LOCAL tables, off ``_ops_lock`` — a concurrent scrape keeps
+        answering from the lost engine's last state (breaker open, so
+        ``/healthz`` says 503 "recovering" instantly instead of blocking
+        for the whole rebuild). Only the final multi-reference commit —
+        engine swap + prefix/running/staged tables + the generation
+        bump — runs under the lock, so ``statusz()``/``health()``/
+        ``tick_stats()`` see the old engine or the fully restored one,
+        never the in-between."""
         cfg = self.recovery_cfg
         # adopt the serving hub on the replacement: ONE trace writer and
         # metrics registry across engine generations (factories build
@@ -528,20 +557,19 @@ class ServingEngine:
             new.pipeline_depth = self._pipeline_depth
         if cfg.fetch_timeout_s is not None:
             new.fetch_timeout_s = cfg.fetch_timeout_s
-        self._cb = new
-        self._staged.clear()
         # rid continuity: new requests continue the rid sequence the lost
         # engine was on, so their RNG streams match the fault-free run
         new._next_rid = max(new._next_rid, self._rid_watermark)
         # serving-level prefixes survive: re-register on the new engine
-        self._prefix_pids = {spid: new.register_prefix(toks)
-                             for spid, toks in self._prefixes.items()}
+        prefix_pids = {spid: new.register_prefix(toks)
+                       for spid, toks in self._prefixes.items()}
         # re-admit every running request mid-stream, in the lost engine's
         # submission order (deterministic). The RecoveryLog — not the
         # live records — is the source of truth here: it is exactly the
         # jax-free state a cross-process recovery would have.
         readmitted = 0
-        self._running = {}
+        running: Dict[int, ServeRequest] = {}
+        staged: Dict[int, int] = {}
         for entry in self._recovery_log.entries():
             req = self._requests.get(entry["rid"])
             if req is None or req.state != RUNNING:
@@ -564,10 +592,19 @@ class ServingEngine:
                 # the degraded engine cannot hold it — shed honestly
                 self._mark_lost(req, f"readmit_failed: {e}")
                 continue
-            self._running[erid] = req
-            self._staged[erid] = req.need_tokens
+            running[erid] = req
+            staged[erid] = req.need_tokens
             req.recoveries += 1
             readmitted += 1
+        # commit: the one multi-step mutation a scrape must never observe
+        # half-done (the _ops_lock read/swap discipline)
+        with self._ops_lock:
+            self._cb = new
+            self._prefix_pids = prefix_pids
+            self._running = running
+            self._staged.clear()
+            self._staged.update(staged)
+            self._rebuild_count += 1
         return readmitted
 
     def _finish_recovered(self, req: ServeRequest, entry: dict):
@@ -581,14 +618,12 @@ class ServingEngine:
                      "batch": 1, "prompt_tokens": len(entry["prompt"]),
                      "new_tokens": len(entry["emitted"]),
                      "recovered_finish": True}
-            # enrich through the one event-hook path (queue_ms/ttft/
-            # priority/tenant + the single SLO verdict); the hook looks
-            # requests up by engine rid, so register transiently
-            self._running[entry["engine_rid"]] = req
-            try:
-                event = self._event_hook(entry["engine_rid"], event) or event
-            finally:
-                self._running.pop(entry["engine_rid"], None)
+            # enrich through the one enrichment path (queue_ms/ttft/
+            # priority/tenant + the single SLO verdict) with the request
+            # in hand — never a transient write to the live _running
+            # table (this runs off _ops_lock during restore; a scrape
+            # could observe the intermediate entry)
+            event = self._enrich_event(req, event) or event
             self._tele.emit("inference_request", event)
         self._finish_request(req, np.concatenate([
             np.asarray(entry["prompt"], np.int32),
@@ -636,8 +671,10 @@ class ServingEngine:
     def _open_breaker(self, now: float):
         if self._breaker_open:
             return
-        self._breaker_open = True
-        self._outage_start = now
+        with self._ops_lock:  # serialize with statusz(): its health/
+            # breaker_open fields must come from one consistent state
+            self._breaker_open = True
+            self._outage_start = now
         self._fault_event("breaker", state="open")
 
     def _close_breaker(self):
@@ -646,9 +683,10 @@ class ServingEngine:
         now = self._clock()
         outage_ms = ((now - self._outage_start) * 1000.0
                      if self._outage_start is not None else 0.0)
-        self._outage_ms_total += outage_ms
-        self._breaker_open = False
-        self._outage_start = None
+        with self._ops_lock:
+            self._outage_ms_total += outage_ms
+            self._breaker_open = False
+            self._outage_start = None
         self._fault_event("breaker", state="closed",
                           outage_ms=round(outage_ms, 3))
 
@@ -744,7 +782,8 @@ class ServingEngine:
         reopens admission."""
         if self._draining:
             return
-        self._draining = True
+        with self._ops_lock:  # consistent with a concurrent statusz()
+            self._draining = True
         if self._tele.enabled:
             self._tele.emit("serving_event", {
                 "event": "drain", "queue_depth": len(self._queue),
@@ -754,7 +793,8 @@ class ServingEngine:
         """Reopen admission after :meth:`drain` (replica back in rotation)."""
         if not self._draining:
             return
-        self._draining = False
+        with self._ops_lock:
+            self._draining = False
         if self._tele.enabled:
             self._tele.emit("serving_event", {"event": "resume"})
 
@@ -774,60 +814,64 @@ class ServingEngine:
         - ``"ok"`` — take traffic.
 
         Only ``"ok"`` answers HTTP 200 on ``/healthz``."""
-        if self._breaker_open:
-            return "recovering"
-        if getattr(self._cb, "poisoned", False):
-            return "poisoned"
-        if self._draining:
-            return "draining"
-        return "ok"
+        with self._ops_lock:  # exporter-thread read discipline
+            if self._breaker_open:
+                return "recovering"
+            if getattr(self._cb, "poisoned", False):
+                return "poisoned"
+            if self._draining:
+                return "draining"
+            return "ok"
 
     def statusz(self) -> dict:
         """One JSON-shaped snapshot for ``/statusz``: health, uptime,
         pool occupancy, queue depth, committed KV tokens, in-flight tick
         depth, tick overlap accounting, recovery generation, and the
         per-chip HBM attribution. Read-only and safe to call from the
-        ops-server thread: every shared container is atomically copied
-        (dict/list copies are single C-level ops under the GIL) before
-        iteration, so a concurrent ``step()`` can never torn-read it."""
-        now = self._clock()
-        queue = list(self._queue)
-        running = list(dict(self._running).values())
-        requests = list(dict(self._requests).values())
-        counts: Dict[str, int] = {}
-        for r in requests:
-            counts[r.state] = counts.get(r.state, 0) + 1
-        stats = self.tick_stats()
-        out = {
-            "health": self.health(),
-            "uptime_s": round(now - self._created, 3),
-            "draining": self._draining,
-            "pools": self._cb.pool_state(),
-            "queue_depth": len(queue),
-            "running": len(running),
-            "requests": counts,
-            "committed_kv_tokens": (sum(r.need_tokens for r in queue)
-                                    + sum(r.need_tokens for r in running)),
-            "kv_budget_tokens": self.kv_budget_tokens,
-            "inflight_depth": len(self._cb._inflight),
-            "pipeline_depth": self._cb.pipeline_depth,
-            "ticks": stats.get("ticks", 0),
-            "overlap_frac": stats.get("overlap_frac"),
-            "block_ms_per_token": stats.get("block_ms_per_token"),
-            "recovery_generation": self._rebuild_count,
-            "breaker_open": self._breaker_open,
-        }
-        try:
-            from deepspeed_tpu.telemetry import memory as hbm
+        ops-server thread: the whole read runs under ``_ops_lock`` (the
+        shared read/swap discipline — a recovery rebuild can therefore
+        never swap ``_cb`` out from under a half-built snapshot), with
+        shared containers additionally copied atomically before
+        iteration so a concurrent ``step()`` can never torn-read them."""
+        with self._ops_lock:
+            now = self._clock()
+            queue = list(self._queue)
+            running = list(dict(self._running).values())
+            requests = list(dict(self._requests).values())
+            counts: Dict[str, int] = {}
+            for r in requests:
+                counts[r.state] = counts.get(r.state, 0) + 1
+            stats = self.tick_stats()
+            out = {
+                "health": self.health(),
+                "uptime_s": round(now - self._created, 3),
+                "draining": self._draining,
+                "pools": self._cb.pool_state(),
+                "queue_depth": len(queue),
+                "running": len(running),
+                "requests": counts,
+                "committed_kv_tokens": (sum(r.need_tokens for r in queue)
+                                        + sum(r.need_tokens for r in running)),
+                "kv_budget_tokens": self.kv_budget_tokens,
+                "inflight_depth": len(self._cb._inflight),
+                "pipeline_depth": self._cb.pipeline_depth,
+                "ticks": stats.get("ticks", 0),
+                "overlap_frac": stats.get("overlap_frac"),
+                "block_ms_per_token": stats.get("block_ms_per_token"),
+                "recovery_generation": self._rebuild_count,
+                "breaker_open": self._breaker_open,
+            }
+            try:
+                from deepspeed_tpu.telemetry import memory as hbm
 
-            comps = self._cb.hbm_components()
-            out["hbm_bytes"] = comps
-            headroom = hbm.headroom_bytes(self._tele, comps)
-            if headroom is not None:
-                out["hbm_headroom_bytes"] = headroom
-        except Exception:  # noqa: BLE001 — status must render even mid-rebuild
-            pass
-        return out
+                comps = self._cb.hbm_components()
+                out["hbm_bytes"] = comps
+                headroom = hbm.headroom_bytes(self._tele, comps)
+                if headroom is not None:
+                    out["hbm_headroom_bytes"] = headroom
+            except Exception:  # noqa: BLE001 — status must render even mid-rebuild
+                pass
+            return out
 
     def hbm_headroom_bytes(self) -> Optional[int]:
         """Per-chip HBM headroom (configured/backend limit minus the live
@@ -868,7 +912,8 @@ class ServingEngine:
         in-process view of what ``ds_trace_report --serve`` computes from
         ``serving_tick`` trace events, and what ``ds_loadgen``'s
         ``--pipeline-depth`` A/B compares."""
-        s = self._cb.tick_stats()
+        with self._ops_lock:  # exporter-thread read discipline
+            s = self._cb.tick_stats()
         cap = s.get("capacity_tokens", 0)
         s["utilization"] = round(s["tokens"] / cap, 4) if cap else 0.0
         return s
@@ -1121,6 +1166,13 @@ class ServingEngine:
         req = self._running.get(engine_rid)
         if req is None:
             return None  # a direct engine.submit request: leave it alone
+        return self._enrich_event(req, event)
+
+    def _enrich_event(self, req: ServeRequest, event: dict) -> dict:
+        """The enrichment body, callable with the request in hand —
+        `_finish_recovered` uses this directly so it never has to
+        transiently register the request in the live `_running` table
+        (an off-lock write a concurrent scrape could observe)."""
         now = self._clock()
         event["path"] = "serving"
         event["request"] = req.rid

@@ -1,0 +1,44 @@
+"""Fixture: telemetry emit sites vs the event-schema registry. Expected
+telemetry-schema findings (line): 8 unknown kind, 12 missing required
+fields, 19 type-inconsistent compile_ms, 27 unregistered field. The
+clean emits (and the non-hub .emit() at the bottom) report nothing."""
+
+
+def unknown_kind(tele):
+    tele.emit("serving_ticks", {"dispatch_ms": 0.1})
+
+
+def missing_required(tele):
+    tele.emit("memory_snapshot", {"reason": "build"})
+
+
+def wrong_type(tele):
+    tele.emit("compile_event", {
+        "family": "pool_tick",
+        "key": "k1",
+        "compile_ms": "fast",
+        "recompile": True,
+    })
+
+
+def unregistered_field(telemetry):
+    event = {"event": "shed"}
+    event["bogus_field"] = 1
+    telemetry.emit("serving_event", event)
+
+
+def clean_literal(tele):
+    tele.emit("serving_tick", {
+        "dispatch_ms": 0.1, "block_ms": 0.0, "inflight": 1,
+        "emitted": 4, "wasted": 0, "fused_prefill": False,
+    })
+
+
+def clean_open_payload(tele, extra):
+    event = {"event": "fault"}
+    event.update(extra)
+    tele.emit("serving_fault", event)
+
+
+def not_a_hub(bus):
+    bus.emit("serving_ticks", {"whatever": 1})

@@ -1,0 +1,34 @@
+"""Fixture: helper-indirected donation. 'dispatch' forwards its 'state'
+param into step's donated position, so 'loop' reading 'state' after the
+dispatch call hits a deleted buffer. Expected donation-flow finding
+(line): 22 read of 'state'. 'direct' (line 28) belongs to the module-
+local donated-buffer-reuse rule, not donation-flow."""
+import jax
+
+
+def tick(params, state):
+    return params, state
+
+
+step = jax.jit(tick, donate_argnums=(1,))
+
+
+def dispatch(params, state):
+    return step(params, state)
+
+
+def loop(params, state):
+    out = dispatch(params, state)
+    leak = state.sum()
+    return out, leak
+
+
+def direct(params, state):
+    out = step(params, state)
+    return out, state.sum()
+
+
+def clean_loop(params, state):
+    for _ in range(4):
+        params, state = dispatch(params, state)
+    return state

@@ -1,0 +1,94 @@
+"""Fixture: FROZEN pre-fix copy (trimmed) of the PR 8 ops plane — the
+exporter callbacks exactly as they shipped before the ds-lint v2 lock
+discipline landed (serving/engine.py + telemetry/ops_server.py). This is
+the acceptance anchor for the thread-shared-state rule: it must keep
+catching the real findings the interprocedural pass surfaced —
+``health()``/``statusz()``/``tick_stats()`` reading ``_cb``/
+``_breaker_open``/``_draining``/``_rebuild_count`` while the tick loop's
+``_restore_onto()``/``_open_breaker()``/``drain()``/``_rebuild()``
+rebind them with no lock. Do NOT "fix" this file; it is a regression
+pin. Expected findings: see test_interprocedural.py."""
+import threading
+
+
+class OpsServer:
+    def __init__(self, registry=None, health=None, status=None):
+        self._registry = registry
+        self._health = health
+        self._status = status
+        self._thread = None
+
+    def health(self):
+        return self._health() if self._health is not None else "ok"
+
+    def status(self):
+        return self._status() if self._status is not None else {}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self):
+        while True:
+            self.health()
+            self.status()
+
+
+class ServingEngine:
+    def __init__(self, engine):
+        self._cb = engine
+        self._queue = []
+        self._running = {}
+        self._breaker_open = False
+        self._draining = False
+        self._rebuild_count = 0
+        self._ops_server = None
+
+    def health(self):
+        if self._breaker_open:
+            return "recovering"
+        if getattr(self._cb, "poisoned", False):
+            return "poisoned"
+        if self._draining:
+            return "draining"
+        return "ok"
+
+    def statusz(self):
+        queue = list(self._queue)
+        running = list(dict(self._running).values())
+        return {
+            "health": self.health(),
+            "draining": self._draining,
+            "pools": self._cb.pool_state(),
+            "queue_depth": len(queue),
+            "running": len(running),
+            "ticks": self.tick_stats().get("ticks", 0),
+            "recovery_generation": self._rebuild_count,
+            "breaker_open": self._breaker_open,
+        }
+
+    def tick_stats(self):
+        s = self._cb.tick_stats()
+        s["utilization"] = 0.0
+        return s
+
+    def start_ops_server(self):
+        self._ops_server = OpsServer(
+            health=self.health, status=self.statusz).start()
+        return self._ops_server
+
+    def _open_breaker(self):
+        self._breaker_open = True
+
+    def drain(self):
+        self._draining = True
+
+    def _restore_onto(self, new):
+        self._cb = new
+        self._running = {}
+
+    def _rebuild(self, factory):
+        self._open_breaker()
+        self._restore_onto(factory())
+        self._rebuild_count += 1

@@ -1,0 +1,27 @@
+"""Fixture: host sync hidden behind a call boundary. 'readout' is only a
+host sync because 'step' (jit-compiled) calls it; 'metrics' calls it
+from plain host code and is fine. Expected jit-boundary-sync findings
+(line): 11 .item() and 12 print() in 'readout', 17 float cast in
+'deep_helper' (two hops from the jit root)."""
+import jax
+
+
+def readout(x):
+    # both of these force a trace-time host sync when called under jit
+    val = x.item()
+    print(val)
+    return deep_helper(x, val)
+
+
+def deep_helper(x, val):
+    return float(x) + val
+
+
+@jax.jit
+def step(x):
+    return readout(x)
+
+
+def metrics(x):
+    # host-side caller: reachable set is seeded only from jit contexts
+    return readout(x)

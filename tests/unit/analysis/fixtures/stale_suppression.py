@@ -1,0 +1,19 @@
+"""Fixture: suppression hygiene. Expected stale-suppression findings
+(line): 7 stale disable-file (module-mutable-state never fires in this
+file), 10 stale bare-except suppression (nothing fires there), 12 stale
+disable=all, 15 unknown rule id. The live suppression on line 18 is
+clean — and mutes its finding."""
+
+# ds-lint: disable-file=module-mutable-state
+
+
+x = 1  # ds-lint: disable=bare-except
+
+# ds-lint: disable=all
+y = 2
+
+z = 3  # ds-lint: disable=no-such-rule
+
+
+def live(a, b=[]):  # ds-lint: disable=mutable-default-arg
+    return b

@@ -1,0 +1,37 @@
+"""Fixture: seeded cross-thread race. A metrics pump thread reads engine
+state the tick loop rebinds/mutates. Expected thread-shared-state
+findings (line): 22 read of 'stats', 23 read of 'engine'; the locked and
+copy-snapshot reads in _pump_safe and the init-only 'name' read are
+clean."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self.name = "replica-0"
+        self.stats = {"ticks": 0}
+        self.engine = object()
+        self.queue = []
+        self.lock = threading.Lock()
+
+    def start(self):
+        t = threading.Thread(target=self._pump, daemon=True)
+        t.start()
+
+    def _pump(self):
+        depth = self.stats["ticks"]
+        live = self.engine
+        return depth, live, self.name, self._pump_safe()
+
+    def _pump_safe(self):
+        with self.lock:
+            depth = self.stats["ticks"]
+        return depth, len(self.queue)
+
+    def step(self):
+        self.stats["ticks"] += 1
+        self.queue.append(1)
+
+    def rebuild(self):
+        self.engine = object()
+        self.stats = {"ticks": 0}

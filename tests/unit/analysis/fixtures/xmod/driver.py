@@ -1,0 +1,16 @@
+"""Cross-module fixture (driver side): imports the donating jit program
+from tickprog and reads the donated buffer after the call — invisible to
+the module-local rule (step's donate_argnums lives in another file).
+Expected donation-flow finding: the state read in 'drive'."""
+from .tickprog import step
+
+
+def drive(params, state):
+    out = step(params, state)
+    stale = state.sum()
+    return out, stale
+
+
+def clean_drive(params, state):
+    params, state = step(params, state)
+    return params, state

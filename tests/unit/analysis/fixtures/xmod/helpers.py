@@ -1,0 +1,16 @@
+"""Cross-module fixture (host side): helpers with host syncs that are
+only defects when a jitted caller in ANOTHER module reaches them.
+Expected jit-boundary-sync findings here: the .item() and np.asarray
+reads in 'summarize' (called from tickprog.fused, which is jitted)."""
+import numpy as np
+
+
+def summarize(x):
+    total = x.item()
+    arr = np.asarray(x)
+    return total, arr
+
+
+def host_only(x):
+    # nobody jitted calls this: .item() here is fine
+    return x.item()

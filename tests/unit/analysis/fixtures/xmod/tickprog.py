@@ -1,0 +1,18 @@
+"""Cross-module fixture (program side): a donating jit program exported
+to driver.py, and a jitted function whose trace crosses into
+helpers.summarize (another module)."""
+import jax
+
+from .helpers import summarize
+
+
+def tick(params, state):
+    return params, state
+
+
+step = jax.jit(tick, donate_argnums=(1,))
+
+
+@jax.jit
+def fused(x):
+    return summarize(x)

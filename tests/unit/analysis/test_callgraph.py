@@ -1,0 +1,251 @@
+"""Unit tests for the interprocedural substrate: module keys, the
+package symbol table (defs, classes, imports — lazy imports included),
+call-edge resolution shapes, reachability, and the dataflow worklist."""
+
+import os
+import textwrap
+
+from deepspeed_tpu.analysis import ModuleContext
+from deepspeed_tpu.analysis.callgraph import (
+    ClassInfo,
+    FunctionInfo,
+    PackageContext,
+    module_key,
+)
+from deepspeed_tpu.analysis.flow import propagate, reach, set_join
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def pkg_from(sources):
+    """PackageContext from {path: source}."""
+    return PackageContext([
+        ModuleContext.from_source(textwrap.dedent(src), path=path)
+        for path, src in sources.items()
+    ])
+
+
+# -- module keys / symbol table -----------------------------------------
+
+def test_module_key_forms():
+    assert module_key("a/b/c.py") == "a.b.c"
+    assert module_key("a/b/__init__.py") == "a.b"
+    assert module_key("solo.py") == "solo"
+
+
+def test_symbol_table_defs_classes_imports():
+    pkg = pkg_from({
+        "pkg/mod.py": """
+            import threading
+            from pkg.other import helper as h
+
+            def top(x):
+                def inner(y):
+                    return y
+                return inner(x)
+
+            class Engine:
+                def step(self):
+                    return self.tick()
+
+                def tick(self):
+                    return 1
+        """,
+        "pkg/other.py": """
+            def helper(x):
+                return x
+        """,
+    })
+    symbols = pkg.symbols()
+    mod = symbols.modules["pkg.mod"]
+    assert set(mod.functions) == {"top", "top.inner", "Engine.step",
+                                  "Engine.tick"}
+    assert mod.functions["Engine.step"].class_name == "Engine"
+    assert isinstance(mod.top_level("Engine"), ClassInfo)
+    assert mod.imports["threading"] == ("module", "threading")
+    resolved = symbols.resolve_import(mod, "h")
+    assert resolved[0] == "symbol" and resolved[2] == "helper"
+    obj = symbols.resolve_name(mod, "h")
+    assert isinstance(obj, FunctionInfo) and obj.module == "pkg.other"
+
+
+def test_lazy_function_body_imports_resolve():
+    pkg = pkg_from({
+        "pkg/a.py": """
+            def build():
+                from pkg.b import Worker
+                return Worker()
+        """,
+        "pkg/b.py": """
+            class Worker:
+                def __init__(self):
+                    self.x = 1
+        """,
+    })
+    symbols = pkg.symbols()
+    mod = symbols.modules["pkg.a"]
+    assert isinstance(symbols.resolve_name(mod, "Worker"), ClassInfo)
+
+
+def test_lazy_import_never_shadows_module_level_binding():
+    # a function-local lazy import of a name the MODULE also imports must
+    # not hijack module-scope resolution: edges from other functions
+    # would silently follow the wrong callee (donation/taint corruption)
+    pkg = pkg_from({
+        "pkg/mod.py": """
+            from pkg.a import helper
+
+            def uses_module_binding(x):
+                return helper(x)
+
+            def uses_local_binding(x):
+                from pkg.b import helper
+                return helper(x)
+        """,
+        "pkg/a.py": "def helper(x):\n    return x\n",
+        "pkg/b.py": "def helper(x):\n    return x + 1\n",
+    })
+    symbols = pkg.symbols()
+    mod = symbols.modules["pkg.mod"]
+    assert mod.imports["helper"] == ("symbol", "pkg.a", "helper")
+    graph = pkg.callgraph()
+    assert graph.callees("pkg.mod::uses_module_binding") == ["pkg.a::helper"]
+
+
+def test_relative_import_resolution():
+    pkg = pkg_from({
+        "pkg/sub/a.py": "from .b import f\n\ndef g(x):\n    return f(x)\n",
+        "pkg/sub/b.py": "def f(x):\n    return x\n",
+    })
+    graph = pkg.callgraph()
+    assert graph.callees("pkg.sub.a::g") == ["pkg.sub.b::f"]
+
+
+# -- call edges ---------------------------------------------------------
+
+def test_call_edges_name_self_and_import():
+    pkg = pkg_from({
+        "pkg/m.py": """
+            from pkg.util import ext
+
+            def a(x):
+                return b(x) + ext(x)
+
+            def b(x):
+                return x
+
+            class C:
+                def run(self):
+                    return self.helper()
+
+                def helper(self):
+                    return 0
+        """,
+        "pkg/util.py": "def ext(x):\n    return x\n",
+    })
+    graph = pkg.callgraph()
+    assert sorted(graph.callees("pkg.m::a")) == ["pkg.m::b", "pkg.util::ext"]
+    assert graph.callees("pkg.m::C.run") == ["pkg.m::C.helper"]
+    assert graph.callers("pkg.util::ext") == ["pkg.m::a"]
+
+
+def test_local_type_inference_constructor_and_annotation():
+    pkg = pkg_from({
+        "pkg/m.py": """
+            from pkg.w import Worker
+
+            def use():
+                w = Worker()
+                return w.run()
+
+            def annotated(obj):
+                w: "Worker" = obj
+                return w.run()
+        """,
+        "pkg/w.py": """
+            class Worker:
+                def run(self):
+                    return 1
+        """,
+    })
+    graph = pkg.callgraph()
+    assert "pkg.w::Worker.run" in graph.callees("pkg.m::use")
+    assert "pkg.w::Worker.run" in graph.callees("pkg.m::annotated")
+
+
+def test_nested_def_shadows_module_scope():
+    pkg = pkg_from({
+        "m.py": """
+            def pump():
+                return "module"
+
+            def main():
+                def pump():
+                    return "nested"
+                return pump()
+        """,
+    })
+    graph = pkg.callgraph()
+    assert graph.callees("m::main") == ["m::main.pump"]
+
+
+# -- reachability / dataflow --------------------------------------------
+
+def test_reach_closure():
+    pkg = pkg_from({
+        "m.py": """
+            def a():
+                return b()
+
+            def b():
+                return c()
+
+            def c():
+                return 1
+
+            def island():
+                return 2
+        """,
+    })
+    graph = pkg.callgraph()
+    assert reach(graph, {"m::a"}) == {"m::a", "m::b", "m::c"}
+    assert "m::island" not in reach(graph, {"m::a", "m::b"})
+
+
+def test_propagate_joins_facts_to_fixpoint():
+    # diamond: facts from both roots must merge at the sink
+    edges = {"a": ["c"], "b": ["c"], "c": ["d"], "d": []}
+    facts = propagate(
+        {"a": frozenset({"A"}), "b": frozenset({"B"})},
+        lambda n, f: ((nxt, f) for nxt in edges[n]),
+    )
+    assert facts["c"] == {"A", "B"}
+    assert facts["d"] == {"A", "B"}
+
+
+def test_propagate_terminates_on_cycles():
+    edges = {"a": ["b"], "b": ["a"]}
+    facts = propagate(
+        {"a": frozenset({"T"})},
+        lambda n, f: ((nxt, f) for nxt in edges[n]),
+    )
+    assert facts["b"] == {"T"}
+
+
+def test_set_join_change_tracking():
+    merged, changed = set_join(None, {"x"})
+    assert merged == {"x"} and changed
+    merged, changed = set_join(frozenset({"x"}), {"x"})
+    assert not changed
+    merged, changed = set_join(frozenset({"x"}), {"y"})
+    assert merged == {"x", "y"} and changed
+
+
+def test_display_strips_common_prefix():
+    pkg = pkg_from({
+        "root/repo/pkg/a.py": "def f():\n    return 1\n",
+        "root/repo/pkg/sub/b.py": "def g():\n    return 2\n",
+    })
+    symbols = pkg.symbols()
+    assert symbols.display("root.repo.pkg.a") == "a"
+    assert symbols.display("root.repo.pkg.sub.b::g") == "sub.b.g"

@@ -74,6 +74,9 @@ def test_list_rules():
         "host-sync-in-jit", "unsynced-timing", "recompile-hazard",
         "partition-spec-axis", "donated-buffer-reuse", "mutable-default-arg",
         "bare-except", "module-mutable-state",
+        # v2 interprocedural families
+        "thread-shared-state", "donation-flow", "jit-boundary-sync",
+        "telemetry-schema", "stale-suppression",
     ):
         assert rule_id in proc.stdout
 
@@ -128,6 +131,210 @@ def test_write_baseline_merges_out_of_scope_entries(tmp_path):
     assert {e["path"] for e in entries} == {"a.py", "b.py"}
     proc = run_cli(str(a), str(b), "--baseline", str(baseline), "--root", str(tmp_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_sarif_format():
+    proc = run_cli(BAD, "--no-baseline", "--format", "sarif")
+    assert proc.returncode == 1  # findings still gate the exit code
+    log = json.loads(proc.stdout)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ds-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "mutable-default-arg" in rule_ids
+    assert len(run["results"]) == 2
+    result = run["results"][0]
+    assert result["ruleId"] == "mutable-default-arg"
+    assert result["level"] == "warning"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("mutable_default_arg.py")
+    assert loc["region"]["startLine"] == 5
+    assert loc["region"]["startColumn"] >= 1  # SARIF columns are 1-based
+    assert loc["region"]["snippet"]["text"]
+
+
+def test_sarif_clean_run_has_empty_results():
+    proc = run_cli(CLEAN, "--no-baseline", "--format", "sarif")
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["runs"][0]["results"] == []
+
+
+def _git(tmp_path, *argv):
+    return subprocess.run(["git", "-C", str(tmp_path), *argv],
+                          capture_output=True, text=True, timeout=60)
+
+
+def _make_repo(tmp_path):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "clean.py").write_text("def ok(x):\n    return x\n")
+    (tmp_path / "old.py").write_text("def f(x, y=[]):\n    return y\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+
+def test_changed_reports_only_the_diff(tmp_path):
+    _make_repo(tmp_path)
+    # introduce a NEW violation in one file; old.py's debt stays untouched
+    (tmp_path / "clean.py").write_text("def ok(x, y={}):\n    return y\n")
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--format", "json",
+                   "--root", str(tmp_path), str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    # old.py's finding exists but is filtered: only the diff is reported
+    assert {f["path"] for f in report["findings"]} == {"clean.py"}
+    assert report["summary"]["changed_files"] == 1
+    # the whole scope was still ANALYZED (interprocedural context)
+    assert report["summary"]["files_checked"] == 2
+
+
+def test_changed_resolves_diff_against_git_toplevel(tmp_path):
+    """The lint root may sit BELOW the git toplevel (a project dir with
+    its own pyproject inside a bigger repo). git prints toplevel-relative
+    names; joining them onto the nested root used to drop every file and
+    silently report the diff clean — a CI-gate bypass."""
+    _make_repo(tmp_path)
+    inner = tmp_path / "inner"
+    inner.mkdir()
+    (inner / "pyproject.toml").write_text("[tool]\n")  # root marker
+    (inner / "mod.py").write_text("def ok(x):\n    return x\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "inner")
+    (inner / "mod.py").write_text("def ok(x, y=[]):\n    return y\n")
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--root",
+                   str(inner), str(inner))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "mutable-default-arg" in proc.stdout
+
+
+def test_changed_sees_quoted_nonascii_names(tmp_path):
+    """git C-quotes non-ASCII names by default (core.quotepath): the
+    quoted form fails the .py check and would silently drop the file
+    from the per-PR gate — the CLI must force quotepath off."""
+    _make_repo(tmp_path)
+    (tmp_path / "tëst.py").write_text("def g(x, y=[]):\n    return y\n")
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--root",
+                   str(tmp_path), str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "mutable-default-arg" in proc.stdout
+
+
+def test_changed_survives_symlinked_checkout(tmp_path):
+    """git rev-parse --show-toplevel is symlink-resolved while the lint
+    paths may not be; without realpath normalization the intersection is
+    empty and the diff reports clean — a CI-gate bypass."""
+    real = tmp_path / "real"
+    real.mkdir()
+    _make_repo(real)
+    (real / "clean.py").write_text("def ok(x, y={}):\n    return y\n")
+    link = tmp_path / "link"
+    link.symlink_to(real, target_is_directory=True)
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--root",
+                   str(link), str(link))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "mutable-default-arg" in proc.stdout
+
+
+def test_changed_uses_merge_base_not_two_dot_diff(tmp_path):
+    """On a feature branch, --changed master must scope to the branch's
+    own changes: a two-dot diff also reported files changed only
+    UPSTREAM since the fork point, failing the gate on code the PR
+    never touched."""
+    _make_repo(tmp_path)
+    # upstream.py carries a pre-existing defect at the fork point
+    (tmp_path / "upstream.py").write_text("def u(x, y=[]):\n    return y\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "fork-point")
+    _git(tmp_path, "branch", "-m", "master")
+    _git(tmp_path, "checkout", "-qb", "feature")
+    # upstream advances: master modifies upstream.py AFTER the fork
+    _git(tmp_path, "checkout", "-q", "master")
+    (tmp_path / "upstream.py").write_text(
+        "def u(x, y=[]):\n    return y\n\n\ndef v(x):\n    return x\n")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "upstream-only")
+    _git(tmp_path, "checkout", "-q", "feature")
+    # feature's worktree still holds the fork version of upstream.py: a
+    # two-dot diff vs master reports it (and its defect); merge-base
+    # semantics scope it out
+    proc = run_cli("--changed", "master", "--no-baseline", "--format",
+                   "json", "--root", str(tmp_path), str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["findings"] == []  # upstream.py's defect is NOT ours
+    assert report["summary"]["changed_files"] == 0
+
+
+def test_changed_refuses_a_path_as_ref(tmp_path):
+    """nargs='?' binds a following positional path to REF: `--changed
+    some/file.py` must refuse loudly instead of linting the default
+    scope against a bogus (or coincidentally valid) revision."""
+    _make_repo(tmp_path)
+    proc = run_cli("--changed", str(tmp_path / "clean.py"),
+                   "--root", str(tmp_path))
+    assert proc.returncode == 2
+    assert "existing path, not a git ref" in proc.stderr
+
+
+def test_changed_refuses_write_baseline(tmp_path):
+    _make_repo(tmp_path)
+    proc = run_cli("--changed", "HEAD", "--write-baseline", "--root",
+                   str(tmp_path), str(tmp_path))
+    assert proc.returncode == 2
+    assert "--changed" in proc.stderr
+
+
+def test_changed_includes_untracked_files(tmp_path):
+    _make_repo(tmp_path)
+    (tmp_path / "fresh.py").write_text("def g(x, y=[]):\n    return y\n")
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--root",
+                   str(tmp_path), str(tmp_path))
+    assert proc.returncode == 1
+    assert "fresh.py" in proc.stdout
+
+
+def test_changed_no_diff_is_clean(tmp_path):
+    _make_repo(tmp_path)
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--root",
+                   str(tmp_path), str(tmp_path))
+    assert proc.returncode == 0
+    assert "0 changed python file(s)" in proc.stdout
+
+
+def test_changed_no_diff_still_emits_valid_sarif_and_json(tmp_path):
+    """The CI pairing must produce a parseable empty document on PRs
+    touching no .py files — not a prose line."""
+    _make_repo(tmp_path)
+    for fmt in ("sarif", "json"):
+        proc = run_cli("--changed", "HEAD", "--no-baseline", "--format", fmt,
+                       "--root", str(tmp_path), str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        if fmt == "sarif":
+            assert doc["runs"][0]["results"] == []
+        else:
+            assert doc["findings"] == []
+            assert doc["summary"]["changed_files"] == 0
+
+
+def test_changed_bad_ref_exits_two(tmp_path):
+    _make_repo(tmp_path)
+    proc = run_cli("--changed", "no-such-ref", "--root", str(tmp_path),
+                   str(tmp_path))
+    assert proc.returncode == 2
+    assert "git" in proc.stderr
+
+
+def test_changed_sarif_pairing(tmp_path):
+    """The per-PR gate shape: --changed + --format sarif."""
+    _make_repo(tmp_path)
+    (tmp_path / "clean.py").write_text("def ok(x, y={}):\n    return y\n")
+    proc = run_cli("--changed", "HEAD", "--no-baseline", "--format", "sarif",
+                   "--root", str(tmp_path), str(tmp_path))
+    assert proc.returncode == 1
+    results = json.loads(proc.stdout)["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["mutable-default-arg"]
 
 
 def test_loader_does_not_import_jax_or_package():

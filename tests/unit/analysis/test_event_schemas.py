@@ -1,0 +1,91 @@
+"""The telemetry schema registry is load-bearing three ways: it must be
+internally consistent, it must cover every emit site in the package
+(the telemetry-schema rule enforces that side in the gate), and
+docs/telemetry.md must document every field it registers — schema, emit
+sites, and docs can only move together."""
+
+import ast
+import os
+import re
+
+from deepspeed_tpu.analysis import event_schemas
+from deepspeed_tpu.analysis.core import iter_python_files
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+PACKAGE = os.path.join(REPO, "deepspeed_tpu")
+DOCS = os.path.join(REPO, "docs", "telemetry.md")
+
+
+def test_registry_is_internally_consistent():
+    event_schemas.validate_registry()
+
+
+def test_field_types_expand_number_and_alternatives():
+    assert event_schemas.field_types("train_step", "step") == {"int"}
+    assert event_schemas.field_types("train_step", "mfu") == {"int", "float"}
+    assert event_schemas.field_types("serving_fault", "mesh") == {
+        "dict", "null"}
+    assert event_schemas.field_types("train_step", "nope") is None
+    assert event_schemas.field_types("no_such_kind", "step") is None
+    # envelope fields resolve for every kind
+    assert event_schemas.field_types("serving_tick", "role") == {"str"}
+
+
+def _emit_kinds_in_package():
+    """Every string-literal kind passed to a telemetry hub .emit() in the
+    package source."""
+    kinds = set()
+    for path in iter_python_files([PACKAGE]):
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit" and node.args):
+                continue
+            kind = node.args[0]
+            if isinstance(kind, ast.Constant) and isinstance(kind.value, str):
+                kinds.add(kind.value)
+    return kinds
+
+
+def test_every_emitted_kind_is_registered():
+    emitted = _emit_kinds_in_package()
+    assert emitted, "no emit sites found — the scan is broken"
+    unregistered = emitted - set(event_schemas.EVENT_SCHEMAS)
+    assert unregistered == set(), (
+        f"emit sites use unregistered kinds {sorted(unregistered)} — add "
+        f"them to analysis/event_schemas.py")
+
+
+def test_docs_document_every_registered_field():
+    """Every field of every registered kind must appear in that kind's
+    docs/telemetry.md section (### `kind: "X"` ... until the next ###)."""
+    with open(DOCS, "r", encoding="utf-8") as fh:
+        doc = fh.read()
+    sections = {}
+    matches = list(re.finditer(r'^### `kind: "([a-z_]+)"`', doc, re.M))
+    for i, m in enumerate(matches):
+        end = matches[i + 1].start() if i + 1 < len(matches) else len(doc)
+        sections[m.group(1)] = doc[m.start():end]
+    missing = []
+    for kind, schema in event_schemas.EVENT_SCHEMAS.items():
+        section = sections.get(kind)
+        if section is None:
+            missing.append(f"{kind}: no '### `kind: \"{kind}\"`' section")
+            continue
+        for name in list(schema["required"]) + list(schema["optional"]):
+            if not re.search(rf"\b{re.escape(name)}\b", section):
+                missing.append(f"{kind}.{name}")
+    assert missing == [], (
+        "docs/telemetry.md does not document these registered fields:\n  "
+        + "\n  ".join(missing))
+
+
+def test_envelope_fields_documented():
+    with open(DOCS, "r", encoding="utf-8") as fh:
+        doc = fh.read()
+    for name in event_schemas.ENVELOPE_FIELDS:
+        assert re.search(rf"`{name}`", doc), (
+            f"envelope field '{name}' undocumented in docs/telemetry.md")

@@ -123,6 +123,24 @@ def test_baseline_version_check(tmp_path):
         raise AssertionError("version 99 should be rejected")
 
 
+def test_overlapping_path_args_report_each_finding_once(tmp_path):
+    """`ds-lint dir dir/file.py` must not load a file twice: duplicate
+    contexts shared one raw-findings list keyed by path and reported
+    every finding quadratically."""
+    (tmp_path / "mod.py").write_text("def f(x, y=[]):\n    return y\n")
+    result = Analyzer(make_rules(["mutable-default-arg"])).check_paths(
+        [str(tmp_path), str(tmp_path / "mod.py")])
+    assert result.files_checked == 1
+    assert len(result.findings) == 1
+    # the same dir through a symlink is also ONE file (realpath dedup)
+    link = tmp_path.parent / (tmp_path.name + "-link")
+    link.symlink_to(tmp_path, target_is_directory=True)
+    result = Analyzer(make_rules(["mutable-default-arg"])).check_paths(
+        [str(tmp_path), str(link)])
+    assert result.files_checked == 1
+    assert len(result.findings) == 1
+
+
 def test_parse_error_reported_not_fatal(tmp_path):
     broken = tmp_path / "broken.py"
     broken.write_text("def oops(:\n")

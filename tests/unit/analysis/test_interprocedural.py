@@ -1,0 +1,356 @@
+"""Fixture tests for the ds-lint v2 interprocedural rule families —
+exact (rule, line) assertions per fixture, the frozen pre-fix ops-plane
+regression pin, cross-module resolution, and baseline round-trips for
+the new rule ids."""
+
+import os
+
+from deepspeed_tpu.analysis import Analyzer, Baseline, make_rules
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+NEW_RULE_IDS = (
+    "thread-shared-state",
+    "donation-flow",
+    "jit-boundary-sync",
+    "telemetry-schema",
+    "stale-suppression",
+)
+
+
+def findings_for(fixture, rule=None):
+    rules = make_rules([rule]) if rule else None
+    return Analyzer(rules).check_paths([os.path.join(FIXTURES, fixture)])
+
+
+def lines(result, rule_id):
+    return sorted(f.line for f in result.findings if f.rule_id == rule_id)
+
+
+# -- thread-shared-state ------------------------------------------------
+
+def test_thread_shared_state_seeded_race():
+    result = findings_for("thread_shared_state.py", "thread-shared-state")
+    assert lines(result, "thread-shared-state") == [22, 23]
+    by_line = {f.line: f for f in result.findings}
+    assert "'Engine._pump'" in by_line[22].message
+    assert "'self.stats'" in by_line[22].message
+    assert "Thread target in Engine.start" in by_line[22].message
+    assert "'self.engine'" in by_line[23].message
+    assert "REBINDS" in by_line[23].message  # rebuild() swaps the object
+
+
+def test_thread_shared_state_lock_and_snapshot_reads_are_clean():
+    """_pump_safe is thread-reachable (called from _pump) but reads under
+    'with self.lock' / through len() — both sides of the documented
+    discipline must stay quiet."""
+    result = findings_for("thread_shared_state.py", "thread-shared-state")
+    flagged_methods = {f.message.split("'")[1] for f in result.findings}
+    assert flagged_methods == {"Engine._pump"}
+
+
+def test_thread_pump_nested_in_method_is_audited(tmp_path):
+    """A thread target defined as a def NESTED inside a method (the
+    launcher-pump idiom) must be registered and audited: previously the
+    ClassDef walk never recursed into method bodies, so the seeded race
+    below produced zero findings."""
+    (tmp_path / "mod.py").write_text(
+        "import threading\n\n\n"
+        "class Engine:\n"
+        "    def start(self):\n"
+        "        def pump():\n"
+        "            while True:\n"
+        "                depth = self._state['depth']\n"
+        "        threading.Thread(target=pump, daemon=True).start()\n\n"
+        "    def step(self):\n"
+        "        self._state = {'depth': 1}\n")
+    result = Analyzer(make_rules(["thread-shared-state"])).check_paths(
+        [str(tmp_path)])
+    assert [f.line for f in result.findings] == [8]
+    (f,) = result.findings
+    assert "'Engine.start.pump'" in f.message
+    assert "'self._state'" in f.message and "REBINDS" in f.message
+
+
+def test_attr_writes_sees_nested_stores():
+    """Stores THROUGH an attribute (self._cfg.timeout = v,
+    self._d[k].x = v, self._cfg.handlers.append(h)) count as mutations
+    of the root attribute, not just direct rebinds/subscripts."""
+    import ast as ast_mod
+    import textwrap
+
+    from deepspeed_tpu.analysis.rules.thread_shared import _attr_writes
+
+    src = textwrap.dedent("""
+        def rebuild(self):
+            self._cfg.timeout = 5
+            self._d[1].x = 2
+            self._cfg.handlers.append(1)
+            self._cb = object()
+    """)
+    fn = ast_mod.parse(src).body[0]
+    writes = {}
+    for attr, rebind in _attr_writes(fn):
+        writes.setdefault(attr, set()).add(rebind)
+    assert writes == {"_cfg": {False}, "_d": {False}, "_cb": {True}}
+
+
+def test_thread_shared_state_catches_frozen_prefix_ops_plane():
+    """Acceptance pin (ISSUE 9): the rule must keep catching the REAL
+    pre-fix PR 8 findings — health/statusz/tick_stats reading engine
+    state the recovery path rebinds — on a frozen copy of the pre-fix
+    code. If this test fails the rule regressed, not the fixture."""
+    result = findings_for("frozen_ops_prefix.py", "thread-shared-state")
+    per_method = {}
+    for f in result.findings:
+        method = f.message.split("'")[1]
+        attr = f.message.split("'self.")[1].split("'")[0]
+        per_method.setdefault(method, set()).add(attr)
+    assert per_method["ServingEngine.health"] == {
+        "_breaker_open", "_cb", "_draining"}
+    assert per_method["ServingEngine.statusz"] >= {
+        "_cb", "_draining", "_rebuild_count", "_breaker_open"}
+    assert per_method["ServingEngine.tick_stats"] == {"_cb"}
+    # the recovery-rebuild engine swap is named on the _cb findings
+    cb = next(f for f in result.findings
+              if "tick_stats" in f.message and "'self._cb'" in f.message)
+    assert "_restore_onto" in cb.message and "REBINDS" in cb.message
+    # the statusz list()/dict() copies stay exempt
+    assert not any("'self._queue'" in f.message for f in result.findings)
+    assert not any("'self._running'" in f.message for f in result.findings)
+
+
+def test_fixed_serving_engine_is_clean():
+    """The shipped (post-fix) serving engine + ops server pass the rule:
+    the _ops_lock discipline is what the gate now enforces."""
+    import deepspeed_tpu.serving as serving_pkg
+    import deepspeed_tpu.telemetry as tele_pkg
+
+    result = Analyzer(make_rules(["thread-shared-state"])).check_paths([
+        os.path.dirname(serving_pkg.__file__),
+        os.path.dirname(tele_pkg.__file__),
+    ])
+    assert result.findings == [], [f.message for f in result.findings]
+
+
+# -- donation-flow ------------------------------------------------------
+
+def test_donation_flow_helper_indirected():
+    result = findings_for("donation_flow.py", "donation-flow")
+    assert lines(result, "donation-flow") == [22]
+    (f,) = result.findings
+    assert "'state'" in f.message and "'dispatch'" in f.message
+    assert f.severity == "error"
+
+
+def test_donation_flow_leaves_direct_calls_to_module_local_rule():
+    """'direct' reads after a direct step() call: the module-local rule
+    owns it; donation-flow must not double-report."""
+    result = findings_for("donation_flow.py")
+    assert lines(result, "donated-buffer-reuse") == [28]
+    assert lines(result, "donation-flow") == [22]
+
+
+def test_donation_flow_cross_module():
+    result = Analyzer(make_rules(["donation-flow"])).check_paths(
+        [os.path.join(FIXTURES, "xmod")])
+    assert [(os.path.basename(f.path), f.line) for f in result.findings] \
+        == [("driver.py", 10)]
+    (f,) = result.findings
+    assert "tickprog.step" in f.message  # names the cross-module jit root
+
+
+def test_donation_flow_ignores_name_collision_on_attribute_calls(tmp_path):
+    """other.step(params) must not match an IMPORTED donor named step —
+    the donating map keys local bindings; collapsing attribute calls to
+    their terminal name convicted unrelated methods (error severity,
+    gate-failing false positive)."""
+    (tmp_path / "donor.py").write_text(
+        "import jax\n\n"
+        "def tick(p, s):\n    return s\n\n"
+        "step = jax.jit(tick, donate_argnums=(1,))\n")
+    (tmp_path / "user.py").write_text(
+        "from donor import step\n\n"
+        "def run(other, params, state):\n"
+        "    out = other.step(params)\n"
+        "    total = params.sum()\n"  # params was NOT donated
+        "    new = step(params, state)\n"
+        "    return out, total, new, state.sum()\n")  # state WAS
+    result = Analyzer(make_rules(["donation-flow"])).check_paths(
+        [str(tmp_path)])
+    hits = [(os.path.basename(f.path), f.line) for f in result.findings]
+    assert hits == [("user.py", 7)]
+    assert "'state'" in result.findings[0].message
+
+
+# -- jit-boundary-sync --------------------------------------------------
+
+def test_jit_boundary_sync_single_module():
+    result = findings_for("jit_boundary_sync.py", "jit-boundary-sync")
+    assert lines(result, "jit-boundary-sync") == [11, 12, 17]
+    by_line = {f.line: f for f in result.findings}
+    assert ".item()" in by_line[11].message
+    assert "print()" in by_line[12].message
+    assert "float() cast" in by_line[17].message  # two hops from the root
+    assert ".step'" in by_line[11].message  # names the jit root
+
+
+def test_jit_boundary_sync_cross_module():
+    result = Analyzer(make_rules(["jit-boundary-sync"])).check_paths(
+        [os.path.join(FIXTURES, "xmod")])
+    hits = sorted((os.path.basename(f.path), f.line) for f in result.findings)
+    assert hits == [("helpers.py", 9), ("helpers.py", 10)]
+    assert all("fused" in f.message for f in result.findings)
+    # host_only is never called from traced code: stays clean
+    assert not any(f.line > 12 for f in result.findings)
+
+
+# -- telemetry-schema ---------------------------------------------------
+
+def test_telemetry_schema_fixture():
+    result = findings_for("bad_emit.py", "telemetry-schema")
+    assert lines(result, "telemetry-schema") == [8, 12, 19, 27]
+    by_line = {f.line: f for f in result.findings}
+    assert "unknown telemetry event kind 'serving_ticks'" in by_line[8].message
+    assert "missing required field" in by_line[12].message
+    assert "total_bytes" in by_line[12].message
+    assert "compile_ms" in by_line[19].message and "str" in by_line[19].message
+    assert "bogus_field" in by_line[27].message
+
+
+def test_telemetry_schema_parameter_payload_is_open():
+    """A payload received as a parameter is caller-built: augmentations
+    seen locally only add to it, so missing/unknown-field checks must
+    not fire (only type checks on the locally seen keys)."""
+    import textwrap
+
+    src = textwrap.dedent("""
+        def send(tele, payload):
+            payload["detail"] = "x"
+            tele.emit("serving_fault", payload)
+
+        def send_bad_type(tele, payload):
+            payload["consecutive"] = "three"
+            tele.emit("serving_fault", payload)
+    """)
+    result = Analyzer(make_rules(["telemetry-schema"])).check_source(src)
+    assert [f.line for f in result.findings] == [7]
+    assert "consecutive" in result.findings[0].message  # type still checked
+
+
+# -- stale-suppression --------------------------------------------------
+
+def test_stale_suppression_fixture():
+    result = findings_for("stale_suppression.py")
+    assert lines(result, "stale-suppression") == [7, 10, 12, 15]
+    by_line = {f.line: f for f in result.findings
+               if f.rule_id == "stale-suppression"}
+    assert "disable-file" in by_line[7].message
+    assert "bare-except" in by_line[10].message
+    assert "disable=all" in by_line[12].message
+    assert "no-such-rule" in by_line[15].message
+    # the live mutable-default-arg suppression is honoured AND not stale
+    assert result.suppressed == 1
+
+
+def test_stale_suppression_unjudgeable_under_partial_package_scope(tmp_path):
+    """A suppression for a PACKAGE-level rule whose liveness depends on
+    cross-module callers must not read as stale when only part of the
+    package is linted (the single-file workflow on flash_attention.py):
+    incomplete evidence is unjudgeable, not staleness."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(
+        "def fetch(x):\n"
+        "    return x.item()  # ds-lint: disable=jit-boundary-sync\n")
+    (pkg / "caller.py").write_text(
+        "import jax\n\n"
+        "from pkg.helper import fetch\n\n"
+        "@jax.jit\n"
+        "def tick(x):\n"
+        "    return fetch(x)\n")
+    # whole package: the suppression is live (and mutes the finding)
+    full = Analyzer().check_paths([str(pkg)])
+    assert not full.findings and full.suppressed == 1
+    # helper.py alone: the jit caller is out of scope — the package-rule
+    # suppression is unjudgeable, NOT stale (per-module rules still are)
+    partial = Analyzer().check_paths([str(pkg / "helper.py")])
+    assert not [f for f in partial.findings
+                if f.rule_id == "stale-suppression"], partial.findings
+
+
+def test_stale_disable_file_all_is_audited(tmp_path):
+    """A file-wide mute-EVERYTHING comment over clean code must be
+    flagged like line-form disable=all — previously only named-rule
+    disable-file suppressions were audited, so one comment could
+    permanently silence every current and future rule unreviewed."""
+    (tmp_path / "mod.py").write_text(
+        "# ds-lint: disable-file=all\n\n\ndef ok(x):\n    return x\n")
+    result = Analyzer().check_paths([str(tmp_path / "mod.py")])
+    assert [(f.rule_id, f.line) for f in result.findings] \
+        == [("stale-suppression", 1)]
+    assert "disable-file=all" in result.findings[0].message
+
+
+def test_stale_suppression_skips_inactive_rules():
+    """Under --rule filtering, suppressions for rules that did not run
+    must not be declared stale."""
+    result = findings_for("stale_suppression.py", "stale-suppression")
+    assert lines(result, "stale-suppression") == [15]  # only the unknown id
+
+
+def test_docstring_mentions_are_not_suppressions():
+    """Suppression syntax quoted inside a docstring/string literal must
+    neither suppress nor be audited (the tokenizer-comment scan)."""
+    src = ('"""doc: write # ds-lint: disable=bare-except on the line"""\n'
+           "x = 1\n")
+    result = Analyzer().check_source(src)
+    assert result.findings == []
+    assert result.suppressed == 0
+
+
+# -- baseline round-trip for the new ids --------------------------------
+
+def test_new_rules_baseline_round_trip(tmp_path):
+    fixtures = [os.path.join(FIXTURES, n) for n in (
+        "thread_shared_state.py", "donation_flow.py", "jit_boundary_sync.py",
+        "bad_emit.py", "stale_suppression.py")]
+    result = Analyzer().check_paths(fixtures)
+    new_findings = [f for f in result.findings if f.rule_id in NEW_RULE_IDS]
+    assert {f.rule_id for f in new_findings} == set(NEW_RULE_IDS)
+    baseline_file = tmp_path / "baseline.json"
+    Baseline.from_findings(result.findings, root=FIXTURES).save(
+        str(baseline_file))
+    reloaded = Baseline.load(str(baseline_file))
+    new, baselined = reloaded.split_new(
+        Analyzer().check_paths(fixtures).findings, root=FIXTURES)
+    assert new == []
+    assert len(baselined) == len(result.findings)
+
+
+def test_package_rule_findings_respect_suppressions():
+    """A suppression comment mutes a package-level rule exactly like a
+    per-module one."""
+    import textwrap
+
+    src = textwrap.dedent("""
+        import threading
+
+
+        class E:
+            def __init__(self):
+                self.state = {}
+
+            def start(self):
+                threading.Thread(target=self._pump).start()
+
+            def _pump(self):
+                return self.state["x"]  # ds-lint: disable=thread-shared-state
+
+            def step(self):
+                self.state["x"] = 1
+    """)
+    result = Analyzer(make_rules(["thread-shared-state"])).check_source(src)
+    assert result.findings == []
+    assert result.suppressed == 1

@@ -10,6 +10,8 @@ three are visible in review). See docs/static_analysis.md.
 
 import os
 
+import pytest
+
 from deepspeed_tpu.analysis import Analyzer, Baseline
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
@@ -18,14 +20,21 @@ PACKAGE = os.path.join(REPO, "deepspeed_tpu")
 BASELINE = os.path.join(REPO, "tools", "ds_lint_baseline.json")
 
 
+@pytest.fixture(scope="module")
+def package_result():
+    """ONE whole-package analysis shared by the gate tests — the full
+    interprocedural pass costs ~5 s and both tests read the same run."""
+    return Analyzer().check_paths([PACKAGE])
+
+
 def _format(findings):
     return "\n".join(
         f"  {f.location()}: [{f.severity}] {f.rule_id}: {f.message}" for f in findings
     )
 
 
-def test_package_has_no_new_findings():
-    result = Analyzer().check_paths([PACKAGE])
+def test_package_has_no_new_findings(package_result):
+    result = package_result
     assert result.files_checked > 100  # the whole package, not a subdir
     assert result.parse_errors == [], result.parse_errors
     baseline = Baseline.load(BASELINE)
@@ -37,12 +46,29 @@ def test_package_has_no_new_findings():
     )
 
 
-def test_baseline_entries_still_exist():
+def test_v2_rule_families_are_active_in_the_gate():
+    """The interprocedural v2 families must be part of the default rule
+    set the gate runs — removing one from the registry silently
+    un-guards the package."""
+    from deepspeed_tpu.analysis import all_rules
+
+    active = {r.id for r in all_rules()}
+    assert active >= {
+        "thread-shared-state", "donation-flow", "jit-boundary-sync",
+        "telemetry-schema", "stale-suppression",
+    }
+    # and the package rules really are package-level (run over the whole
+    # file set at once, not per module)
+    package_level = {r.id for r in all_rules() if r.package_level}
+    assert package_level >= {
+        "thread-shared-state", "donation-flow", "jit-boundary-sync"}
+
+
+def test_baseline_entries_still_exist(package_result):
     """Baseline hygiene: every entry must still match a real finding —
     stale entries mean the debt was paid and the file should shrink."""
-    result = Analyzer().check_paths([PACKAGE])
     baseline = Baseline.load(BASELINE)
-    _, baselined = baseline.split_new(result.findings, root=REPO)
+    _, baselined = baseline.split_new(package_result.findings, root=REPO)
     assert len(baselined) == len(baseline.entries), (
         f"{len(baseline.entries) - len(baselined)} stale baseline entr(y|ies) "
         f"in {BASELINE}: remove entries whose findings no longer occur"

@@ -322,3 +322,103 @@ def test_exporter_never_blocks_tick_loop(setup, tmp_path):
     if blocked_off and blocked_on:
         # generous CI bound; the measured on-chip budget is the 5% A/B
         assert blocked_on <= blocked_off * 3 + 0.05
+
+
+# -- scrape-during-rebuild race discipline (ds-lint v2, ISSUE 9) --------
+#
+# The thread-shared-state pass surfaced real races here: statusz()/
+# health()/tick_stats() read engine state (`_cb`, breaker flags, tick
+# dicts) that the recovery ladder rebinds mid-rebuild. The fix is the
+# documented `_ops_lock` read/swap discipline in ServingEngine; these
+# tests prove it by hammering the exporter-thread entry points from a
+# real thread while fault-injected rebuilds swap the engine under them.
+
+def _scrape_during_rebuild(setup, fault_ticks, min_scrapes, max_ticks=400):
+    plan = FaultPlan([Fault(tick=t, kind="preempt") for t in fault_ticks])
+    # reference streams: the fault-free run (bitwise recovery contract)
+    ref_srv = ServingEngine(_build_cb(setup, telemetry=False))
+    ref_rids = [ref_srv.submit(p, max_new_tokens=m).rid
+                for p, m in zip(PROMPTS, MAX_NEW)]
+    while ref_srv.has_work():
+        ref_srv.step()
+    ref_done = ref_srv.reap()
+    ref = {rid: list(ref_done[rid].tokens) for rid in ref_rids}
+
+    cb = _build_cb(setup, telemetry=False)
+    cb.fault_hook = FaultInjector(plan)
+
+    def factory(mesh_shape=None):
+        # widen the restore window so scrapes really land mid-rebuild:
+        # without the _ops_lock discipline this is where they torn-read
+        time.sleep(0.002)
+        return _build_cb(setup, telemetry=False)
+
+    srv = ServingEngine(cb, engine_factory=factory,
+                        recovery=RecoveryConfig(backoff_s=0.0),
+                        sleep=lambda s: None)
+    errors = []
+    snapshots = {"n": 0, "generations": []}
+    stop = threading.Event()
+
+    def scraper():
+        # the exact exporter-thread entry points, no HTTP overhead
+        while not stop.is_set():
+            try:
+                st = srv.statusz()
+                assert st["health"] in ("ok", "recovering", "poisoned",
+                                        "draining")
+                # one consistent snapshot: the breaker flag and the
+                # health verdict must agree (both read under _ops_lock)
+                assert (st["health"] == "recovering") == st["breaker_open"]
+                assert st["recovery_generation"] >= (
+                    snapshots["generations"][-1]
+                    if snapshots["generations"] else 0)
+                snapshots["generations"].append(st["recovery_generation"])
+                srv.health()
+                srv.tick_stats()
+                snapshots["n"] += 1
+            except Exception as e:  # noqa: BLE001 — the test's whole point
+                errors.append(repr(e))
+                return
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    try:
+        adms = [srv.submit(p, max_new_tokens=m)
+                for p, m in zip(PROMPTS, MAX_NEW)]
+        n = 0
+        while srv.has_work():
+            assert n < max_ticks, "serving did not drain"
+            srv.step()
+            n += 1
+        # keep scraping a beat after the last rebuild settled
+        deadline = time.monotonic() + 2.0
+        while snapshots["n"] < min_scrapes and time.monotonic() < deadline:
+            time.sleep(0.001)
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+        srv.close()
+    assert errors == [], errors
+    assert snapshots["n"] >= min_scrapes
+    done = srv.reap()
+    assert srv.recovery_stats()["rebuilds"] == len(fault_ticks)
+    for a, rid in zip(adms, ref_rids):
+        assert done[a.rid].state == "finished"
+        assert list(done[a.rid].tokens) == ref[rid]  # bitwise across rebuilds
+
+
+def test_scrape_during_rebuild_consistent(setup):
+    """Fast sibling: one preemption-rebuild under a live scraper thread —
+    no torn reads, consistent snapshots, bitwise streams."""
+    _scrape_during_rebuild(setup, fault_ticks=(2,), min_scrapes=10)
+
+
+@pytest.mark.slow
+def test_scrape_during_rebuild_stress(setup):
+    """Slow stress: repeated rebuilds while the scraper hammers
+    statusz/health/tick_stats continuously (the ISSUE 9 acceptance
+    stress for the _ops_lock discipline)."""
+    for _ in range(3):
+        _scrape_during_rebuild(setup, fault_ticks=(2, 5, 8),
+                               min_scrapes=200)
