@@ -914,7 +914,14 @@ def _bench_digest():
                 "deepspeed_tpu/models/transformer.py", "deepspeed_tpu/runtime/engine.py",
                 "deepspeed_tpu/inference/decoding.py",
                 "deepspeed_tpu/inference/continuous.py",
-                "deepspeed_tpu/parallel/partition.py"):
+                "deepspeed_tpu/parallel/partition.py",
+                # ds-audit pins the program contracts the bench candidates
+                # compile under (donation, collective inventory); a contract
+                # or capture change can alter the compiled programs the
+                # winner was probed on — re-probe rather than replay stale
+                "deepspeed_tpu/analysis/program/contracts.py",
+                "deepspeed_tpu/analysis/program/capture.py",
+                "deepspeed_tpu/analysis/program/families.py"):
         try:
             with open(os.path.join(root, rel), "rb") as f:
                 h.update(f.read())

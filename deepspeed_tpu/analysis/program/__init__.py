@@ -1,0 +1,46 @@
+"""ds-audit: program-contract auditing over lowered XLA programs.
+
+Where ds-lint (the parent package) verifies *Python source*, ds-audit
+verifies the *compiled artifact*: donation surviving as input/output
+aliases, the exact collective inventory per mesh width, zero host
+transfers in device-resident families, dtype policy, and static HBM
+ceilings — the hot-path guarantees that only exist in the lowered
+program. See docs/static_analysis.md "Program audit".
+
+Import layering: this package is part of ``deepspeed_tpu.analysis`` and
+therefore must stay importable WITHOUT jax (the ds-lint standalone
+loader). ``artifact``/``contracts``/``rules``/``auditor`` are pure
+stdlib; ``capture``/``families`` import jax lazily inside functions.
+
+Entry points:
+    python tools/ds_audit.py [--mesh 1:1,1:2] [--format text|json|sarif]
+    dstpu_prewarm --audit ...            (audit the real warmed programs)
+    tests/unit/analysis/test_program_gate.py   (the tier-1 gate)
+"""
+
+from .artifact import ProgramArtifact
+from .auditor import ProgramAuditor, audit_artifacts
+from .contracts import (
+    COLLECTIVE_PROFILES,
+    PROGRAM_CONTRACTS,
+    contract_for,
+    expected_collectives,
+    known_families,
+    validate_registry,
+)
+from .rules import ProgramRule, program_rules, program_rules_by_id
+
+__all__ = [
+    "COLLECTIVE_PROFILES",
+    "PROGRAM_CONTRACTS",
+    "ProgramArtifact",
+    "ProgramAuditor",
+    "ProgramRule",
+    "audit_artifacts",
+    "contract_for",
+    "expected_collectives",
+    "known_families",
+    "program_rules",
+    "program_rules_by_id",
+    "validate_registry",
+]

@@ -1,0 +1,426 @@
+"""ProgramArtifact: one lowered XLA program as pure data, plus the
+stdlib-only text parsers the audit rules read it through.
+
+ds-audit's subject is the *compiled artifact*, not Python source: the
+StableHLO module text (donation attrs, custom calls, dtypes, the main
+signature), the post-SPMD compiled HLO text (collectives only exist
+there — SPMD partitioning runs at compile time), and the executable's
+``memory_analysis()`` / ``cost_analysis()`` summaries. Everything in
+this module is stdlib-only so the parsers load (and unit-test) without
+jax — extraction of live programs lives in :mod:`.capture`.
+
+Parsing is line/regex-level by design: HLO text is stable enough for
+op-kind counting and shape extraction, and a full MLIR parser would be
+a liability here. Attribute dicts in the StableHLO signature may nest
+braces *inside quoted strings* (``mhlo.sharding = "{devices=[1,2]}"``),
+so the signature scanner is quote-aware rather than regex-greedy.
+"""
+
+import re
+from dataclasses import dataclass, field
+
+# dtype token -> bytes per element (HLO/StableHLO spellings)
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "i8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2, "i16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "i32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "i64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+# custom_call targets that are compiler annotations, not host transfers
+BENIGN_CUSTOM_CALLS = frozenset({
+    "Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+    "annotate_device_placement", "MoveToHost", "MoveToDevice",
+    "LayoutConstraint", "X64Combine", "X64SplitHigh", "X64SplitLow",
+})
+
+
+def dtype_bytes(token: str) -> int:
+    """Bytes per element for an HLO dtype token (0 when unknown — the
+    caller treats unknown-typed ops as zero-byte rather than guessing)."""
+    return DTYPE_BYTES.get(token, 0)
+
+
+def _shape_numel(dims: str) -> int:
+    """'4x8x16' -> 512; '' (scalar) -> 1."""
+    n = 1
+    for d in dims.split("x"):
+        d = d.strip()
+        if d.isdigit():
+            n *= int(d)
+    return n
+
+
+# one HLO-text tensor type: f32[4,8]{1,0} / s32[3] / pred[] — captures
+# (dtype, dims-with-commas)
+_HLO_TENSOR_RE = re.compile(r"\b([a-z]+\d*)\[([\d,]*)\]")
+# one StableHLO tensor type: tensor<4x8xf32> / tensor<f32> — captures the
+# full payload between the angle brackets
+_STABLE_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+
+
+def hlo_tensor_bytes(dtype: str, dims_csv: str) -> int:
+    numel = 1
+    for d in dims_csv.split(","):
+        d = d.strip()
+        if d.isdigit():
+            numel *= int(d)
+    return numel * dtype_bytes(dtype)
+
+
+def stable_tensor_dtype(payload: str) -> str:
+    """'2x3x64xf32' -> 'f32'; 'f32' -> 'f32' (scalar tensor)."""
+    return payload.rsplit("x", 1)[-1] if "x" in payload else payload
+
+
+def stable_tensor_shape(payload: str):
+    """'2x3x64xf32' -> (2, 3, 64); 'f32' -> ()."""
+    parts = payload.split("x")
+    dims = []
+    for p in parts[:-1]:
+        if p.isdigit():
+            dims.append(int(p))
+        else:  # dynamic ('?') or otherwise unparseable dim
+            return None
+    return tuple(dims)
+
+
+@dataclass
+class CollectiveOp:
+    """One collective op instance in the compiled HLO text."""
+
+    kind: str            # canonical kind (async -start folded in)
+    out_dtype: str
+    out_shape_csv: str   # '4,8' (per-shard, as printed post-SPMD)
+    operand_bytes: int   # sum of operand tensor bytes (per-chip payload)
+    operand_shapes: tuple = ()  # ((dtype, (d0, d1, ...)), ...)
+    line: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "out": f"{self.out_dtype}[{self.out_shape_csv}]",
+                "bytes": self.operand_bytes}
+
+
+@dataclass
+class SignatureArg:
+    index: int
+    dtype: str
+    shape: tuple
+    aliased_output: int = -1  # tf.aliasing_output value, -1 when absent
+
+
+@dataclass
+class ProgramArtifact:
+    """One audited program: identity + raw artifact texts + analyses.
+
+    ``meta`` carries everything the contract rules need that is not in
+    the texts themselves: ``tp`` (mesh tensor width), ``donate`` (was
+    donation requested when building this program), ``donated_leaves``
+    (flat arg leaves jax marked donated — from ``Lowered.args_info``),
+    ``param_shapes`` (global shapes of the model's param leaves, for the
+    param-shaped-collective check), ``dims`` ({batch, width, hidden,
+    vocab}), ``accum_dtypes`` (allowed dot_general output dtypes),
+    ``int8_kv`` (an int8 KV cache rides this program),
+    ``hbm_limit_bytes`` (per-chip ceiling, 0 = unknown).
+    """
+
+    family: str          # contract registry key ("pool_tick", ...)
+    variant: str = ""    # display discriminator ("plain", "burst", ...)
+    stable_text: str = ""
+    hlo_text: str = ""   # compiled (post-SPMD) HLO; "" when compile failed
+    memory: dict = field(default_factory=dict)
+    cost: dict = field(default_factory=dict)
+    meta: dict = field(default_factory=dict)
+    error: str = ""      # extraction failure (lower/compile raised)
+    _cache: dict = field(default_factory=dict, repr=False)
+
+    # -- identity -------------------------------------------------------
+    @property
+    def label(self) -> str:
+        """The finding path: program://family[variant]@tpN."""
+        var = f"[{self.variant}]" if self.variant else ""
+        return f"program://{self.family}{var}@tp{self.tp}"
+
+    @property
+    def tp(self) -> int:
+        return int(self.meta.get("tp", 1))
+
+    def _memo(self, key, builder):
+        if key not in self._cache:
+            self._cache[key] = builder()
+        return self._cache[key]
+
+    # -- donation -------------------------------------------------------
+    @property
+    def donated_leaves(self) -> int:
+        """Flat arg leaves jax marked donated at lowering time."""
+        return int(self.meta.get("donated_leaves", 0))
+
+    def alias_attr_count(self) -> int:
+        """``tf.aliasing_output`` occurrences in the StableHLO main
+        signature — the donations that actually became aliases."""
+        return self.stable_text.count("tf.aliasing_output")
+
+    def compiled_alias_count(self) -> int:
+        """Alias entries in the compiled HLO header's
+        ``input_output_alias={ {0}: (1, {}, may-alias), ... }`` — the
+        aliasing the runtime executes. -1 when no compiled text. The
+        entry dict nests braces (each key is an output-index tuple), so
+        the span is brace-scanned, not regexed."""
+        if not self.hlo_text:
+            return -1
+        header = self.hlo_text.split("\n", 1)[0]
+        start = header.find("input_output_alias=")
+        if start < 0:
+            return 0
+        open_at = header.find("{", start)
+        if open_at < 0:
+            return 0
+        end = _scan_attr_block(header, open_at)
+        return len(re.findall(r"\{[\d,\s]*\}:", header[open_at + 1:end]))
+
+    # -- signature ------------------------------------------------------
+    def signature_args(self):
+        return self._memo("sig_args", lambda: _parse_signature(self.stable_text)[0])
+
+    def result_types(self):
+        """[(dtype, shape), ...] of the main function results."""
+        return self._memo("sig_results", lambda: _parse_signature(self.stable_text)[1])
+
+    # -- collectives ----------------------------------------------------
+    def collectives(self):
+        return self._memo("collectives", lambda: parse_collectives(self.hlo_text))
+
+    def collective_inventory(self) -> dict:
+        """{kind: count} over the compiled HLO text (ops inside scan /
+        while bodies count once — the *program* inventory, not the
+        per-execution trip count)."""
+        inv = {}
+        for op in self.collectives():
+            inv[op.kind] = inv.get(op.kind, 0) + 1
+        return inv
+
+    def collective_bytes(self) -> dict:
+        """{kind: summed operand bytes} (per-chip, text-level)."""
+        out = {}
+        for op in self.collectives():
+            out[op.kind] = out.get(op.kind, 0) + op.operand_bytes
+        return out
+
+    # -- host transfers -------------------------------------------------
+    def host_transfers(self):
+        return self._memo("host", lambda: parse_host_transfers(self.stable_text))
+
+    # -- dtypes ---------------------------------------------------------
+    def f64_types(self):
+        """Distinct tensor-type payloads mentioning f64 anywhere in the
+        StableHLO module."""
+        def build():
+            out = []
+            for payload in set(_STABLE_TENSOR_RE.findall(self.stable_text)):
+                if stable_tensor_dtype(payload) == "f64" or "xf64" in payload:
+                    out.append(payload)
+            return sorted(out)
+        return self._memo("f64", build)
+
+    def dot_outputs(self):
+        """[(in_dtypes tuple, out_dtype), ...] for every
+        ``stablehlo.dot_general`` in the module."""
+        return self._memo("dots", lambda: parse_dot_outputs(self.stable_text))
+
+    def to_dict(self) -> dict:
+        """JSON summary for reports (the texts themselves stay out)."""
+        return {
+            "family": self.family,
+            "variant": self.variant,
+            "tp": self.tp,
+            "donated_leaves": self.donated_leaves,
+            "alias_attrs": self.alias_attr_count(),
+            "collectives": {
+                kind: {"count": self.collective_inventory().get(kind, 0),
+                       "bytes": self.collective_bytes().get(kind, 0)}
+                for kind in self.collective_inventory()
+            },
+            "host_transfers": len(self.host_transfers()),
+            "memory": dict(self.memory),
+            "cost": {k: v for k, v in self.cost.items()
+                     if k in ("flops", "bytes accessed")},
+            "error": self.error,
+        }
+
+
+def _scan_attr_block(text: str, start: int) -> int:
+    """Index just past the ``{...}`` block opening at ``start``,
+    skipping braces inside double-quoted strings (mhlo.sharding values
+    embed ``{devices=[...]}``)."""
+    depth = 0
+    i = start
+    in_str = False
+    while i < len(text):
+        c = text[i]
+        if in_str:
+            if c == '"' and text[i - 1] != "\\":
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+        i += 1
+    return i
+
+
+_ARG_RE = re.compile(r"%arg(\d+): tensor<([^>]*)>")
+_ALIAS_ATTR_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
+def _parse_signature(stable_text: str):
+    """(args, results) of the ``func.func public @main`` signature.
+
+    args: list of :class:`SignatureArg`; results: [(dtype, shape)].
+    Empty lists when the signature is absent/unparseable (rules treat
+    that as "no evidence", never as a violation)."""
+    start = stable_text.find("func.func public @main(")
+    if start < 0:
+        return [], []
+    # the signature runs to the opening "{" of the body; jax prints it on
+    # one line, but scan defensively to the first " {" at paren depth 0
+    i = stable_text.find("(", start)
+    depth = 0
+    end = i
+    while end < len(stable_text):
+        c = stable_text[end]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "{" and depth == 0:
+            break
+        elif c == "{":  # attr dict inside the arg list
+            end = _scan_attr_block(stable_text, end)
+            continue
+        end += 1
+    sig = stable_text[start:end]
+    arrow = sig.rfind("->")
+    arg_part = sig if arrow < 0 else sig[:arrow]
+    res_part = "" if arrow < 0 else sig[arrow:]
+
+    args = []
+    pos = 0
+    while True:
+        m = _ARG_RE.search(arg_part, pos)
+        if m is None:
+            break
+        idx, payload = int(m.group(1)), m.group(2)
+        pos = m.end()
+        aliased = -1
+        # attrs, when present, open immediately after the type
+        rest = arg_part[pos:pos + 2]
+        if rest.lstrip().startswith("{"):
+            open_at = arg_part.index("{", pos)
+            close_at = _scan_attr_block(arg_part, open_at)
+            attrs = arg_part[open_at:close_at]
+            am = _ALIAS_ATTR_RE.search(attrs)
+            if am:
+                aliased = int(am.group(1))
+            pos = close_at
+        shape = stable_tensor_shape(payload)
+        args.append(SignatureArg(index=idx, dtype=stable_tensor_dtype(payload),
+                                 shape=shape if shape is not None else (),
+                                 aliased_output=aliased))
+    results = []
+    for payload in _STABLE_TENSOR_RE.findall(res_part):
+        shape = stable_tensor_shape(payload)
+        results.append((stable_tensor_dtype(payload),
+                        shape if shape is not None else ()))
+    return args, results
+
+
+_COLLECTIVE_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(" + "|".join(COLLECTIVE_KINDS) + r")"
+    r"(-start|-done)?[\w.\-]*\s*=\s*(.*)$")
+
+
+def parse_collectives(hlo_text: str):
+    """Collective op instances in compiled HLO text. Async pairs count
+    once (the ``-done`` half is skipped); each op carries its output
+    type and summed operand bytes from the printed per-shard shapes."""
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_LINE_RE.match(line)
+        if m is None:
+            continue
+        kind, phase, rest = m.group(1), m.group(2), m.group(3)
+        if phase == "-done":
+            continue  # counted at -start
+        # the operand list opens at the paren FOLLOWING the op name — an
+        # async op's tuple-typed result (`(f32[4], f32[4]) all-reduce-
+        # start(...)`) puts an earlier paren in the type position, which
+        # must not be mistaken for operands (it would double the bytes)
+        om = re.search(
+            re.escape(kind) + (phase or "") + r"(?:\.\d+)?\(", rest)
+        paren = om.end() - 1 if om else rest.find("(")
+        type_end = om.start() if om else (paren if paren > 0 else len(rest))
+        out_tokens = _HLO_TENSOR_RE.findall(rest[:type_end])
+        out_dtype, out_csv = out_tokens[0] if out_tokens else ("", "")
+        operand_bytes = 0
+        operand_shapes = []
+        if paren >= 0:
+            # operands run to the matching close paren; HLO operand lists
+            # have no nested parens
+            close = rest.find(")", paren)
+            for dt, csv in _HLO_TENSOR_RE.findall(rest[paren:close]):
+                operand_bytes += hlo_tensor_bytes(dt, csv)
+                dims = tuple(int(d) for d in csv.split(",") if d.strip().isdigit())
+                operand_shapes.append((dt, dims))
+        ops.append(CollectiveOp(kind=kind, out_dtype=out_dtype,
+                                out_shape_csv=out_csv,
+                                operand_bytes=operand_bytes,
+                                operand_shapes=tuple(operand_shapes),
+                                line=line.strip()[:160]))
+    return ops
+
+
+_CUSTOM_CALL_RE = re.compile(r"stablehlo\.custom_call\s+@([\w.\-$]+)")
+_TRANSFER_OP_RE = re.compile(
+    r"\b(?:stablehlo|mhlo)\.(infeed|outfeed|send|recv)\b")
+
+
+def parse_host_transfers(stable_text: str):
+    """[(kind, detail), ...] host-transfer evidence in the StableHLO
+    module: python-callback custom calls (jax.debug.print, io_callback,
+    pure_callback all lower to one), infeed/outfeed, send/recv.
+    Compiler-annotation custom calls (@Sharding et al) are exempt."""
+    out = []
+    for m in _CUSTOM_CALL_RE.finditer(stable_text):
+        target = m.group(1)
+        if target in BENIGN_CUSTOM_CALLS:
+            continue
+        out.append(("custom_call", target))
+    for m in _TRANSFER_OP_RE.finditer(stable_text):
+        out.append((m.group(1), m.group(1)))
+    return out
+
+
+_DOT_TAIL_RE = re.compile(
+    r"stablehlo\.dot_general[^\n]*?:\s*\(([^)]*)\)\s*->\s*tensor<([^>]*)>")
+
+
+def parse_dot_outputs(stable_text: str):
+    """[(operand dtypes, out dtype)] per dot_general — the accumulation-
+    dtype evidence (the output type IS the accumulation type XLA keeps)."""
+    out = []
+    for m in _DOT_TAIL_RE.finditer(stable_text):
+        ins = tuple(stable_tensor_dtype(p)
+                    for p in _STABLE_TENSOR_RE.findall(m.group(1)))
+        out.append((ins, stable_tensor_dtype(m.group(2))))
+    return out

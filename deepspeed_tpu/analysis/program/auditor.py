@@ -1,0 +1,118 @@
+"""ProgramAuditor: run the contract rules over a set of lowered-program
+artifacts, reusing ds-lint's Finding / AnalysisResult / Baseline
+machinery so the CLI, baseline workflow, and SARIF rendering are shared.
+
+Stdlib-only and jax-free: artifacts arrive already extracted (from
+:mod:`.capture` hooks or :mod:`.families` builders); this module only
+judges them.
+"""
+
+import os
+
+from ..core import AnalysisResult
+from .contracts import PROGRAM_CONTRACTS
+from .rules import program_rules
+
+AUDIT_BASELINE = os.path.join("tools", "ds_audit_baseline.json")
+
+
+class ProgramAuditor:
+    """Runs a program-rule set over ProgramArtifacts."""
+
+    def __init__(self, rules=None, contracts=None):
+        self.rules = list(rules) if rules is not None else program_rules()
+        self.contracts = contracts if contracts is not None else PROGRAM_CONTRACTS
+
+    def audit(self, artifacts) -> AnalysisResult:
+        artifacts = list(artifacts)
+        result = AnalysisResult()
+        for artifact in artifacts:
+            contract = self.contracts.get(artifact.family)
+            for rule in self.rules:
+                result.findings.extend(rule.check_program(artifact, contract))
+        result.files_checked = len(artifacts)
+        result.findings = result.sorted_findings()
+        return result
+
+
+def audit_artifacts(artifacts, rules=None, contracts=None) -> AnalysisResult:
+    return ProgramAuditor(rules=rules, contracts=contracts).audit(artifacts)
+
+
+def build_report(result: AnalysisResult, new, baselined, artifacts) -> dict:
+    """JSON report (mirrors cli._build_report, plus the per-program
+    inventory block ``ds_trace_report --audit`` consumes)."""
+    by_rule = {}
+    for f in new:
+        by_rule[f.rule_id] = by_rule.get(f.rule_id, 0) + 1
+    programs = {}
+    for a in artifacts:
+        # two artifacts may share a label (the greedy and sampled plain
+        # ticks at one width) — suffix duplicates so neither drops out
+        # of the report or the comm cross-check byte sums
+        key, n = a.label, 2
+        while key in programs:
+            key = f"{a.label}#{n}"
+            n += 1
+        programs[key] = a.to_dict()
+    return {
+        "version": 1,
+        "tool": "ds-audit",
+        "findings": [f.to_dict() for f in new],
+        "summary": {
+            "programs_audited": len(artifacts),
+            "new": len(new),
+            "baselined": len(baselined),
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+        "programs": programs,
+    }
+
+
+def print_text(report: dict):
+    for f in report["findings"]:
+        print(f"{f['path']}: [{f['severity']}] {f['rule']}: {f['message']}")
+    s = report["summary"]
+    verdict = "clean" if not report["findings"] else "FAIL"
+    print(f"ds-audit: {s['programs_audited']} program(s), {s['new']} new "
+          f"finding(s), {s['baselined']} baselined — {verdict}")
+
+
+def render(report: dict, fmt: str, rules=None) -> str:
+    """The machine formats as a string ('text' prints directly and
+    returns '')."""
+    import json
+
+    if fmt == "json":
+        return json.dumps(report, indent=2, sort_keys=True)
+    if fmt == "sarif":
+        from ..sarif import render_sarif
+
+        return json.dumps(
+            render_sarif(report,
+                         rules if rules is not None else program_rules(),
+                         tool_name="ds-audit"),
+            indent=2)
+    print_text(report)
+    return ""
+
+
+def split_against_baseline(result: AnalysisResult, baseline_path,
+                           no_baseline: bool = False):
+    """(new, baselined) after the audit baseline, mirroring the ds-lint
+    CLI split. Program finding paths are already root-free pseudo-paths
+    (program://...), so no root relativization applies."""
+    from ..baseline import Baseline
+
+    if no_baseline or baseline_path is None or not os.path.exists(baseline_path):
+        return list(result.findings), []
+    baseline = Baseline.load(baseline_path)
+    return baseline.split_new(result.findings, root="")
+
+
+def write_baseline(result: AnalysisResult, baseline_path: str) -> int:
+    from ..baseline import Baseline
+
+    fresh = Baseline.from_findings(result.findings, root="")
+    fresh.save(baseline_path)
+    return len(fresh.entries)

@@ -1,0 +1,243 @@
+"""Checked-in program-contract registry.
+
+One entry per *program family* the stack compiles and dispatches —
+mirroring :mod:`..event_schemas` for trace events: the registry is the
+single place a family's hot-path invariants are declared, the audit
+rules (:mod:`.rules`) enforce it over lowered artifacts, and the tier-1
+gate test (tests/unit/analysis/test_program_gate.py) lowers the shipped
+families on the virtual mesh and asserts the registry holds. A program
+family not registered here is itself a finding (``unregistered-program``)
+— the same "new kinds must register" discipline the telemetry schema
+enforces.
+
+Contract dimensions (each optional; absent = not checked for the family):
+
+- ``donated``: tuple of arg names the builder donates. When the artifact
+  meta says donation was requested (``donate: True``), every donated
+  leaf must surface as an ``input_output_alias`` — a silently-dropped
+  alias doubles the family's HBM traffic on chip.
+- ``collectives``: profile name in :data:`COLLECTIVE_PROFILES`. A
+  profile maps mesh tensor width -> exact {op kind: count} inventory
+  expected in the compiled text (ops inside scan bodies count once).
+  ``None`` from a profile means "width not calibrated": the exact-count
+  check is skipped, the zero-at-tp1 and param-shaped checks still apply.
+  The tables assume every NON-tensor mesh axis is 1 (the subset serving
+  meshes the gate builds); an artifact whose meta reports
+  ``other_axes > 1`` (a live dp/fsdp mesh — grad sync and batch
+  reshards legitimately add collectives) skips the exact-count check
+  entirely.
+- ``param_collectives``: ``"forbid"`` — no collective may move a
+  param-shaped operand (the misplaced-PartitionSpec weight re-gather).
+  Serving/decode families opt in; training families must NOT (grad
+  sync is param-shaped by definition).
+- ``host_transfers``: ``"forbid"`` — no python-callback custom calls,
+  infeed/outfeed, or send/recv anywhere in the module.
+- ``dtype``: dict with ``forbid`` (type tokens that must not appear,
+  default ``("f64",)``), ``matmul_accum`` (``"meta"`` = allowed
+  dot_general output dtypes come from the artifact's ``accum_dtypes``
+  meta), ``int8_kv`` (``"stable"`` = when an int8 KV cache enters the
+  program, an int8 leaf of the same shape must come back out — the
+  cache never round-trips through a wider dtype).
+- ``hbm``: ``"telemetry_limit"`` — the executable's static peak
+  (arguments + outputs + temp - aliased) must fit the configured
+  ``telemetry.hbm_limit_bytes`` when one is set.
+
+Collective-count calibration: the transformer stacks layers through one
+``lax.scan``, so the per-layer collectives appear ONCE in the compiled
+text regardless of depth — the inventory below is depth-independent
+(verified across num_layers 1-3 for every tick variant) and pinned for
+the jaxlib this repo ships against. tp widths beyond the calibrated
+table return None (count check skipped) rather than a guessed number.
+"""
+
+# Inventory tables: {tp: {op: count}}; a missing tp -> None (uncalibrated).
+# tp=1 is {} for every profile — a replicated program must contain ZERO
+# collectives; anything else is a reshard bug costing a cross-chip round
+# trip per dispatch.
+_TICK_FORWARD = {
+    1: {},
+    # Megatron-sharded tick at tp=2. The inventory depends on the
+    # ON-DEVICE sampler the tick compiles in: greedy (temperature<=0)
+    # argmaxes the vocab-sharded logits (layer-scan all-reduces + the
+    # embedding gather's, two logits-head all-gathers); sampled
+    # (temperature>0) categorical draws add a cross-shard reduce and two
+    # collective-permutes for the per-row key fold. Depth-invariant:
+    # layers ride one lax.scan, so body collectives appear once in the
+    # text regardless of num_layers (verified 1-3; see
+    # docs/static_analysis.md "Program audit" calibration notes).
+    2: {"greedy": {"all-reduce": 3, "all-gather": 2},
+        "sampled": {"all-reduce": 4, "all-gather": 2,
+                    "collective-permute": 2}},
+}
+
+_PLAIN_FORWARD = {
+    1: {},
+    # same forward without the on-device sampling head: logits are
+    # returned (sharded gather happens once), so one all-gather
+    2: {"all-reduce": 3, "all-gather": 1},
+}
+
+_LOCAL_ONLY = {1: {}, 2: {}, 4: {}, 8: {}}
+
+# train tables are calibrated in tests/unit/analysis/test_program_gate.py
+# against the shipped tiny config; autodiff + optimizer sharding make
+# them richer than the forward-only tables (grad transposes re-gather,
+# Adam state updates reduce) — the POINT is pinning them, so a sharding
+# change that silently re-routes training traffic fails the gate
+_TRAIN_MICRO = {
+    1: {},
+    2: {"all-reduce": 29, "all-gather": 21, "all-to-all": 1},
+}
+
+_TRAIN_APPLY = {
+    1: {},
+    2: {"all-reduce": 17, "all-gather": 30, "all-to-all": 6},
+}
+
+COLLECTIVE_PROFILES = {
+    # pool tick forward (logits head + on-device sampling)
+    "tick_forward": _TICK_FORWARD,
+    # prefill/segment/decode-step forward (logits returned, no sampler)
+    "plain_forward": _PLAIN_FORWARD,
+    # programs that must never communicate at any width (row updates,
+    # cache splices, pure scatter/gather on replicated state)
+    "local_only": _LOCAL_ONLY,
+    "train_micro": _TRAIN_MICRO,
+    "train_apply": _TRAIN_APPLY,
+}
+
+
+def expected_collectives(profile: str, tp: int, sampled: bool = False):
+    """{op: count} for ``profile`` at mesh tensor width ``tp``, or None
+    when the width is not calibrated (exact-count check skipped). A
+    width entry may split by sampler mode (``greedy``/``sampled`` keys)
+    — ``sampled`` selects; a missing mode key means uncalibrated."""
+    table = COLLECTIVE_PROFILES.get(profile)
+    if table is None:
+        return None
+    entry = table.get(int(tp))
+    if entry is not None and ("greedy" in entry or "sampled" in entry):
+        return entry.get("sampled" if sampled else "greedy")
+    return entry
+
+
+_DTYPE_DEFAULT = {"forbid": ("f64",), "matmul_accum": "meta",
+                  "int8_kv": "stable"}
+
+PROGRAM_CONTRACTS = {
+    # -- continuous-batching pool (inference/continuous.py) -------------
+    "pool_tick": {
+        # decoding.compile_pool_tick_fn donate_argnums=(1, 2, 3)
+        "donated": ("cache", "last_tok", "done"),
+        "collectives": "tick_forward",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    "pool_segment": {
+        # compile_segment_fn donate_argnums=(2,)
+        "donated": ("cache",),
+        "collectives": "plain_forward",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    "pool_row_update": {
+        # compile_row_update_fn donate_argnums=(0, 1)
+        "donated": ("last_tok", "done"),
+        "collectives": "local_only",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+    },
+    # -- engine decode pair (inference/engine.py _compile) --------------
+    "decode_prefill": {
+        # compile_decode_fns prefill donate_argnums=(2,)
+        "donated": ("cache",),
+        "collectives": "plain_forward",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    "decode_step": {
+        # compile_decode_fns decode donate_argnums=(2,)
+        "donated": ("cache",),
+        "collectives": "plain_forward",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    # -- training step programs (runtime/engine.py) ---------------------
+    "train_micro": {
+        # build_micro donate_argnums=(1,) — the grad accumulator
+        "donated": ("grad_acc",),
+        "collectives": "train_micro",
+        "host_transfers": "forbid",
+        "dtype": {"forbid": ("f64",), "matmul_accum": "meta"},
+        "hbm": "telemetry_limit",
+    },
+    "train_apply": {
+        # apply_fn donate_argnums=(0, 1, 2, 3)
+        "donated": ("params", "master", "opt_state", "grad_acc"),
+        "collectives": "train_apply",
+        "host_transfers": "forbid",
+        "dtype": {"forbid": ("f64",)},
+        "hbm": "telemetry_limit",
+    },
+}
+
+
+def contract_for(family: str):
+    """The contract dict for ``family``, or None when unregistered."""
+    return PROGRAM_CONTRACTS.get(family)
+
+
+def known_families():
+    return frozenset(PROGRAM_CONTRACTS)
+
+
+def validate_registry():
+    """Internal consistency (the registry test calls this): every
+    collectives profile resolves, every dtype block is well-formed,
+    every donated tuple is non-empty strings. Raises ValueError."""
+    for family, contract in PROGRAM_CONTRACTS.items():
+        profile = contract.get("collectives")
+        if profile is not None and profile not in COLLECTIVE_PROFILES:
+            raise ValueError(f"{family}: unknown collectives profile "
+                             f"{profile!r}")
+        donated = contract.get("donated", ())
+        if not all(isinstance(n, str) and n for n in donated):
+            raise ValueError(f"{family}: malformed donated tuple {donated!r}")
+        ht = contract.get("host_transfers")
+        if ht not in (None, "forbid"):
+            raise ValueError(f"{family}: host_transfers must be 'forbid' "
+                             f"or absent, got {ht!r}")
+        pc = contract.get("param_collectives")
+        if pc not in (None, "forbid"):
+            raise ValueError(f"{family}: param_collectives must be "
+                             f"'forbid' or absent, got {pc!r}")
+        dt = contract.get("dtype")
+        if dt is not None:
+            unknown = set(dt) - {"forbid", "matmul_accum", "int8_kv"}
+            if unknown:
+                raise ValueError(f"{family}: unknown dtype keys {unknown}")
+        hbm = contract.get("hbm")
+        if hbm not in (None, "telemetry_limit"):
+            raise ValueError(f"{family}: hbm must be 'telemetry_limit' or "
+                             f"absent, got {hbm!r}")
+    for name, table in COLLECTIVE_PROFILES.items():
+        if 1 not in table or table[1] != {}:
+            raise ValueError(f"profile {name}: tp=1 must be the empty "
+                             f"inventory (replicated programs carry zero "
+                             f"collectives)")
+        for tp, entry in table.items():
+            if "greedy" in entry or "sampled" in entry:
+                bad = set(entry) - {"greedy", "sampled"}
+                if bad or not all(isinstance(v, dict)
+                                  for v in entry.values()):
+                    raise ValueError(f"profile {name}@tp{tp}: malformed "
+                                     f"sampler-mode entry {entry!r}")

@@ -1,0 +1,406 @@
+"""ds-audit rules: contract checks over :class:`~.artifact.ProgramArtifact`.
+
+Each rule is a :class:`ProgramRule` — same id/severity/description surface
+as ds-lint's AST rules (the CLI reuses the text/json/SARIF renderers and
+the baseline machinery verbatim) but ``check_program(artifact, contract)``
+replaces ``check(ctx)``: the subject is a lowered program, not a module.
+
+Findings anchor at the artifact's pseudo-path
+(``program://family[variant]@tpN``, line 1) with ``code`` set to a stable
+violation signature, so the multiset baseline keyed on (rule, path, code)
+works exactly as it does for source findings — accepted program debt
+survives recompiles, new debt fails the gate.
+"""
+
+import re
+
+from ..core import Finding, Rule, SEVERITY_ERROR, SEVERITY_WARNING
+
+
+class ProgramRule(Rule):
+    """Base class for program-contract rules. ``check_program`` yields
+    Findings for one artifact under its (possibly None) contract."""
+
+    program_level = True
+
+    def check(self, ctx):
+        return ()  # program rules never run over source modules
+
+    def check_program(self, artifact, contract):
+        raise NotImplementedError
+
+    def finding(self, artifact, message: str, code: str = "",
+                severity=None) -> Finding:
+        return Finding(
+            rule_id=self.id,
+            severity=severity or self.severity,
+            path=artifact.label,
+            line=1,
+            col=0,
+            message=message,
+            code=code or message[:120],
+        )
+
+
+class UnregisteredProgramRule(ProgramRule):
+    """A lowered program family missing from the contract registry —
+    the registry is only a safety net for families it knows about."""
+
+    id = "unregistered-program"
+    severity = SEVERITY_ERROR
+    description = ("program family has no entry in analysis/program/"
+                   "contracts.py PROGRAM_CONTRACTS")
+
+    def check_program(self, artifact, contract):
+        if contract is None:
+            yield self.finding(
+                artifact,
+                f"program family {artifact.family!r} is not registered in "
+                f"PROGRAM_CONTRACTS — declare its invariants (donation, "
+                f"collectives, host transfers, dtype policy) so ds-audit "
+                f"can pin them",
+                code=f"unregistered {artifact.family}")
+
+
+class ExtractionErrorRule(ProgramRule):
+    """Lowering or compiling an audited program raised — the audit has
+    no artifact to check, which must fail loudly, not pass silently."""
+
+    id = "audit-extraction-error"
+    severity = SEVERITY_ERROR
+    description = "lowering/compiling the audited program failed"
+
+    def check_program(self, artifact, contract):
+        if artifact.error:
+            yield self.finding(
+                artifact,
+                f"could not extract the lowered program: {artifact.error}",
+                code=f"extraction-error {artifact.family}")
+
+
+class DonationDroppedRule(ProgramRule):
+    """Every donated argument must surface as an input/output alias in
+    the lowered module (and the compiled header, when available).
+
+    jax drops a donation it cannot match to an output *with a warning
+    that nothing reads in production* — the program then silently keeps
+    a full copy of the donated buffer (2x the KV pool / grad
+    accumulator in HBM) and every tick pays the extra traffic."""
+
+    id = "donation-dropped"
+    severity = SEVERITY_ERROR
+    description = ("a donate_argnums buffer is not input/output-aliased "
+                   "in the lowered program")
+
+    def check_program(self, artifact, contract):
+        if contract is None or not contract.get("donated"):
+            return
+        if artifact.error or not artifact.stable_text:
+            return
+        names = ", ".join(contract["donated"])
+        expected = artifact.donated_leaves
+        if artifact.meta.get("donate", True) and expected == 0:
+            yield self.finding(
+                artifact,
+                f"contract declares donated args ({names}) and donation is "
+                f"enabled, but no argument leaf is marked donated — "
+                f"donate_argnums was dropped at the build site",
+                code="donation not requested")
+            return
+        attrs = artifact.alias_attr_count()
+        if attrs < expected:
+            yield self.finding(
+                artifact,
+                f"{expected - attrs} of {expected} donated leaves "
+                f"({names}) lost their input_output_alias in lowering — "
+                f"each unaliased leaf keeps a full extra copy of its "
+                f"buffer resident per dispatch",
+                code=f"alias dropped {expected - attrs}/{expected}")
+            return
+        compiled = artifact.compiled_alias_count()
+        if compiled >= 0 and compiled < expected:
+            yield self.finding(
+                artifact,
+                f"lowering aliased {attrs} leaves but the compiled "
+                f"executable honors only {compiled} of {expected} — XLA "
+                f"dropped aliases at compile time",
+                code=f"compiled alias dropped {compiled}/{expected}")
+
+
+def _format_inventory(inv: dict) -> str:
+    if not inv:
+        return "none"
+    return ", ".join(f"{k}×{v}" for k, v in sorted(inv.items()))
+
+
+class CollectiveInventoryRule(ProgramRule):
+    """The compiled program's collective op inventory must be exactly
+    what the family's profile declares for the mesh tensor width —
+    zero at 1x1 (a replicated program that communicates is a reshard
+    bug), the pinned all-reduce/all-gather set at tp>1 (a drifted set
+    means a sharding change re-routed the hot path's traffic)."""
+
+    id = "collective-inventory"
+    severity = SEVERITY_ERROR
+    description = ("compiled collective op set differs from the family's "
+                   "contract inventory for this mesh width")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("collectives") is None:
+            return
+        if artifact.error or not artifact.hlo_text:
+            return
+        if int(artifact.meta.get("other_axes", 1)) > 1:
+            # the profiles are calibrated for TENSOR sharding with every
+            # other mesh axis at 1; a live mesh with dp/fsdp > 1
+            # legitimately adds data-parallel collectives (grad sync,
+            # batch reshards) the tables do not cover — skip the exact
+            # count rather than false-positive (param-collective, host-
+            # transfer and dtype checks still apply)
+            return
+        from .contracts import expected_collectives
+
+        expected = expected_collectives(
+            contract["collectives"], artifact.tp,
+            sampled=bool(artifact.meta.get("sampled")))
+        found = artifact.collective_inventory()
+        if expected is None:
+            # width not calibrated: the only universal assertion is that
+            # a 1-device program cannot need cross-chip traffic — handled
+            # by the tp=1 entry every profile must carry; nothing to pin
+            return
+        if found != expected:
+            byte_note = ""
+            bytes_by_kind = artifact.collective_bytes()
+            extra = {k: v for k, v in found.items()
+                     if v > expected.get(k, 0)}
+            if extra:
+                moved = sum(bytes_by_kind.get(k, 0) for k in extra)
+                byte_note = (f" (unexpected ops move {moved} operand "
+                             f"bytes/chip)")
+            yield self.finding(
+                artifact,
+                f"collective inventory at tp={artifact.tp} is "
+                f"[{_format_inventory(found)}], contract profile "
+                f"{contract['collectives']!r} pins "
+                f"[{_format_inventory(expected)}]{byte_note}",
+                code=f"tp{artifact.tp} {_format_inventory(found)} != "
+                     f"{_format_inventory(expected)}")
+
+
+class ParamCollectiveRule(ProgramRule):
+    """A collective whose operand is param-shaped — the canonical
+    misplaced-PartitionSpec catastrophe: XLA re-gathers a sharded weight
+    every dispatch (weight bytes » activation bytes), costing 2x HBM for
+    the gathered copy plus the interconnect round trip. Detected by
+    exact shape match against the model's param leaves (global shape or
+    its 1-axis-sharded slices), so no byte threshold has to guess."""
+
+    id = "param-collective"
+    severity = SEVERITY_ERROR
+    description = ("a collective op moves a param-shaped tensor "
+                   "(weight re-gather per dispatch)")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("param_collectives") != "forbid":
+            # training families legitimately move param-shaped tensors
+            # (grad sync IS param-shaped) — only contracts that opt in
+            # (the serving/decode families) are held to this
+            return
+        if artifact.error or not artifact.hlo_text:
+            return
+        if artifact.tp <= 1:
+            return  # tp=1 has no sharded weights to re-gather
+        param_shapes = {tuple(s) for s in artifact.meta.get("param_shapes", ())
+                        if len(s) >= 2}
+        if not param_shapes:
+            return
+        tp = artifact.tp
+        candidates = set(param_shapes)
+        for shape in param_shapes:
+            for axis, dim in enumerate(shape):
+                if dim % tp == 0:
+                    sliced = list(shape)
+                    sliced[axis] = dim // tp
+                    candidates.add(tuple(sliced))
+        seen = set()
+        for op in artifact.collectives():
+            for _, dims in op.operand_shapes:
+                if len(dims) >= 2 and tuple(dims) in candidates:
+                    key = (op.kind, tuple(dims))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield self.finding(
+                        artifact,
+                        f"{op.kind} operates on param-shaped operand "
+                        f"{'x'.join(map(str, dims))} ({op.operand_bytes} "
+                        f"bytes/chip) — a sharded weight is being "
+                        f"re-gathered per dispatch; check the family's "
+                        f"PartitionSpecs",
+                        code=f"{op.kind} param {'x'.join(map(str, dims))}")
+
+
+class HostTransferRule(ProgramRule):
+    """No host round trips inside device-resident program families:
+    python-callback custom calls (jax.debug.print / io_callback /
+    pure_callback), infeed/outfeed, send/recv. One callback in a tick
+    program serializes every tick on the host."""
+
+    id = "host-transfer"
+    severity = SEVERITY_ERROR
+    description = ("lowered program contains a host callback / "
+                   "infeed / outfeed / send / recv")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("host_transfers") != "forbid":
+            return
+        if artifact.error:
+            return
+        seen = set()
+        for kind, detail in artifact.host_transfers():
+            if detail in seen:
+                continue
+            seen.add(detail)
+            yield self.finding(
+                artifact,
+                f"{kind} '{detail}' in the lowered module — this family "
+                f"must stay device-resident (a host transfer serializes "
+                f"every dispatch on the host round trip)",
+                code=f"{kind} {detail}")
+
+
+class DtypePolicyRule(ProgramRule):
+    """Dtype policy over the lowered module: no forbidden types anywhere
+    (f64 doubles every buffer it touches and TPUs emulate it), matmul
+    accumulation stays in the configured dtypes, and an int8 KV cache
+    round-trips int8 (an upcast re-materializes the cache at 4x)."""
+
+    id = "dtype-policy"
+    severity = SEVERITY_ERROR
+    description = ("forbidden dtype, off-policy matmul accumulation, or "
+                   "int8-KV upcast in the lowered program")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("dtype") is None:
+            return
+        if artifact.error or not artifact.stable_text:
+            return
+        policy = contract["dtype"]
+        for token in policy.get("forbid", ()):
+            hits = artifact.f64_types() if token == "f64" else (
+                [p for p in set(re.findall(r"tensor<([^>]*)>",
+                                           artifact.stable_text))
+                 if p.endswith(token)])
+            if hits:
+                yield self.finding(
+                    artifact,
+                    f"forbidden dtype {token} appears in the lowered "
+                    f"module ({len(hits)} distinct tensor type(s), e.g. "
+                    f"tensor<{hits[0]}>)",
+                    code=f"forbidden {token}")
+        if policy.get("matmul_accum") == "meta":
+            allowed = set(artifact.meta.get("accum_dtypes", ()))
+            if allowed:
+                bad = sorted({out for _, out in artifact.dot_outputs()
+                              if out not in allowed})
+                if bad:
+                    yield self.finding(
+                        artifact,
+                        f"dot_general accumulates in {', '.join(bad)} but "
+                        f"the config allows only "
+                        f"{', '.join(sorted(allowed))}",
+                        code=f"accum {','.join(bad)}")
+        if policy.get("int8_kv") == "stable" and artifact.meta.get("int8_kv"):
+            in_i8 = {a.shape for a in artifact.signature_args()
+                     if a.dtype in ("i8", "s8") and len(a.shape) >= 2}
+            out_i8 = {shape for dtype, shape in artifact.result_types()
+                      if dtype in ("i8", "s8")}
+            lost = sorted(in_i8 - out_i8)
+            if lost:
+                shape = "x".join(map(str, lost[0]))
+                yield self.finding(
+                    artifact,
+                    f"int8 KV cache leaf {shape} enters the program but "
+                    f"no int8 output of that shape comes back — the "
+                    f"cache is being re-stored in a wider dtype (4x the "
+                    f"HBM the int8 path exists to save)",
+                    code=f"int8 kv upcast {shape}")
+
+
+class HbmCeilingRule(ProgramRule):
+    """The executable's static peak (arguments + outputs + temp, minus
+    aliased bytes counted once) must fit the configured per-chip
+    ``telemetry.hbm_limit_bytes`` — catching the 2x-HBM program at
+    compile time instead of as an on-chip OOM mid-serve."""
+
+    id = "hbm-ceiling"
+    severity = SEVERITY_ERROR
+    description = ("static program memory exceeds "
+                   "telemetry.hbm_limit_bytes")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("hbm") != "telemetry_limit":
+            return
+        limit = int(artifact.meta.get("hbm_limit_bytes", 0) or 0)
+        if limit <= 0 or not artifact.memory:
+            return
+        mem = artifact.memory
+        args = int(mem.get("argument_bytes", 0))
+        out = int(mem.get("output_bytes", 0))
+        temp = int(mem.get("temp_bytes", 0))
+        alias = int(mem.get("alias_bytes", 0))
+        peak = args + out + temp - alias
+        if peak > limit:
+            yield self.finding(
+                artifact,
+                f"static peak {peak} bytes/chip (args {args} + outputs "
+                f"{out} + temp {temp} - aliased {alias}) exceeds "
+                f"telemetry.hbm_limit_bytes {limit}",
+                code=f"peak {peak} > limit {limit}")
+
+
+class DonationUnexpectedRule(ProgramRule):
+    """Aliasing present where the contract declares none — an arg the
+    host still reads after dispatch got donated (use-after-donate reads
+    garbage; ds-lint's donated-buffer-reuse is the source-level twin)."""
+
+    id = "unexpected-donation"
+    severity = SEVERITY_WARNING
+    description = ("program aliases inputs although its contract "
+                   "declares no donated args")
+
+    def check_program(self, artifact, contract):
+        if contract is None or contract.get("donated"):
+            return
+        if artifact.error or not artifact.stable_text:
+            return
+        attrs = artifact.alias_attr_count()
+        if attrs:
+            yield self.finding(
+                artifact,
+                f"{attrs} argument leaf/leaves carry input_output_alias "
+                f"but the {artifact.family!r} contract declares no "
+                f"donated args — either register the donation or drop "
+                f"it (the host must not read a donated buffer after "
+                f"dispatch)",
+                code=f"unexpected alias {attrs}")
+
+
+def program_rules():
+    """The default ds-audit rule set, one instance each."""
+    return [
+        ExtractionErrorRule(),
+        UnregisteredProgramRule(),
+        DonationDroppedRule(),
+        DonationUnexpectedRule(),
+        CollectiveInventoryRule(),
+        ParamCollectiveRule(),
+        HostTransferRule(),
+        DtypePolicyRule(),
+        HbmCeilingRule(),
+    ]
+
+
+def program_rules_by_id():
+    return {r.id: type(r) for r in program_rules()}

@@ -16,10 +16,11 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 _LEVELS = {"error": "error", "warning": "warning", "info": "note"}
 
 
-def render_sarif(report: dict, rules) -> dict:
+def render_sarif(report: dict, rules, tool_name: str = "ds-lint") -> dict:
     """SARIF log dict from a ds-lint report (``cli._build_report``
     shape: findings already root-relative) and the active rule
-    instances."""
+    instances. ``tool_name`` labels the driver — ds-audit reuses this
+    renderer for program findings."""
     catalog = [
         {
             "id": rule.id,
@@ -70,7 +71,7 @@ def render_sarif(report: dict, rules) -> dict:
         "runs": [{
             "tool": {
                 "driver": {
-                    "name": "ds-lint",
+                    "name": tool_name,
                     # informationUri is omitted: SARIF 2.1.0 §3.19.2
                     # requires an ABSOLUTE URI and this repo has no
                     # canonical public URL; strict ingesters reject the

@@ -187,6 +187,28 @@ class _Pool:
                                                 donate=engine.donate_cache)
         self.set_row_fn = wrap_deferred(get_tele, self.set_row_fn,
                                         "row_update", (n_slots,))
+        # ds-audit capture of the pool's companion programs (the tick
+        # variants notify from _tick_fn as they are built)
+        from deepspeed_tpu.analysis.program import capture
+
+        if capture.active():
+            def row_args(n=n_slots):
+                row = jax.ShapeDtypeStruct((n,), jnp.int32)
+                return (row, row, 0, 0, 0)
+
+            def seg_args(n=n_slots, pool=self, eng=engine):
+                def sds(a):
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+                return (jax.tree.map(sds, eng._eng.params),
+                        jax.ShapeDtypeStruct((n, 8), jnp.int32),
+                        jax.tree.map(sds, pool.cache),
+                        jax.ShapeDtypeStruct((n,), jnp.int32))
+
+            capture.notify_program("pool_segment", "", self.segment_fn,
+                                   seg_args, meta=engine._audit_meta)
+            capture.notify_program("pool_row_update", "", self.set_row_fn,
+                                   row_args, meta=engine._audit_meta)
         # host DISPATCH mirrors: the position/emission count each row will
         # have reached once every dispatched tick retires. Exact for live
         # rows (a live row advances by exactly k per burst until done);
@@ -388,6 +410,33 @@ class ContinuousBatchingEngine:
         return hbm.emit_snapshot(self._eng.telemetry, self.hbm_components(),
                                  reason)
 
+    def _tick_arg_structs(self, pool: "_Pool", chunk: Optional[int]):
+        """ShapeDtypeStruct argument tuple for one tick program — the
+        ONE abstract-args builder shared by the AOT memory diagnostic
+        and the ds-audit capture hook, so neither can drift from the
+        real dispatch signature."""
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        params_s = jax.tree.map(sds, self._eng.params)
+        cache_s = jax.tree.map(sds, pool.cache)
+        row = jax.ShapeDtypeStruct((pool.n_slots,), jnp.int32)
+        args = [params_s, cache_s, row, row, row, row, row, row,
+                sds(self._base_key)]
+        if chunk is not None:
+            cvec = jax.ShapeDtypeStruct((chunk,), jnp.int32)
+            args += [cvec, cvec, 0, row, row]
+        return tuple(args)
+
+    def _audit_meta(self) -> dict:
+        """ProgramArtifact meta for ds-audit captures from this engine
+        (analysis/program/capture.py) — the inner engine's meta with the
+        pool's donation knob (donate_cache gates the tick/row-update
+        donations; the CPU overlap A/B runs them off) and sampler mode
+        (the tick collective profile splits greedy vs sampled)."""
+        return dict(self._eng._audit_meta(), donate=self.donate_cache,
+                    sampled=self.temperature > 0.0)
+
     def analyze_program_memory(self) -> Dict[str, dict]:
         """Per-tick-program-family ``compiled.memory_analysis()`` view
         (temp/argument/output bytes) over every tick program built so
@@ -397,21 +446,10 @@ class ContinuousBatchingEngine:
         {} per family on backends without the analysis (jax CPU)."""
         from deepspeed_tpu.telemetry import memory as hbm
 
-        def sds(a):
-            return jax.ShapeDtypeStruct(a.shape, a.dtype)
-
         out: Dict[str, dict] = {}
-        params_s = jax.tree.map(sds, self._eng.params)
-        key_s = sds(self._base_key)
         for pi, pool in enumerate(self._pools):
-            cache_s = jax.tree.map(sds, pool.cache)
-            row = jax.ShapeDtypeStruct((pool.n_slots,), jnp.int32)
             for (chunk, read_len), fn in pool.tick_fns.items():
-                args = [params_s, cache_s, row, row, row, row, row, row,
-                        key_s]
-                if chunk is not None:
-                    cvec = jax.ShapeDtypeStruct((chunk,), jnp.int32)
-                    args += [cvec, cvec, 0, row, row]
+                args = self._tick_arg_structs(pool, chunk)
                 try:
                     mem = hbm.program_memory(fn.lower(*args).compile())
                 except Exception:  # noqa: BLE001 — strictly best-effort AOT
@@ -819,6 +857,18 @@ class ContinuousBatchingEngine:
                      1 if chunk is not None else self.tokens_per_tick,
                      chunk, read_len))
             pool.tick_fns[key] = fn
+            # ds-audit capture (zero cost without a hook): the contract
+            # auditor sees every tick variant a serve actually compiles
+            from deepspeed_tpu.analysis.program import capture
+
+            if capture.active():
+                variant = ("fused" if chunk is not None
+                           else "burst" if self.tokens_per_tick > 1
+                           else "plain")
+                capture.notify_program(
+                    "pool_tick", variant, fn,
+                    lambda: self._tick_arg_structs(pool, chunk),
+                    meta=self._audit_meta)
         return pool.tick_fns[key]
 
     def _dispatch_tick(self, pool: _Pool) -> Optional[_TickRecord]:

@@ -292,6 +292,29 @@ class InferenceEngine:
                                         self._compiled_shape)
             self._decode_fn = rec.wrap(self._decode_fn, "decode_step",
                                        self._compiled_shape)
+        # ds-audit capture (zero cost without a hook): the decode pair is
+        # the engine's hot program family — contract-checked as built
+        from deepspeed_tpu.analysis.program import capture
+
+        if capture.active():
+            def sds(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            params_s = jax.tree.map(sds, self.params)
+            cache_s = jax.tree.map(sds, jax.eval_shape(
+                lambda: tf.init_cache(self.cfg, batch_size, max_len)))
+            capture.notify_program(
+                "decode_prefill", "", self._prefill_fn,
+                lambda: (params_s,
+                         jax.ShapeDtypeStruct((batch_size, 8), jnp.int32),
+                         cache_s),
+                meta=self._audit_meta)
+            capture.notify_program(
+                "decode_step", "", self._decode_fn,
+                lambda: (params_s,
+                         jax.ShapeDtypeStruct((batch_size, 1), jnp.int32),
+                         cache_s, jax.ShapeDtypeStruct((), jnp.int32)),
+                meta=self._audit_meta)
         # fresh jit objects hold no traces — geoms recorded against the
         # discarded pair must not claim their shapes are still compiled
         self._traced_geoms = set()
@@ -307,6 +330,30 @@ class InferenceEngine:
             self.telemetry.registry.counter(
                 "compile_cache", {"kind": "decode", "outcome": "miss" if miss else "hit"}
             ).inc()
+
+    def _audit_meta(self) -> dict:
+        """ProgramArtifact meta for ds-audit captures from this engine
+        (analysis/program/capture.py) — built only while a hook is
+        installed. The decode pair always donates its cache
+        (compile_decode_fns donate_argnums=(2,))."""
+        from deepspeed_tpu.analysis.program.capture import param_leaf_shapes
+        from deepspeed_tpu.parallel.partition import mesh_tensor_width
+
+        accum = {"float32": ("f32",), "bfloat16": ("bf16", "f32"),
+                 "float16": ("f16", "f32")}.get(self.cfg.dtype, ())
+        tp = mesh_tensor_width(self.mesh)
+        return {
+            "tp": tp,
+            # dp/fsdp/... width: >1 means the calibrated tensor-only
+            # collective tables don't apply (the inventory rule skips)
+            "other_axes": int(self.mesh.devices.size) // max(tp, 1),
+            "donate": True,
+            "param_shapes": param_leaf_shapes(self.params),
+            "accum_dtypes": accum,
+            "int8_kv": self.cfg.kv_cache_dtype == "int8",
+            "hbm_limit_bytes": getattr(self.telemetry.cfg,
+                                       "hbm_limit_bytes", 0),
+        }
 
     # ------------------------------------------------------------------
     def forward(self, input_ids, **kwargs):

@@ -87,6 +87,12 @@ def main(argv=None):
     p.add_argument("--cache-dir", default=None,
                    help="persistent XLA cache dir (defaults to jax config / "
                         "JAX_COMPILATION_CACHE_DIR)")
+    p.add_argument("--audit", action="store_true",
+                   help="run ds-audit over every program this warm "
+                        "compiles (the REAL serving configuration, not "
+                        "the tiny-config table) and fail the warm on "
+                        "contract findings — docs/static_analysis.md "
+                        "'Program audit'")
     p.add_argument("--override", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="TransformerConfig field override (repeatable), e.g. "
@@ -128,6 +134,20 @@ def main(argv=None):
         cfg["kv_read_floor"] = args.kv_floor
     rs = np.random.RandomState(0)
 
+    # --audit: collect every program family the warm builds and contract-
+    # check the artifacts at the end (exit 1 on findings) — this audits
+    # the REAL serving configuration on the REAL mesh widths, where the
+    # standalone tools/ds_audit.py audits a tiny calibration table
+    collector = None
+    if args.audit:
+        from deepspeed_tpu.analysis.program.capture import (
+            ArtifactCollector,
+            set_hook,
+        )
+
+        collector = ArtifactCollector()
+        set_hook(collector)
+
     def tick(name, fn):
         t0 = time.time()
         # drain the dispatch: without this the "ready in" time would report
@@ -150,66 +170,90 @@ def main(argv=None):
 
         meshes = [parse_mesh_arg(s) for s in args.mesh.split(",")]
 
-    for shape in meshes:
-        mcfg = dict(cfg)
-        label = ""
-        if shape is not None:
-            mcfg["mesh"] = {"shape": shape}
-            label = (f", mesh={shape.get('data', 1)}:"
-                     f"{shape.get('tensor', 1)}")
-        eng = deepspeed_tpu.init_inference(model, params=params, config=dict(mcfg))
-        tick(f"fused generate (B={args.batch}, S={args.prompt}, "
-             f"new={args.new}{label})",
-             lambda: np.asarray(eng.generate(toks, max_new_tokens=args.new)))
+    # the hook must not outlive the warm even when a build raises —
+    # a leaked hook would capture (and re-lower) every later program
+    # in the process (test engines included)
+    try:
+        for shape in meshes:
+            mcfg = dict(cfg)
+            label = ""
+            if shape is not None:
+                mcfg["mesh"] = {"shape": shape}
+                label = (f", mesh={shape.get('data', 1)}:"
+                         f"{shape.get('tensor', 1)}")
+            eng = deepspeed_tpu.init_inference(model, params=params, config=dict(mcfg))
+            tick(f"fused generate (B={args.batch}, S={args.prompt}, "
+                 f"new={args.new}{label})",
+                 lambda: np.asarray(eng.generate(toks, max_new_tokens=args.new)))
 
-        if args.chunk:
-            eng_c = deepspeed_tpu.init_inference(
-                model, params=params,
-                config=dict(mcfg, prefill_chunk_size=args.chunk))
-            tick(f"chunked prefill (chunk={args.chunk}) + per-token decode"
-                 f"{label}",
-                 lambda: np.asarray(eng_c.generate(toks, max_new_tokens=2)))
-            del eng_c
+            if args.chunk:
+                eng_c = deepspeed_tpu.init_inference(
+                    model, params=params,
+                    config=dict(mcfg, prefill_chunk_size=args.chunk))
+                tick(f"chunked prefill (chunk={args.chunk}) + per-token decode"
+                     f"{label}",
+                     lambda: np.asarray(eng_c.generate(toks, max_new_tokens=2)))
+                del eng_c
 
-        if args.continuous:
-            from deepspeed_tpu.inference import ContinuousBatchingEngine
+            if args.continuous:
+                from deepspeed_tpu.inference import ContinuousBatchingEngine
 
-            serve = ContinuousBatchingEngine(
-                model, params=params, config=dict(mcfg), max_slots=args.slots,
-                cache_len=args.cache_len, tokens_per_tick=args.burst,
-                pipeline_depth=args.pipeline_depth,
-                fused_prefill=not args.no_fused_prefill)
+                serve = ContinuousBatchingEngine(
+                    model, params=params, config=dict(mcfg), max_slots=args.slots,
+                    cache_len=args.cache_len, tokens_per_tick=args.burst,
+                    pipeline_depth=args.pipeline_depth,
+                    fused_prefill=not args.no_fused_prefill)
 
-            def run_pool():
-                # drive a real request through: warms the admission programs
-                # (prefill/splice or the first chunk width) plus the tick
-                # read-buckets this prompt actually crosses
-                pool_new = min(args.new, 8)
-                plen = min(args.prompt, args.cache_len - pool_new)
-                assert plen >= 1, (
-                    f"--cache-len {args.cache_len} leaves no room for a prompt "
-                    f"(warming {pool_new} tokens)")
-                serve.submit(toks[0, :plen], max_new_tokens=pool_new)
-                while serve.has_work():
-                    serve.step()
-                serve.finished()
+                def run_pool():
+                    # drive a real request through: warms the admission programs
+                    # (prefill/splice or the first chunk width) plus the tick
+                    # read-buckets this prompt actually crosses
+                    pool_new = min(args.new, 8)
+                    plen = min(args.prompt, args.cache_len - pool_new)
+                    assert plen >= 1, (
+                        f"--cache-len {args.cache_len} leaves no room for a prompt "
+                        f"(warming {pool_new} tokens)")
+                    serve.submit(toks[0, :plen], max_new_tokens=pool_new)
+                    while serve.has_work():
+                        serve.step()
+                    serve.finished()
 
-            tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
-                 f"burst={args.burst}{label})", run_pool)
-            # then the FULL tick-program family (bucket x read_len x {plain,
-            # burst, fused-prefill}) under THIS mesh: a live serve dispatches
-            # whichever variant its mix demands — every one missing
-            # cold-costs a remote compile
-            n_fns = serve.precompile_tick_programs(
-                progress=lambda msg: print(f"prewarm: {msg}", flush=True))
-            print(f"prewarm: tick-program family complete "
-                  f"({n_fns} variants resident{label})", flush=True)
-            del serve
-        # drop this width's engines (and their on-device param placements
-        # + KV pools) before the next width builds its own — two resident
-        # placements is exactly the 3x-HBM-at-7B hazard the shared param
-        # init above exists to avoid
-        del eng
+                tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
+                     f"burst={args.burst}{label})", run_pool)
+                # then the FULL tick-program family (bucket x read_len x {plain,
+                # burst, fused-prefill}) under THIS mesh: a live serve dispatches
+                # whichever variant its mix demands — every one missing
+                # cold-costs a remote compile
+                n_fns = serve.precompile_tick_programs(
+                    progress=lambda msg: print(f"prewarm: {msg}", flush=True))
+                print(f"prewarm: tick-program family complete "
+                      f"({n_fns} variants resident{label})", flush=True)
+                del serve
+            # drop this width's engines (and their on-device param placements
+            # + KV pools) before the next width builds its own — two resident
+            # placements is exactly the 3x-HBM-at-7B hazard the shared param
+            # init above exists to avoid
+            del eng
+    finally:
+        if collector is not None:
+            from deepspeed_tpu.analysis.program.capture import clear_hook
+
+            clear_hook()
+    if collector is not None:
+        from deepspeed_tpu.analysis.program import audit_artifacts
+        from deepspeed_tpu.analysis.program.auditor import (
+            build_report,
+            print_text,
+        )
+
+        result = audit_artifacts(collector.artifacts)
+        report = build_report(result, result.findings, [],
+                              collector.artifacts)
+        print(f"prewarm: ds-audit over {len(collector.artifacts)} captured "
+              f"program(s)", flush=True)
+        print_text(report)
+        if result.findings:
+            return 1
     print("prewarm: done — executables persisted to the XLA compile cache",
           flush=True)
     return 0

@@ -873,6 +873,23 @@ class TpuEngine:
             self._apply_fn = self.telemetry.compile_recorder().wrap(
                 self._apply_fn, "train_apply",
                 (self.train_micro_batch_size_per_gpu, gas))
+        # ds-audit capture (zero cost without a hook): the optimizer
+        # apply program's args are all engine state, so it can be
+        # contract-checked right at build (the micro program needs a
+        # real batch and notifies from _micro_cost_analysis instead)
+        from deepspeed_tpu.analysis.program import capture
+
+        if capture.active():
+            def apply_args():
+                lr_s = jax.ShapeDtypeStruct((), jnp.float32)
+                return (capture.shape_structs(self.params),
+                        capture.shape_structs(self.master_params),
+                        capture.shape_structs(self.opt_state),
+                        capture.shape_structs(self.grad_acc),
+                        capture.shape_structs(self.scale_state), lr_s)
+
+            capture.notify_program("train_apply", "", self._apply_fn,
+                                   apply_args, meta=self._audit_meta)
 
     # ------------------------------------------------------------------
     # HBM accounting (telemetry/memory.py — the live ops plane)
@@ -1203,6 +1220,30 @@ class TpuEngine:
             self.timers.log(normalizer=self.gradient_accumulation_steps)
             self._emit_comm_summary()
 
+    def _audit_meta(self) -> dict:
+        """ProgramArtifact meta for ds-audit captures of the train step
+        programs (analysis/program/capture.py) — built only while a
+        hook is installed. Both step programs donate unconditionally
+        (micro: grad_acc; apply: params/master/opt_state/grad_acc)."""
+        from deepspeed_tpu.analysis.program.capture import param_leaf_shapes
+        from deepspeed_tpu.parallel.partition import mesh_tensor_width
+
+        accum = {"float32": ("f32",), "bfloat16": ("bf16", "f32"),
+                 "float16": ("f16", "f32")}.get(
+            jnp.dtype(self.model_dtype).name, ())
+        tp = mesh_tensor_width(self.mesh)
+        return {
+            "tp": tp,
+            # dp/fsdp/... width: >1 means the calibrated tensor-only
+            # collective tables don't apply (the inventory rule skips)
+            "other_axes": int(self.mesh.devices.size) // max(tp, 1),
+            "donate": True,
+            "param_shapes": param_leaf_shapes(self.params),
+            "accum_dtypes": accum,
+            "hbm_limit_bytes": getattr(self.config.telemetry,
+                                       "hbm_limit_bytes", 0),
+        }
+
     def _micro_cost_analysis(self, batch, rng):
         """(cost_dict, compiled) for the default micro program via one AOT
         lower+compile, cached on the engine — the flops profiler and the
@@ -1210,9 +1251,19 @@ class TpuEngine:
         jit dispatch cache is separate from AOT artifacts) happens at most
         once per engine."""
         if self._micro_cost_cache is None:
-            compiled = self._micro_fn.lower(
+            lowered = self._micro_fn.lower(
                 self.params, self.grad_acc, batch, rng, self.scale_state.scale, jnp.float32(1.0)
-            ).compile()
+            )
+            compiled = lowered.compile()
+            # ds-audit capture: this is the one place the engine already
+            # holds the micro program's lowered artifact — feed the
+            # contract auditor without a second trace
+            from deepspeed_tpu.analysis.program import capture
+
+            if capture.active():
+                capture.notify_lowered("train_micro", "", lowered,
+                                       meta=self._audit_meta,
+                                       compiled=compiled)
             cost = compiled.cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}

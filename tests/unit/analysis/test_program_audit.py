@@ -1,0 +1,464 @@
+"""ds-audit unit tests: artifact parsers over synthetic HLO text, the
+contract registry's validity, and — the load-bearing part — fixture
+programs deliberately violating one contract dimension each, asserting
+the EXACT rule id + program family in the finding (a rule that fires on
+the wrong family or under the wrong id would train people to ignore it).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.analysis import Baseline
+from deepspeed_tpu.analysis.program import (
+    PROGRAM_CONTRACTS,
+    ProgramArtifact,
+    ProgramAuditor,
+    audit_artifacts,
+    expected_collectives,
+    validate_registry,
+)
+from deepspeed_tpu.analysis.program.artifact import (
+    parse_collectives,
+    parse_dot_outputs,
+    parse_host_transfers,
+)
+from deepspeed_tpu.analysis.program.capture import (
+    ArtifactCollector,
+    clear_hook,
+    extract_artifact,
+    notify_program,
+    set_hook,
+)
+
+
+def _audit_one(artifact, contract):
+    """Findings for one artifact under one synthetic contract."""
+    return audit_artifacts(
+        [artifact], contracts={artifact.family: contract}).findings
+
+
+def _ids(findings):
+    return sorted({(f.rule_id, f.path) for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# registry + parsers
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_registry_is_valid(self):
+        validate_registry()
+
+    def test_every_family_pins_tp1_empty(self):
+        for family, contract in PROGRAM_CONTRACTS.items():
+            profile = contract.get("collectives")
+            if profile is not None:
+                assert expected_collectives(profile, 1) == {}, family
+
+    def test_sampler_mode_split(self):
+        greedy = expected_collectives("tick_forward", 2, sampled=False)
+        sampled = expected_collectives("tick_forward", 2, sampled=True)
+        assert greedy != sampled
+        assert greedy and sampled
+
+    def test_uncalibrated_width_returns_none(self):
+        assert expected_collectives("tick_forward", 16) is None
+        assert expected_collectives("no-such-profile", 2) is None
+
+
+class TestParsers:
+    def test_collective_parse_counts_and_bytes(self):
+        text = (
+            "  %all-gather = f32[4,8]{0,1} all-gather(f32[4,4]{0,1} %copy), "
+            "channel_id=1, replica_groups=[1,2]<=[2], dimensions={1}\n"
+            "  ROOT %all-reduce.3 = f32[4,4]{1,0} all-reduce(f32[4,4]{1,0} "
+            "%add), channel_id=2\n"
+            "  %all-reduce-start = f32[2,2] all-reduce-start(f32[2,2] %x)\n"
+            "  %all-reduce-done = f32[2,2] all-reduce-done(f32[2,2] %y)\n")
+        ops = parse_collectives(text)
+        kinds = sorted(o.kind for o in ops)
+        # async pair counts once (the -done half is skipped)
+        assert kinds == ["all-gather", "all-reduce", "all-reduce"]
+        ag = [o for o in ops if o.kind == "all-gather"][0]
+        assert ag.operand_bytes == 4 * 4 * 4  # f32[4,4]
+        assert ag.operand_shapes == (("f32", (4, 4)),)
+
+    def test_async_tuple_result_collective_parse(self):
+        """Real XLA prints async collectives with a TUPLE-typed result:
+        the leading paren is the type, not the operand list — operand
+        bytes must come from the operands, not the doubled tuple."""
+        text = ("  %all-reduce-start = (f32[4]{0}, f32[4]{0}) "
+                "all-reduce-start(f32[4]{0} %x), channel_id=3\n"
+                "  %all-reduce-done = f32[4]{0} all-reduce-done("
+                "(f32[4]{0}, f32[4]{0}) %all-reduce-start)\n")
+        ops = parse_collectives(text)
+        assert [o.kind for o in ops] == ["all-reduce"]
+        assert ops[0].operand_bytes == 16  # one f32[4], not the 2x tuple
+        assert ops[0].operand_shapes == (("f32", (4,)),)
+
+    def test_host_transfer_parse_skips_benign_targets(self):
+        text = (
+            'stablehlo.custom_call @Sharding(%1)\n'
+            'stablehlo.custom_call @xla_python_cpu_callback(%c, %0)\n'
+            'stablehlo.custom_call @SPMDFullToShardShape(%2)\n')
+        out = parse_host_transfers(text)
+        assert out == [("custom_call", "xla_python_cpu_callback")]
+
+    def test_dot_output_parse(self):
+        text = ("%3 = stablehlo.dot_general %1, %2, contracting_dims = "
+                "[1] x [0] : (tensor<3x64xbf16>, tensor<64x64xbf16>) "
+                "-> tensor<3x64xf32>")
+        assert parse_dot_outputs(text) == [(("bf16", "bf16"), "f32")]
+
+    def test_signature_alias_parse_with_nested_quoted_braces(self):
+        text = (
+            'func.func public @main(%arg0: tensor<4x8xf32> {mhlo.sharding '
+            '= "{devices=[1,2]<=[2]}"}, %arg1: tensor<4x8xf32> '
+            '{mhlo.sharding = "{devices=[1,2]<=[2]}", tf.aliasing_output '
+            '= 0 : i32}) -> (tensor<4x8xf32> {jax.result_info = "[0]"}) {')
+        art = ProgramArtifact(family="x", stable_text=text)
+        args = art.signature_args()
+        assert [a.aliased_output for a in args] == [-1, 0]
+        assert art.alias_attr_count() == 1
+        assert art.result_types() == [("f32", (4, 8))]
+
+    def test_compiled_alias_header_count(self):
+        hlo = ("HloModule jit_f, is_scheduled=true, input_output_alias={ "
+               "{0}: (1, {}, may-alias), {2}: (3, {}, may-alias) }, "
+               "entry_computation_layout={...}\n%x = f32[] parameter(0)\n")
+        art = ProgramArtifact(family="x", hlo_text=hlo)
+        assert art.compiled_alias_count() == 2
+
+    def test_f64_scan(self):
+        art = ProgramArtifact(
+            family="x",
+            stable_text="%0 = stablehlo.convert %a : (tensor<4xf32>) -> "
+                        "tensor<4xf64>")
+        assert art.f64_types() == ["4xf64"]
+
+
+# ---------------------------------------------------------------------------
+# broken-program fixtures: each produces exactly its pinned finding
+# ---------------------------------------------------------------------------
+
+class TestBrokenPrograms:
+    def test_dropped_donation_flags_donation_dropped(self):
+        """A donated arg no output can alias (here: unused entirely, so
+        lowering erases it) must flag donation-dropped."""
+        import warnings
+
+        def f(w, c):
+            return w * 2.0
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # jax warns about the drop
+            art = extract_artifact(
+                "tickprog", "", jax.jit(f, donate_argnums=(1,)),
+                (jax.ShapeDtypeStruct((4, 4), jnp.float32),
+                 jax.ShapeDtypeStruct((3, 3), jnp.float32)),
+                meta={"donate": True})
+        findings = _audit_one(art, {"donated": ("cache",)})
+        assert _ids(findings) == [("donation-dropped", "program://tickprog@tp1")]
+        assert "cache" in findings[0].message
+
+    def test_host_callback_flags_host_transfer(self):
+        """An injected jax.debug.print is a python-callback custom call —
+        the canonical host round trip inside a tick program."""
+        def f(x):
+            jax.debug.print("x={v}", v=x.sum())
+            return x * 2.0
+
+        art = extract_artifact(
+            "tickprog", "", jax.jit(f),
+            (jax.ShapeDtypeStruct((4,), jnp.float32),), meta={})
+        findings = _audit_one(art, {"host_transfers": "forbid"})
+        assert _ids(findings) == [("host-transfer", "program://tickprog@tp1")]
+        assert "callback" in findings[0].message
+
+    def test_f32_cast_kv_read_flags_dtype_policy(self):
+        """An int8 KV cache returned as f32 (the cache re-stored wide)
+        must flag dtype-policy with the offending shape."""
+        def f(cache):
+            wide = cache["q8"].astype(jnp.float32) * cache["s"]
+            return {"q8": wide, "s": cache["s"]}
+
+        art = extract_artifact(
+            "kvprog", "", jax.jit(f),
+            ({"q8": jax.ShapeDtypeStruct((2, 8, 4), jnp.int8),
+              "s": jax.ShapeDtypeStruct((2, 8, 1), jnp.float32)},),
+            meta={"int8_kv": True})
+        findings = _audit_one(art, {"dtype": {"int8_kv": "stable"}})
+        assert _ids(findings) == [("dtype-policy", "program://kvprog@tp1")]
+        assert "2x8x4" in findings[0].message
+
+    def test_forced_all_gather_flags_param_collective(self):
+        """A misplaced PartitionSpec (sharded weight, replicated output)
+        forces XLA to re-gather the weight — param-collective, by exact
+        shape match, no byte threshold."""
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                    ("data", "tensor"))
+        shd = NamedSharding(mesh, PartitionSpec(None, "tensor"))
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def f(w):
+            # replicated-output spec over a sharded weight: XLA must
+            # re-gather the whole weight every dispatch
+            return w + 1.0
+
+        art = extract_artifact(
+            "gatherprog", "",
+            jax.jit(f, in_shardings=(shd,), out_shardings=rep),
+            (jax.ShapeDtypeStruct((8, 8), jnp.float32),),
+            meta={"tp": 2, "param_shapes": ((8, 8),)})
+        findings = _audit_one(art, {"param_collectives": "forbid"})
+        assert _ids(findings) == [
+            ("param-collective", "program://gatherprog@tp2")]
+        assert "PartitionSpec" in findings[0].message
+
+    def test_mixed_mesh_skips_inventory_but_not_the_rest(self):
+        """A live dp/fsdp mesh (other_axes > 1) legitimately carries
+        grad-sync collectives the tensor-only tables don't cover — the
+        exact-count check must skip, NOT false-positive (caught live by
+        the PR 10 verify run: SimpleModel on a data:1,fsdp:8 mesh)."""
+        art = ProgramArtifact(
+            family="mixprog",
+            hlo_text="HloModule m\n  %all-reduce = f32[4,4]{1,0} "
+                     "all-reduce(f32[4,4]{1,0} %x), channel_id=1\n",
+            meta={"tp": 1, "other_axes": 8})
+        findings = _audit_one(art, {"collectives": "local_only"})
+        assert findings == []
+        # the same artifact on a pure mesh still flags
+        art2 = ProgramArtifact(family="mixprog", hlo_text=art.hlo_text,
+                               meta={"tp": 1, "other_axes": 1})
+        assert _ids(_audit_one(art2, {"collectives": "local_only"})) == [
+            ("collective-inventory", "program://mixprog@tp1")]
+
+    def test_unexpected_collective_flags_inventory(self):
+        """Any collective in a local_only-contract program is an
+        inventory violation (the zero-collectives-at-1x1 class)."""
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                    ("data", "tensor"))
+        shd = NamedSharding(mesh, PartitionSpec("tensor"))
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def f(x):
+            return x.sum()
+
+        art = extract_artifact(
+            "localprog", "", jax.jit(f, in_shardings=(shd,), out_shardings=rep),
+            (jax.ShapeDtypeStruct((8,), jnp.float32),), meta={"tp": 2})
+        findings = _audit_one(art, {"collectives": "local_only"})
+        assert _ids(findings) == [
+            ("collective-inventory", "program://localprog@tp2")]
+
+    def test_matmul_accum_off_policy_flags_dtype(self):
+        def f(a, b):
+            return a @ b
+
+        art = extract_artifact(
+            "dotprog", "", jax.jit(f),
+            (jax.ShapeDtypeStruct((4, 8), jnp.float32),
+             jax.ShapeDtypeStruct((8, 8), jnp.float32)),
+            meta={"accum_dtypes": ("bf16",)})
+        findings = _audit_one(art, {"dtype": {"matmul_accum": "meta"}})
+        assert _ids(findings) == [("dtype-policy", "program://dotprog@tp1")]
+        assert "f32" in findings[0].message
+
+    def test_f64_in_module_flags_dtype(self):
+        art = ProgramArtifact(
+            family="f64prog",
+            stable_text="func.func public @main() {\n  %0 = stablehlo."
+                        "constant dense<0.0> : tensor<4xf64>\n}")
+        findings = _audit_one(art, {"dtype": {"forbid": ("f64",)}})
+        assert _ids(findings) == [("dtype-policy", "program://f64prog@tp1")]
+
+    def test_hbm_ceiling_breach(self):
+        def f(x):
+            return x * 2.0
+
+        art = extract_artifact(
+            "bigprog", "", jax.jit(f),
+            (jax.ShapeDtypeStruct((512, 512), jnp.float32),),
+            meta={"hbm_limit_bytes": 100_000})
+        if not art.memory:
+            pytest.skip("backend reports no memory_analysis")
+        findings = _audit_one(art, {"hbm": "telemetry_limit"})
+        assert _ids(findings) == [("hbm-ceiling", "program://bigprog@tp1")]
+
+    def test_unregistered_family_is_a_finding(self):
+        art = ProgramArtifact(family="mystery", stable_text="x")
+        findings = audit_artifacts([art]).findings  # real registry
+        assert ("unregistered-program", "program://mystery@tp1") in _ids(findings)
+
+    def test_extraction_error_is_a_finding(self):
+        art = ProgramArtifact(family="pool_tick", error="boom")
+        findings = audit_artifacts([art]).findings
+        assert _ids(findings) == [
+            ("audit-extraction-error", "program://pool_tick@tp1")]
+
+    def test_unexpected_donation_warns(self):
+        def f(c):
+            return c + 1.0
+
+        art = extract_artifact(
+            "noDonate", "", jax.jit(f, donate_argnums=(0,)),
+            (jax.ShapeDtypeStruct((4,), jnp.float32),), meta={})
+        findings = _audit_one(art, {"donated": ()})
+        assert _ids(findings) == [
+            ("unexpected-donation", "program://noDonate@tp1")]
+
+
+# ---------------------------------------------------------------------------
+# baseline + hook mechanics
+# ---------------------------------------------------------------------------
+
+class TestReport:
+    def test_duplicate_labels_both_survive_the_report(self):
+        """The greedy and sampled plain ticks share a label at one
+        width — the JSON report must keep BOTH (a dropped one silently
+        removes its collective bytes from the comm cross-check)."""
+        from deepspeed_tpu.analysis.program.auditor import build_report
+
+        arts = [ProgramArtifact(family="pool_tick", variant="plain",
+                                meta={"tp": 2, "sampled": s})
+                for s in (False, True)]
+        report = build_report(audit_artifacts(arts, contracts={}),
+                              [], [], arts)
+        assert len(report["programs"]) == 2
+        assert "program://pool_tick[plain]@tp2" in report["programs"]
+        assert "program://pool_tick[plain]@tp2#2" in report["programs"]
+
+
+class TestBaselineAndHook:
+    def test_program_findings_round_trip_the_baseline(self, tmp_path):
+        art = ProgramArtifact(family="mystery", stable_text="x")
+        result = audit_artifacts([art])
+        assert result.findings
+        path = os.path.join(str(tmp_path), "audit_baseline.json")
+        Baseline.from_findings(result.findings, root="").save(path)
+        new, baselined = Baseline.load(path).split_new(
+            audit_artifacts([ProgramArtifact(family="mystery",
+                                             stable_text="x")]).findings,
+            root="")
+        assert new == [] and len(baselined) == len(result.findings)
+
+    def test_notify_without_hook_never_calls_the_thunk(self):
+        calls = []
+
+        def thunk():
+            calls.append(1)
+            return ()
+
+        clear_hook()
+        notify_program("pool_tick", "plain", None, thunk)
+        assert calls == []
+
+    def test_notify_with_hook_collects_and_restores(self):
+        col = ArtifactCollector()
+        prev = set_hook(col)
+        try:
+            notify_program(
+                "pool_row_update", "", jax.jit(lambda x: x + 1),
+                lambda: (jax.ShapeDtypeStruct((2,), jnp.int32),),
+                meta=lambda: {"tp": 1})
+        finally:
+            set_hook(prev)
+        assert [a.family for a in col.artifacts] == ["pool_row_update"]
+        assert col.artifacts[0].error == ""
+        assert col.artifacts[0].stable_text
+
+    def test_args_thunk_failure_surfaces_as_extraction_error(self):
+        col = ArtifactCollector()
+        prev = set_hook(col)
+        try:
+            notify_program("pool_tick", "plain", None,
+                           lambda: (_ for _ in ()).throw(RuntimeError("no")))
+        finally:
+            set_hook(prev)
+        assert col.artifacts[0].error.startswith("args_thunk failed")
+        findings = audit_artifacts(col.artifacts).findings
+        assert ("audit-extraction-error",
+                "program://pool_tick[plain]@tp1") in _ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI (in-process: jax is already initialized with the 8-device platform)
+# ---------------------------------------------------------------------------
+
+def _cli_main(argv):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    spec = importlib.util.spec_from_file_location(
+        "_ds_audit_cli", os.path.join(repo, "tools", "ds_audit.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_ds_audit_cli"] = mod
+    spec.loader.exec_module(mod)
+    return mod.main(argv)
+
+
+class TestCli:
+    def test_bad_mesh_is_usage_error(self, capsys):
+        assert _cli_main(["--mesh", "bogus"]) == 2
+        assert "DATA:TENSOR" in capsys.readouterr().err
+
+    def test_unknown_family_is_usage_error(self, capsys):
+        assert _cli_main(["--mesh", "1:1", "--family", "nope"]) == 2
+        assert "unknown famil" in capsys.readouterr().err
+
+    def test_write_baseline_refuses_family_filter(self, capsys):
+        assert _cli_main(["--family", "pool_row_update",
+                          "--write-baseline"]) == 2
+        assert "cannot be combined" in capsys.readouterr().err
+
+    def test_tiny_clean_run_json(self, capsys):
+        import json
+        import logging
+
+        logger = logging.getLogger("deepspeed_tpu")
+        level = logger.level
+        try:
+            # machine formats quiet the stdout logger; in-process, that
+            # must not leak into later tests
+            rc = _cli_main(["--mesh", "1:1", "--family", "pool_row_update",
+                            "--format", "json"])
+        finally:
+            logger.setLevel(level)
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        report = json.loads(out)
+        assert report["summary"]["new"] == 0
+        assert any("pool_row_update" in k for k in report["programs"])
+
+
+def test_program_package_loads_standalone_without_jax():
+    """The ds-lint standalone loader contract extends to analysis/program:
+    the stdlib core (artifact/contracts/rules/auditor, and capture's
+    module surface) must import under the alias package without jax or
+    deepspeed_tpu — keeping tools/ds_lint.py runnable on jax-less hosts
+    with the program package present."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    probe = (
+        "import sys, runpy, importlib;"
+        "ctx = runpy.run_path(%r, run_name='not_main');"
+        "ctx['_load_analysis']();"
+        "prog = importlib.import_module('_ds_lint_analysis.program');"
+        "importlib.import_module('_ds_lint_analysis.program.capture');"
+        "prog.validate_registry();"
+        "assert prog.program_rules();"
+        "assert 'jax' not in sys.modules, 'jax was imported';"
+        "assert 'deepspeed_tpu' not in sys.modules, 'package was imported';"
+    ) % os.path.join(repo, "tools", "ds_lint.py")
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,162 @@
+"""Tier-1 ds-audit gate: lower the SHIPPED tick + train program families
+at tensor width 1 and 2 on the virtual mesh and assert the checked-in
+program-contract registry holds clean against the (empty) audit
+baseline — donation aliasing present with donation on, ZERO collectives
+at 1x1, the exact pinned collective inventory at tp=2, zero host
+transfers, no f64 anywhere.
+
+This is the compiled-program sibling of test_package_gate.py: any PR
+that drops an input_output_alias, re-routes tensor-parallel traffic, or
+sneaks a host callback into a tick program fails tier-1 unless the
+change is explicit (contract edit or baseline entry — both visible in
+review).
+"""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.analysis import Baseline
+from deepspeed_tpu.analysis.program import (
+    audit_artifacts,
+    expected_collectives,
+)
+from deepspeed_tpu.analysis.program.families import (
+    ALL_FAMILIES,
+    build_family_artifacts,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+BASELINE = os.path.join(REPO, "tools", "ds_audit_baseline.json")
+
+HBM_LIMIT = 1 << 30  # generous: exercises the ceiling rule, never trips
+
+
+@pytest.fixture(scope="module")
+def artifacts():
+    """ONE family-table build shared by every gate assertion (each
+    artifact is a lower+compile of a tiny-config program — the expensive
+    part, paid once per module)."""
+    return build_family_artifacts(
+        tensor_widths=(1, 2), donate=True, hbm_limit_bytes=HBM_LIMIT)
+
+
+def _by_label(artifacts):
+    table = {}
+    for a in artifacts:
+        table.setdefault(a.label, []).append(a)
+    return table
+
+
+def test_every_family_lowered_at_both_widths(artifacts):
+    families = {(a.family + (f"[{a.variant}]" if a.variant else ""), a.tp)
+                for a in artifacts}
+    for name in ALL_FAMILIES:
+        for tp in (1, 2):
+            assert (name, tp) in families, (name, tp)
+    assert not [a for a in artifacts if a.error], \
+        [(a.label, a.error) for a in artifacts if a.error]
+
+
+def test_registry_holds_clean_against_the_baseline(artifacts):
+    result = audit_artifacts(artifacts)
+    baseline = Baseline.load(BASELINE)
+    new, baselined = baseline.split_new(result.findings, root="")
+    assert new == [], "\n".join(
+        f"  {f.path}: [{f.severity}] {f.rule_id}: {f.message}" for f in new)
+    # baseline hygiene, same rule as the ds-lint gate: accepted program
+    # debt must still exist or the entry comes out
+    assert len(baselined) == len(baseline.entries), (
+        f"{len(baseline.entries) - len(baselined)} stale audit baseline "
+        f"entr(y|ies) in {BASELINE}")
+
+
+def test_donation_is_honored_everywhere(artifacts):
+    """With donation on, every family's donated leaves all surface as
+    aliases, in BOTH the lowered module and the compiled header."""
+    for a in artifacts:
+        assert a.donated_leaves > 0, a.label
+        assert a.alias_attr_count() == a.donated_leaves, a.label
+        assert a.compiled_alias_count() == a.donated_leaves, a.label
+
+
+def test_replicated_programs_carry_zero_collectives(artifacts):
+    for a in artifacts:
+        if a.tp == 1:
+            assert a.collective_inventory() == {}, (
+                a.label, a.collective_inventory())
+
+
+def test_tp2_inventory_matches_the_pinned_profiles(artifacts):
+    """The exact collective set at tp=2, per family — the calibration
+    the contract registry checks in (a drift here means a sharding
+    change re-routed hot-path traffic; update contracts.py consciously
+    or fix the regression)."""
+    table = _by_label(artifacts)
+    greedy = expected_collectives("tick_forward", 2, sampled=False)
+    sampled = expected_collectives("tick_forward", 2, sampled=True)
+    plain = expected_collectives("plain_forward", 2)
+    for art in table["program://pool_tick[plain]@tp2"]:
+        assert art.collective_inventory() == (
+            sampled if art.meta.get("sampled") else greedy), art.label
+    for label in ("program://pool_tick[burst]@tp2",
+                  "program://pool_tick[fused]@tp2"):
+        for art in table[label]:
+            assert art.collective_inventory() == sampled, label
+    for label in ("program://pool_segment@tp2",
+                  "program://decode_prefill@tp2",
+                  "program://decode_step@tp2"):
+        for art in table[label]:
+            assert art.collective_inventory() == plain, label
+    for art in table["program://pool_row_update@tp2"]:
+        assert art.collective_inventory() == {}
+    for fam in ("train_micro", "train_apply"):
+        for art in table[f"program://{fam}@tp2"]:
+            assert art.collective_inventory() == \
+                expected_collectives(fam, 2), fam
+
+
+def test_no_host_transfers_and_no_f64(artifacts):
+    for a in artifacts:
+        assert a.host_transfers() == [], (a.label, a.host_transfers())
+        assert a.f64_types() == [], (a.label, a.f64_types())
+
+
+def test_capture_hook_sees_a_live_serving_engine(artifacts):
+    """The build-site wiring: a hook installed around a real
+    ContinuousBatchingEngine run captures the pool program families as
+    they are built, and the captured artifacts audit clean."""
+    import numpy as np
+
+    import jax
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.analysis.program.capture import (
+        ArtifactCollector,
+        set_hook,
+    )
+    from deepspeed_tpu.inference.continuous import ContinuousBatchingEngine
+    from deepspeed_tpu.models.transformer import TransformerModel
+
+    from deepspeed_tpu.analysis.program.families import tiny_config
+
+    comm.destroy()
+    model = TransformerModel(tiny_config())
+    params = model.init(jax.random.PRNGKey(0))
+    collector = ArtifactCollector()
+    prev = set_hook(collector)
+    try:
+        eng = ContinuousBatchingEngine(
+            model, params=params, config={"dtype": "float32"},
+            max_slots=2, cache_len=32, donate_cache=False)
+        eng.submit(np.arange(5, dtype=np.int32) + 2, max_new_tokens=2)
+        while eng.has_work():
+            eng.step()
+    finally:
+        set_hook(prev)
+    captured = {a.family for a in collector.artifacts}
+    assert {"pool_tick", "pool_segment", "pool_row_update"} <= captured
+    assert not [a for a in collector.artifacts if a.error]
+    result = audit_artifacts(collector.artifacts)
+    assert result.findings == [], [
+        (f.rule_id, f.path, f.message) for f in result.findings]

@@ -84,3 +84,44 @@ def test_prewarm_full_set_persists(tmp_path, restore_jax_cache_config):
     assert rc == 0
     assert os.path.isdir(cache) and len(os.listdir(cache)) >= 3, \
         os.listdir(cache) if os.path.isdir(cache) else "no cache dir"
+
+
+def test_prewarm_audit_flag(tmp_path, restore_jax_cache_config, capsys):
+    """--audit runs ds-audit over the captured program set at the end of
+    the warm and exits 0 when the contracts hold. The fused-generate
+    path has no capture site (not a registered family yet), so this
+    fast sibling proves the CLI surface + clean exit; the continuous
+    arm of the slow test below captures the real pool families."""
+    from deepspeed_tpu.analysis.program import capture
+    from deepspeed_tpu.inference.prewarm import main
+
+    comm.destroy()
+    cache = str(tmp_path / "xla_cache")
+    rc = main(["--batch", "1", "--prompt", "8", "--new", "2",
+               "--dtype", "float32", "--cache-dir", cache, "--audit", *TINY])
+    assert rc == 0
+    assert not capture.active()  # the hook was cleared on the way out
+    assert "ds-audit over" in capsys.readouterr().out
+
+
+@pytest.mark.slow  # continuous pool warm + a full audit of its programs
+def test_prewarm_audit_captures_pool_programs(tmp_path,
+                                              restore_jax_cache_config,
+                                              capsys):
+    from deepspeed_tpu.inference.prewarm import main
+
+    comm.destroy()
+    cache = str(tmp_path / "xla_cache")
+    rc = main([
+        "--batch", "1", "--prompt", "16", "--new", "4", "--dtype", "float32",
+        "--continuous", "--slots", "2", "--cache-len", "64",
+        "--cache-dir", cache, "--audit", *TINY,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "ds-audit over" in out and "clean" in out
+    # the pool warm built (and the audit therefore saw) real programs
+    import re
+
+    m = re.search(r"ds-audit over (\d+) captured", out)
+    assert m and int(m.group(1)) > 0, out

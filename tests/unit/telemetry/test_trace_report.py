@@ -215,3 +215,100 @@ def test_cli_missing_file_exit_code():
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# --audit: static-vs-runtime comm cross-check (ds-audit pairing)
+# ---------------------------------------------------------------------------
+
+def _audit_report(bytes_ar=1000, bytes_ag=0):
+    programs = {
+        "program://train_micro@tp2": {
+            "collectives": {"all-reduce": {"count": 3, "bytes": bytes_ar}},
+        },
+    }
+    if bytes_ag:
+        programs["program://pool_tick[plain]@tp2"] = {
+            "collectives": {"all-gather": {"count": 1, "bytes": bytes_ag}},
+        }
+    return {"version": 1, "tool": "ds-audit", "programs": programs}
+
+
+def _steps(per_step):
+    return [{"schema": 1, "kind": "train_step",
+             "comm_bytes": dict(per_step)} for _ in range(4)]
+
+
+def test_audit_crosscheck_ok_within_tolerance():
+    rows = ds_trace_report.audit_crosscheck(
+        _steps({"all_reduce": 1200}), _audit_report(bytes_ar=1000))
+    assert rows["all_reduce"]["verdict"] == "ok"
+    assert rows["all_reduce"]["ratio"] == 1.2
+    assert rows["all_reduce"]["static_bytes"] == 1000
+
+
+def test_audit_crosscheck_warns_beyond_tolerance():
+    rows = ds_trace_report.audit_crosscheck(
+        _steps({"all_reduce": 50_000}), _audit_report(bytes_ar=1000))
+    assert rows["all_reduce"]["verdict"] == "WARN"
+    text = ds_trace_report.format_audit_crosscheck(rows, 0.5)
+    assert "warning:" in text and "all_reduce" in text
+
+
+def test_audit_crosscheck_static_only_is_not_a_warning():
+    """XLA-inserted collectives are invisible to CommsLogger — a static
+    prediction with zero runtime bytes must NOT warn."""
+    rows = ds_trace_report.audit_crosscheck(
+        _steps({}), _audit_report(bytes_ar=1000, bytes_ag=512))
+    assert rows["all_reduce"]["verdict"] == "static-only"
+    assert rows["all_gather"]["verdict"] == "static-only"
+    assert "warning:" not in ds_trace_report.format_audit_crosscheck(rows, 0.5)
+
+
+def test_audit_crosscheck_zero_delta_op_is_silent():
+    """An op that ran once at init appears in every later train_step's
+    comm_bytes with delta 0 — zero on both sides must produce NO row
+    (and certainly no warning)."""
+    rows = ds_trace_report.audit_crosscheck(
+        _steps({"all_reduce": 1200, "broadcast": 0}),
+        _audit_report(bytes_ar=1000))
+    assert "broadcast" not in rows
+    assert rows["all_reduce"]["verdict"] == "ok"
+
+
+def test_audit_crosscheck_runtime_only_warns():
+    """Runtime traffic no audited program explains IS a warning (the
+    measurement or the audit scope is wrong)."""
+    rows = ds_trace_report.audit_crosscheck(
+        _steps({"all_to_all": 4096}), _audit_report(bytes_ar=1000))
+    assert rows["all_to_all"]["verdict"] == "WARN"
+
+
+def test_audit_crosscheck_falls_back_to_comm_summary():
+    events = [
+        {"schema": 1, "kind": "comm_summary",
+         "ops": {"all_reduce": {"count": 4, "total_bytes": 4000}}},
+    ]
+    rows = ds_trace_report.audit_crosscheck(events, _audit_report(1000))
+    assert rows["all_reduce"]["measured_bytes"] == 4000.0
+
+
+def test_cli_audit_flag(tmp_path):
+    audit = tmp_path / "audit.json"
+    audit.write_text(json.dumps(_audit_report(bytes_ar=1000)))
+    trace = tmp_path / "trace.jsonl"
+    trace.write_text("\n".join(json.dumps(e) for e in _steps(
+        {"all_reduce": 900})) + "\n")
+    proc = subprocess.run(
+        [sys.executable, CLI, str(trace), "--audit", str(audit), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rows = json.loads(proc.stdout)["audit_crosscheck"]
+    assert rows["all_reduce"]["verdict"] == "ok"
+    # unreadable audit report is a usage error
+    proc = subprocess.run(
+        [sys.executable, CLI, str(trace), "--audit", "/nonexistent.json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# The per-PR gate, as ONE documented entry point (README "Development"):
+#
+#   1. ds-lint  --changed --format sarif   (source contracts, diff-scoped)
+#   2. ds-audit --format sarif             (compiled-program contracts)
+#   3. tier-1 tests                        (the ROADMAP.md command)
+#
+# Usage:  tools/ci_check.sh [BASE_REF] [SARIF_DIR]
+#   BASE_REF   git ref to diff against for ds-lint --changed (default HEAD,
+#              i.e. uncommitted work; CI passes origin/main)
+#   SARIF_DIR  where the SARIF documents land (default ./ci_artifacts)
+#
+# Exit: non-zero on the FIRST failing stage; SARIF files are written for
+# whichever stages ran (code hosts ingest them for PR annotation).
+
+set -o pipefail
+set -u
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+BASE_REF="${1:-HEAD}"
+SARIF_DIR="${2:-${REPO}/ci_artifacts}"
+mkdir -p "${SARIF_DIR}"
+
+echo "ci_check: [1/3] ds-lint --changed ${BASE_REF} --format sarif"
+python "${REPO}/tools/ds_lint.py" --changed "${BASE_REF}" --format sarif \
+    > "${SARIF_DIR}/ds_lint.sarif"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "ci_check: ds-lint FAILED (exit $rc) — findings in ${SARIF_DIR}/ds_lint.sarif" >&2
+    exit $rc
+fi
+
+echo "ci_check: [2/3] ds-audit --format sarif"
+python "${REPO}/tools/ds_audit.py" --format sarif \
+    > "${SARIF_DIR}/ds_audit.sarif"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "ci_check: ds-audit FAILED (exit $rc) — findings in ${SARIF_DIR}/ds_audit.sarif" >&2
+    exit $rc
+fi
+
+echo "ci_check: [3/3] tier-1 tests (ROADMAP.md command)"
+cd "${REPO}" || exit 2
+rm -f /tmp/_t1.log
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ $rc -ne 0 ]; then
+    echo "ci_check: tier-1 FAILED (exit $rc) — log at /tmp/_t1.log" >&2
+fi
+exit $rc

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""ds-audit launcher — audit the shipped program families' contracts
+(donation aliasing, collective inventory, host transfers, dtype policy,
+HBM ceiling) over lowered XLA artifacts, on a virtual CPU mesh.
+
+Unlike ``tools/ds_lint.py`` this DOES import jax (programs must be
+lowered to be audited); it arranges a multi-device virtual CPU platform
+*before* jax initializes so sharded widths (``--mesh 1:2``) work on any
+host.
+
+Usage (see docs/static_analysis.md "Program audit"):
+    python tools/ds_audit.py                       # full table, 1:1 + 1:2
+    python tools/ds_audit.py --mesh 1:1            # replicated only
+    python tools/ds_audit.py --format sarif        # CI annotation pairing
+    python tools/ds_audit.py --family 'pool_tick[plain]' --family train_micro
+    python tools/ds_audit.py --write-baseline      # accept current state
+
+Exit codes match ds-lint: 0 clean, 1 new findings, 2 usage error.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(REPO, "tools", "ds_audit_baseline.json")
+_VIRTUAL_DEVICES = 8
+
+
+def _prepare_platform(max_width: int):
+    """Force a CPU platform with enough virtual devices BEFORE jax
+    initializes its backend. On jax 0.4.x the device count is only an
+    XLA flag, and the flag is read at first backend use — so this must
+    run before any jax import in the process."""
+    if "jax" in sys.modules:
+        import jax
+
+        if len(jax.devices()) >= max_width:
+            return
+        print(f"ds-audit: jax already initialized with "
+              f"{len(jax.devices())} device(s) but --mesh needs "
+              f"{max_width}; run in a fresh process", file=sys.stderr)
+        raise SystemExit(2)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+                    f"{max(_VIRTUAL_DEVICES, max_width)}").strip()
+
+
+def _parse_meshes(spec: str):
+    """'1:1,1:2' -> [(1, 1), (1, 2)] (data:tensor pairs; only the tensor
+    width shapes the audited programs — data stays 1 on subset meshes)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) != 2 or not all(f.isdigit() and int(f) >= 1
+                                       for f in fields):
+            raise ValueError(
+                f"--mesh wants DATA:TENSOR[,DATA:TENSOR...], got {part!r}")
+        out.append((int(fields[0]), int(fields[1])))
+    if not out:
+        raise ValueError("--mesh parsed to no meshes")
+    return out
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="ds-audit",
+        description="program-contract audit over lowered XLA artifacts "
+                    "(the compiled-program sibling of ds-lint)")
+    parser.add_argument(
+        "--mesh", default="1:1,1:2", metavar="DATA:TENSOR[,..]",
+        help="serving-mesh widths to audit under (default 1:1,1:2 — the "
+             "replicated table plus one sharded width)")
+    parser.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text",
+        dest="fmt", help="report format (default: text)")
+    parser.add_argument(
+        "--family", action="append", default=None, metavar="FAMILY",
+        help="audit only this program family (repeatable; e.g. "
+             "'pool_tick[plain]', 'train_micro')")
+    parser.add_argument(
+        "--layers", type=int, default=1,
+        help="tiny-model depth (the layer scan makes the collective "
+             "inventory depth-invariant; >1 only re-verifies that)")
+    parser.add_argument(
+        "--no-donate", action="store_true",
+        help="build the serving families donation-off (the CPU overlap "
+             "A/B configuration — donation checks then skip)")
+    parser.add_argument(
+        "--kv-int8", action="store_true",
+        help="build the serving families with an int8 KV cache (enables "
+             "the int8-upcast contract check)")
+    parser.add_argument(
+        "--hbm-limit", type=int, default=0, metavar="BYTES",
+        help="per-chip HBM ceiling for the static-memory check "
+             "(default 0 = skip; serving configs carry it as "
+             "telemetry.hbm_limit_bytes)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help=f"baseline JSON of accepted findings (default: "
+             f"{os.path.relpath(_DEFAULT_BASELINE, REPO)} when present)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline; report and fail on every finding")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline accepting all current findings")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the audit rule catalog and exit")
+    return parser
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        sys.path.insert(0, REPO)
+        from deepspeed_tpu.analysis.program import program_rules
+
+        for rule in sorted(program_rules(), key=lambda r: r.id):
+            print(f"{rule.id:24s} [{rule.severity}] {rule.description}")
+        return 0
+
+    try:
+        meshes = _parse_meshes(args.mesh)
+    except ValueError as exc:
+        print(f"ds-audit: {exc}", file=sys.stderr)
+        return 2
+    if args.write_baseline and args.family:
+        print("ds-audit: --write-baseline cannot be combined with "
+              "--family (a filtered write would drop every other "
+              "family's entries)", file=sys.stderr)
+        return 2
+
+    _prepare_platform(max(d * t for d, t in meshes))
+    sys.path.insert(0, REPO)
+
+    from deepspeed_tpu.analysis.program import audit_artifacts, program_rules
+    from deepspeed_tpu.analysis.program.auditor import (
+        build_report,
+        render,
+        split_against_baseline,
+        write_baseline,
+    )
+    from deepspeed_tpu.analysis.program.families import (
+        ALL_FAMILIES,
+        build_family_artifacts,
+    )
+
+    # the stack's logger writes INFO to STDOUT (engine-ready banners);
+    # machine formats must emit exactly one parseable document there.
+    # AFTER the imports above: the package import creates the logger and
+    # sets its level — configuring earlier gets overwritten
+    if args.fmt != "text":
+        import logging
+
+        logging.getLogger("deepspeed_tpu").setLevel(logging.WARNING)
+
+    if args.family:
+        unknown = [f for f in args.family if f not in ALL_FAMILIES]
+        if unknown:
+            print(f"ds-audit: unknown famil{'y' if len(unknown) == 1 else 'ies'} "
+                  f"{', '.join(unknown)} (known: {', '.join(ALL_FAMILIES)})",
+                  file=sys.stderr)
+            return 2
+
+    widths = sorted({t for _, t in meshes})
+    artifacts = build_family_artifacts(
+        tensor_widths=widths, donate=not args.no_donate,
+        hbm_limit_bytes=args.hbm_limit, kv_int8=args.kv_int8,
+        families=args.family, layers=args.layers)
+    result = audit_artifacts(artifacts)
+
+    if args.write_baseline:
+        path = args.baseline or _DEFAULT_BASELINE
+        n = write_baseline(result, path)
+        print(f"ds-audit: wrote {n} finding(s) to {path}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline \
+            and os.path.exists(_DEFAULT_BASELINE):
+        baseline_path = _DEFAULT_BASELINE
+    new, baselined = split_against_baseline(
+        result, baseline_path, no_baseline=args.no_baseline)
+
+    report = build_report(result, new, baselined, artifacts)
+    rendered = render(report, args.fmt, rules=program_rules())
+    if rendered:
+        print(rendered)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -424,6 +424,93 @@ def format_compile_table(table):
     return "\n".join(lines) + "\n"
 
 
+def audit_crosscheck(events, audit_report, tolerance=0.5):
+    """Static-vs-runtime comm cross-check: ds-audit's per-program
+    collective bytes (the ``programs`` block of ``ds_audit.py --format
+    json``) against what the trace actually logged — ``train_step``
+    events' per-step ``comm_bytes`` deltas when present, else the last
+    ``comm_summary`` totals averaged over the step span.
+
+    Returns rows keyed by op kind: ``static_bytes`` (summed operand
+    bytes per dispatch over every audited program), ``measured_bytes``
+    (per step), ``ratio`` and ``verdict``:
+
+    - ``WARN``: both sides nonzero but the ratio falls outside
+      ``[tolerance, 1/tolerance]`` — the measurement and the artifact
+      disagree (a CommsLogger.append drifted from the real op, or the
+      audited program is not the one serving), OR runtime traffic exists
+      with no static counterpart at all.
+    - ``static-only``: the audited programs contain the collective but
+      the trace never logged it. NOT a warning: XLA-inserted collectives
+      (sharding-implicit) are invisible to CommsLogger by design — only
+      explicit ``comm.*`` wrapper calls log (docs/telemetry.md).
+    - ``ok``: within tolerance.
+
+    The same honesty rule as the unsynced-timing lint: numbers that
+    cannot be reconciled should say so, loudly, in the report."""
+    kinds = {}
+    for prog in (audit_report.get("programs") or {}).values():
+        for kind, stats in (prog.get("collectives") or {}).items():
+            key = kind.replace("-", "_")
+            kinds[key] = kinds.get(key, 0) + int(stats.get("bytes", 0))
+
+    steps = [ev for ev in events if ev.get("kind") == "train_step"]
+    measured = {}
+    if steps:
+        for ev in steps:
+            for op, b in (ev.get("comm_bytes") or {}).items():
+                measured[op] = measured.get(op, 0.0) + float(b)
+        measured = {op: total / len(steps) for op, total in measured.items()}
+    else:
+        summaries = [ev for ev in events if ev.get("kind") == "comm_summary"]
+        if summaries:
+            ops = summaries[-1].get("ops") or {}
+            span = max(len(summaries), 1)
+            measured = {op: float(stats.get("total_bytes", 0)) / span
+                        for op, stats in ops.items()}
+
+    rows = {}
+    for op in sorted(set(kinds) | set(measured)):
+        static = kinds.get(op, 0)
+        runtime = measured.get(op, 0.0)
+        if static <= 0 and runtime <= 0:
+            # an op that ran once at init shows up in every later step's
+            # comm_bytes with delta 0 — zero on both sides carries no
+            # information, and certainly not a warning
+            continue
+        row = {"static_bytes": static, "measured_bytes": round(runtime, 1)}
+        if static > 0 and runtime > 0:
+            ratio = runtime / static
+            row["ratio"] = round(ratio, 3)
+            row["verdict"] = ("ok" if tolerance <= ratio <= 1.0 / tolerance
+                              else "WARN")
+        elif static > 0:
+            row["verdict"] = "static-only"
+        else:
+            row["verdict"] = "WARN"  # runtime bytes nothing static explains
+        rows[op] = row
+    return rows
+
+
+def format_audit_crosscheck(rows, tolerance):
+    lines = ["Comm cross-check — ds-audit static vs CommsLogger runtime "
+             f"(tolerance {tolerance}x)",
+             f"  {'op':<20} {'static B/dispatch':>18} {'measured B/step':>16} "
+             f"{'ratio':>8}  verdict"]
+    for op, row in rows.items():
+        ratio = row.get("ratio")
+        lines.append(
+            f"  {op:<20} {row['static_bytes']:>18} "
+            f"{row['measured_bytes']:>16} "
+            f"{ratio if ratio is not None else '-':>8}  {row['verdict']}")
+    warns = [op for op, row in rows.items() if row["verdict"] == "WARN"]
+    if warns:
+        lines.append(f"  warning: {len(warns)} op kind(s) beyond tolerance "
+                     f"({', '.join(warns)}) — static artifact and runtime "
+                     f"measurement disagree")
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v):
     if v == 0:
         return "0"
@@ -478,6 +565,15 @@ def main(argv=None):
     ap.add_argument("--memory", action="store_true",
                     help="only the per-component HBM table (peak + latest "
                          "bytes per chip over memory_snapshot events)")
+    ap.add_argument("--audit", metavar="AUDIT_JSON", default=None,
+                    help="cross-check ds-audit's predicted per-program "
+                         "collective bytes (ds_audit.py --format json "
+                         "output) against the trace's CommsLogger "
+                         "comm_summary/train_step volume; mismatch beyond "
+                         "tolerance prints a warning row")
+    ap.add_argument("--audit-tolerance", type=float, default=0.5,
+                    help="accepted measured/static ratio band "
+                         "[T, 1/T] for --audit (default 0.5)")
     args = ap.parse_args(argv)
 
     try:
@@ -495,6 +591,32 @@ def main(argv=None):
     if not events:
         print(f"no events in {args.trace}", file=sys.stderr)
         return 1
+
+    if args.audit:
+        try:
+            with open(args.audit) as fh:
+                audit_report = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read audit report {args.audit}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not (0.0 < args.audit_tolerance <= 1.0):
+            print("error: --audit-tolerance must be in (0, 1]",
+                  file=sys.stderr)
+            return 2
+        rows = audit_crosscheck(events, audit_report,
+                                tolerance=args.audit_tolerance)
+        if not rows:
+            print("no collective traffic on either side (audit programs "
+                  "carry none, trace logged none)", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"audit_crosscheck": rows}, indent=2,
+                             sort_keys=True))
+        else:
+            sys.stdout.write(
+                format_audit_crosscheck(rows, args.audit_tolerance))
+        return 0
 
     if args.decode:
         table = decode_table(events)
