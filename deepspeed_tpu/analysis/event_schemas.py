@@ -84,6 +84,7 @@ EVENT_SCHEMAS = {
             "deadline_met": "bool",
             "recoveries": "int",
             "recovered_finish": "bool",
+            "replica": "str",
         },
     },
     "serving_event": {
@@ -103,6 +104,34 @@ EVENT_SCHEMAS = {
             "need_tokens": "int",
             "tokens_emitted": "int",
             "deadline_ms": "number",
+            "replica": "str",
+        },
+    },
+    "router_event": {
+        # fleet router lifecycle (serving/router.py), discriminated by
+        # "event": route | spillover | shed | backoff | migrated |
+        # replica_added | replica_dead | replica_drained | drain | kill |
+        # replica_recovering | replica_recovered | replica_failed |
+        # rolling_restart | rolling_restart_done
+        "required": {"event": "str"},
+        "optional": {
+            "replica": "str",
+            "from_replica": "str",
+            "to_replica": "str",
+            "request": "int",
+            "reason": "str",
+            "detail": "str",
+            "health": "str",
+            "verdict": "str",
+            "retry_after_s": "number",
+            "attempts": "int",
+            "need_tokens": "int",
+            "tokens_emitted": "int",
+            "gen_base": "int",
+            "migrated": "int",
+            "lost": "int",
+            "replicas": "int",
+            "tick": "int",
         },
     },
     "serving_tick": {
@@ -114,7 +143,7 @@ EVENT_SCHEMAS = {
             "wasted": "int",
             "fused_prefill": "bool",
         },
-        "optional": {},
+        "optional": {"replica": "str"},
     },
     "serving_fault": {
         # discriminated by "event": fault | retried | retry_failed |
@@ -135,6 +164,7 @@ EVENT_SCHEMAS = {
             "state": "str",
             "outage_ms": "number",
             "requests_lost": "int",
+            "replica": "str",
         },
     },
     "memory_snapshot": {
@@ -147,6 +177,7 @@ EVENT_SCHEMAS = {
             "limit_bytes": "int",
             "headroom_bytes": "int",
             "programs": "dict",
+            "replica": "str",
         },
     },
     "compile_event": {
@@ -156,7 +187,7 @@ EVENT_SCHEMAS = {
             "compile_ms": "number",
             "recompile": "bool",
         },
-        "optional": {},
+        "optional": {"replica": "str"},
     },
 }
 

@@ -7,6 +7,13 @@ the load-test harness behind ``tools/ds_loadgen.py``. See
 docs/serving.md."""
 
 from deepspeed_tpu.serving.engine import ServingEngine, TokenStream
+from deepspeed_tpu.serving.fleet import (
+    RID_STRIDE,
+    Replica,
+    ReplicaTelemetry,
+    attach_replica_telemetry,
+)
+from deepspeed_tpu.serving.router import FleetRouter, FleetStream
 from deepspeed_tpu.serving.faults import (
     EnginePreempted,
     Fault,
@@ -45,6 +52,8 @@ from deepspeed_tpu.serving.request import (
 
 __all__ = [
     "ServingEngine", "TokenStream",
+    "FleetRouter", "FleetStream", "Replica", "ReplicaTelemetry",
+    "attach_replica_telemetry", "RID_STRIDE",
     "SchedulerPolicy", "FifoPolicy", "PriorityPolicy", "EdfPolicy",
     "FairSharePolicy", "resolve_policy",
     "Admission", "ServeRequest",

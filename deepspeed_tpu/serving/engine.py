@@ -69,6 +69,7 @@ from deepspeed_tpu.serving.recovery import (
     RecoveryConfig,
     RecoveryFailed,
     RecoveryLog,
+    snapshot_request,
 )
 from deepspeed_tpu.serving.request import (
     ADMITTED,
@@ -860,6 +861,19 @@ class ServingEngine:
                 "block_ms_per_token": stats.get("block_ms_per_token"),
                 "recovery_generation": self._rebuild_count,
                 "breaker_open": self._breaker_open,
+                # queue residue: how much admitted-but-unfinished work
+                # this replica still owes. "draining with residue" means
+                # don't place here, but the work WILL finish; "breaker
+                # open" means don't place here, the work may die — a
+                # fleet router (or any external probe) must not conflate
+                # the two when deciding whether to wait or migrate.
+                "residue_queued": len(queue),
+                "residue_running": len(running),
+                "residue_tokens": (
+                    sum(max(0, r.max_new_tokens - len(r.tokens))
+                        for r in queue)
+                    + sum(max(0, r.max_new_tokens - len(r.tokens))
+                          for r in running)),
             }
             try:
                 from deepspeed_tpu.telemetry import memory as hbm
@@ -1003,6 +1017,176 @@ class ServingEngine:
         self._update_gauges()
         return True
 
+    # -- fleet membership (serving/router.py) ---------------------------
+    @property
+    def vocab_size(self) -> int:
+        """The engine's vocabulary size — surfaced so fleet-level callers
+        (router, load generator) never reach into ``_cb.cfg``."""
+        return self._cb.cfg.vocab_size
+
+    def set_rid_base(self, base: int):
+        """Partition the engine-rid namespace for fleet membership: every
+        rid this replica assigns naturally from now on is ``>= base``.
+        The fleet router gives each replica slot a disjoint stride so a
+        migrated request's pinned engine rid (its RNG identity, hence its
+        bitwise token stream) can never collide with a rid the survivor
+        hands out on its own. Slot 0 keeps base 0 — a single-replica
+        fleet is rid-for-rid identical to a bare serving engine."""
+        self._rid_watermark = max(self._rid_watermark, int(base))
+        self._cb._next_rid = max(self._cb._next_rid, int(base))
+
+    def admission_outlook(self, need_tokens: int):
+        """What :meth:`submit` would answer RIGHT NOW for a well-formed
+        request committing ``need_tokens`` — ``(status, reason)`` with no
+        side effects: nothing is admitted, queued, or counted, and no
+        ``serving_event`` is emitted. The fleet router uses this to rank
+        candidate replicas before spending the one real ``submit`` (whose
+        verdict — and shed event — is the honest, final one)."""
+        with self._ops_lock:
+            if self._draining:
+                return SHED, "draining"
+            if self._breaker_open:
+                return SHED, "recovering"
+            if len(self._queue) >= self.max_queue_depth:
+                return SHED, "queue_full"
+            if self.committed_tokens() + need_tokens > self.kv_budget_tokens:
+                return SHED, "kv_budget"
+            if not self._queue and self._fits_now(need_tokens):
+                return ADMITTED, ""
+            return QUEUED_STATUS, ""
+
+    def recovery_snapshot(self, include_queued: bool = False) -> List[dict]:
+        """Plain-data copy of every RUNNING request's recovery entry
+        (prompt, emitted tokens, remaining quota, engine rid — see
+        ``RecoveryLog``). This is what the fleet router reads off a dead
+        replica to re-admit its streams onto survivors. With
+        ``include_queued`` the host-side queue is appended too (entries
+        with ``engine_rid`` None, in queue order) — queued requests have
+        no device state but a dead replica's queue still holds work the
+        fleet must not lose."""
+        with self._ops_lock:
+            out = self._recovery_log.snapshot()
+            if include_queued:
+                out.extend(snapshot_request(r) for r in list(self._queue))
+        return out
+
+    def readmit(self, entry: dict, *, on_token=None) -> Admission:
+        """Re-admit a (possibly foreign) ``RecoveryLog`` entry onto THIS
+        serving engine, resuming its stream mid-token: the handover
+        re-prefills ``prompt + emitted`` and continues at
+        ``gen_base=len(emitted)`` under the entry's pinned engine rid, so
+        the tokens that follow are bitwise the ones the lost replica
+        would have produced (``entry["engine_rid"]`` None — the request
+        never reached that engine — gets a natural rid and a fresh
+        stream). Admission-controlled exactly like :meth:`submit`: the
+        verdict is honest, and a shed leaves no state behind. A pinned
+        rid this engine already holds raises ValueError (namespace
+        collision — see :meth:`set_rid_base`)."""
+        prompt = np.asarray(entry["prompt"], np.int32).reshape(-1)
+        emitted = [int(t) for t in entry.get("emitted", [])]
+        max_new = int(entry["max_new_tokens"])
+        need = int(prompt.size) + max_new
+        now = self._clock()
+        if self._t_start is None:
+            self._t_start = now
+        if max_new - len(emitted) < 1:
+            # every token already surfaced host-side: synthesize the
+            # finish — nothing left for an engine to generate
+            rid = self._next_rid
+            self._next_rid += 1
+            req = self._entry_request(rid, entry, prompt, on_token, emitted)
+            self._requests[rid] = req
+            self._finish_request(req, np.concatenate(
+                [prompt, np.asarray(emitted, np.int32)]), now)
+            return Admission(status=ADMITTED, rid=rid)
+        if need > self.kv_budget_tokens:
+            raise ValueError(
+                f"recovery entry needs {need} tokens, over this replica's "
+                f"kv_budget_tokens {self.kv_budget_tokens}: it can never "
+                f"be admitted here")
+        if self._draining:
+            return self._shed("draining", prompt, need, now, no_hint=True)
+        if self._breaker_open:
+            return self._shed("recovering", prompt, need, now)
+        if len(self._queue) >= self.max_queue_depth:
+            return self._shed("queue_full", prompt, need, now)
+        committed = self.committed_tokens()
+        if committed + need > self.kv_budget_tokens:
+            return self._shed("kv_budget", prompt, need, now,
+                              excess=committed + need - self.kv_budget_tokens)
+        rid = self._next_rid
+        self._next_rid += 1
+        req = self._entry_request(rid, entry, prompt, on_token, emitted)
+        self._requests[rid] = req
+        try:
+            if not self._queue and self._fits_now(need):
+                self._handover(req, now)
+                status = ADMITTED
+            else:
+                self._queue.append(req)
+                status = QUEUED_STATUS
+        except ValueError:
+            # engine refused the resume (rid collision, degraded cache):
+            # leave no state behind — the router tries the next survivor
+            self._requests.pop(rid, None)
+            raise
+        self._update_gauges()
+        return Admission(status=status, rid=rid)
+
+    def _entry_request(self, rid: int, entry: dict, prompt, on_token,
+                       emitted: List[int]) -> ServeRequest:
+        """A live ``ServeRequest`` rebuilt from a recovery entry: original
+        submit time (queue-wait and deadline clocks keep running across
+        the migration), emitted tokens pre-seeded (streams replay them,
+        then continue), pinned engine rid carried until handover."""
+        req = ServeRequest(rid=rid, prompt=prompt,
+                           max_new_tokens=int(entry["max_new_tokens"]),
+                           priority=int(entry.get("priority", 0)),
+                           tenant=str(entry.get("tenant", "default")),
+                           deadline_ms=entry.get("deadline_ms"),
+                           on_token=on_token,
+                           submit_t=float(entry["submit_t"]))
+        req.tokens.extend(emitted)
+        req.engine_rid = entry.get("engine_rid")
+        req.recoveries = 1
+        return req
+
+    def release(self, rid: int) -> Optional[ServeRequest]:
+        """Detach a live request WITHOUT terminal accounting: no state
+        change, no counter, no event — the request is not lost, it
+        continues on another replica (the fleet router calls this after
+        a successful cross-replica ``readmit``). Frees the local slot
+        best-effort (the engine may already be gone). Returns the record,
+        or None if unknown/terminal (nothing to release)."""
+        req = self._requests.get(rid)
+        if req is None or req.state in TERMINAL_STATES:
+            return None
+        self._requests.pop(rid)
+        self._queue = [r for r in self._queue if r.rid != rid]
+        if req.engine_rid is not None:
+            self._running.pop(req.engine_rid, None)
+            self._staged.pop(req.engine_rid, None)
+            try:
+                self._cb.cancel(req.engine_rid)
+            except Exception:  # noqa: BLE001 — engine may be lost/poisoned
+                pass
+        self._recovery_log.retire(rid)
+        self._update_gauges()
+        return req
+
+    def abandon(self, detail: str) -> Dict[int, ServeRequest]:
+        """Mark every live request shed (reason ``engine_lost``) — the
+        honest terminal outcome for work that could not be migrated off a
+        dead replica. Same accounting as the in-engine terminal-failure
+        path (:meth:`_fail_terminally`) but without raising: the fleet
+        keeps serving on the survivors. Returns the abandoned records."""
+        live = [r for r in self._requests.values()
+                if r.state not in TERMINAL_STATES]
+        for req in live:
+            self._mark_lost(req, detail)
+        self._update_gauges()
+        return {r.rid: r for r in live}
+
     # -- internals ------------------------------------------------------
     def _shed(self, reason: str, prompt, need: int, now: float,
               excess: Optional[int] = None, no_hint: bool = False) -> Admission:
@@ -1079,7 +1263,21 @@ class ServingEngine:
                    for p in self._effective_pool_state())
 
     def _handover(self, req: ServeRequest, now: float):
-        if req.prefix_id is not None and req.prefix_id in self._prefixes:
+        if req.engine_rid is not None or req.tokens:
+            # migrated resume (readmit): re-prefill prompt + everything
+            # already emitted and continue at gen_base, pinning the
+            # foreign engine rid — the RNG identity — so the stream is
+            # bitwise the one the lost replica would have produced.
+            # rid None means the request never reached the dead
+            # replica's engine (still queued there): a natural rid is
+            # correct, the stream starts fresh.
+            full = (np.concatenate([req.prompt,
+                                    np.asarray(req.tokens, np.int32)])
+                    if req.tokens else req.prompt)
+            req.engine_rid = self._cb.submit(
+                full, req.max_new_tokens - len(req.tokens),
+                rid=req.engine_rid, gen_base=len(req.tokens))
+        elif req.prefix_id is not None and req.prefix_id in self._prefixes:
             # splice the registered prefix KV; only the suffix prefills
             suffix = req.prompt[self._prefixes[req.prefix_id].size:]
             req.engine_rid = self._cb.submit_with_prefix(

@@ -149,7 +149,9 @@ def run_load(serving, workload: List[dict], arrivals: List[float],
     if len(arrivals) != len(workload):
         raise ValueError(f"{len(workload)} workload items but "
                          f"{len(arrivals)} arrival times")
-    vocab = serving._cb.cfg.vocab_size
+    # works for a single ServingEngine AND a FleetRouter — both expose
+    # the same submit/step/reap/vocab_size surface
+    vocab = serving.vocab_size
     n = len(workload)
     records: List[dict] = [{} for _ in range(n)]
     rid_to_index: Dict[int, int] = {}
@@ -290,6 +292,84 @@ def chaos_scorecard(records: List[dict], wall_s: float, recovery: dict,
     return out
 
 
+def fleet_scorecard(router, records: List[dict]) -> dict:
+    """The ``fleet`` summary section for a :class:`FleetRouter` run:
+    per-replica placement outcomes (from the fleet ``statusz``) plus the
+    conservation check the failover contract promises — every admitted
+    request ends terminal (finished / shed / expired / cancelled);
+    replica death loses none silently."""
+    st = router.statusz()
+    placed = [r for r in records if "rid" in r]
+    terminal = sum(1 for r in placed if "state" in r)
+    return {
+        "replicas": {
+            rid: {"state": info["state"], "admitted": info["admitted"],
+                  "shed": info["shed"],
+                  "migrated_in": info["migrated_in"],
+                  "migrated_out": info["migrated_out"]}
+            for rid, info in sorted(st["replicas"].items())
+        },
+        "submitted": st["submitted"],
+        "admitted": st["admitted"],
+        "shed": st["shed"],
+        "spillovers": st["spillovers"],
+        "migrated": st["migrated"],
+        "lost": st["lost"],
+        "replica_deaths": st["replica_deaths"],
+        "conservation_ok": (terminal == len(placed)
+                            and len(placed) == st["admitted"]),
+    }
+
+
+def format_fleet_sweep(results: "Dict[str, dict]") -> str:
+    """``--replicas 1,2,4``: one scorecard per fleet size plus the
+    goodput / SLO-met curve table — the scaling headline the ISSUE's
+    acceptance criteria cite."""
+    lines = []
+    for n in sorted(results, key=int):
+        lines += [f"== fleet: {n} replica(s) ==",
+                  format_summary(results[n]).rstrip(), ""]
+    lines.append("replicas  throughput  goodput   shed     deadline-met")
+    for n in sorted(results, key=int):
+        s = results[n]
+        dm = s.get("deadline_met_frac")
+        lines.append(f"{n:<9} {s['throughput_tok_s']:<11} "
+                     f"{s['goodput_tok_s']:<9} {s['shed_rate']:<8.2%} "
+                     f"{f'{dm:.2%}' if dm is not None else '-'}")
+    return "\n".join(lines) + "\n"
+
+
+def fleet_record(results: "Dict[str, dict]", workload_args: dict) -> dict:
+    """FLEET_*-style JSON record for a ``--replicas`` sweep: the
+    goodput/SLO curve per fleet size plus the full summaries, in the
+    shape the repo's committed perf records use."""
+    import jax
+
+    curves = {
+        n: {
+            "throughput_tok_s": s.get("throughput_tok_s"),
+            "goodput_tok_s": s.get("goodput_tok_s"),
+            "shed_rate": s.get("shed_rate"),
+            "deadline_met_frac": s.get("deadline_met_frac"),
+            "ttft_ms": s.get("ttft_ms"),
+            "replica_deaths": (s.get("fleet") or {}).get("replica_deaths"),
+            "migrated": (s.get("fleet") or {}).get("migrated"),
+            "lost": (s.get("fleet") or {}).get("lost"),
+            "conservation_ok": (s.get("fleet") or {}).get("conservation_ok"),
+        }
+        for n, s in results.items()
+    }
+    return {
+        "kind": "serving_fleet_sweep",
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": jax.device_count(),
+        "replicas": sorted(int(n) for n in results),
+        "curves": curves,
+        "workload": workload_args,
+        "summaries": results,
+    }
+
+
 def summarize(records: List[dict], wall_s: float,
               tick_stats: Optional[dict] = None) -> dict:
     """The serving scorecard over one run's records: counts per outcome,
@@ -313,6 +393,33 @@ def summarize(records: List[dict], wall_s: float,
         "offered_rps": round(len(records) / span, 3) if span > 0 else None,
         "shed_rate": round(len(shed) / len(records), 4) if records else 0.0,
     }
+    # honest-retry accounting per shed reason: how many verdicts carried
+    # a retry_after_s hint and the mean hint. ds_trace_report --serve
+    # computes the SAME table from the serving_event stream — the two
+    # must agree (tests/unit/serving/test_shed_hints.py)
+    by_reason: Dict[str, dict] = {}
+    for r in records:
+        if r.get("state") != "shed":
+            continue
+        # reaped sheds (admitted, then shed during failover) carry no
+        # admission reason — bucket them separately, they are the fleet's
+        # post-admission losses, not admission-control verdicts
+        d = by_reason.setdefault(r.get("reason", "post_admission"),
+                                 {"count": 0, "with_hint": 0, "hints": []})
+        d["count"] += 1
+        if r.get("retry_after_s") is not None:
+            d["with_hint"] += 1
+            d["hints"].append(float(r["retry_after_s"]))
+    if by_reason:
+        out["shed_by_reason"] = {
+            reason: {
+                "count": d["count"],
+                "with_hint": d["with_hint"],
+                "retry_after_s_mean": (round(sum(d["hints"]) / len(d["hints"]),
+                                             4) if d["hints"] else None),
+            }
+            for reason, d in sorted(by_reason.items())
+        }
     for field in ("ttft_ms", "tbt_ms", "queue_ms"):
         vals = [r[field] for r in finished if field in r]
         if vals:
@@ -349,6 +456,15 @@ def format_summary(summary: dict) -> str:
     lines.append(f"throughput     {summary['throughput_tok_s']} tok/s")
     lines.append(f"goodput        {summary['goodput_tok_s']} tok/s")
     lines.append(f"shed rate      {summary['shed_rate']:.2%}")
+    sbr = summary.get("shed_by_reason")
+    if sbr:
+        parts = []
+        for reason, d in sbr.items():
+            hint = (f" hint~{d['retry_after_s_mean']}s"
+                    if d["retry_after_s_mean"] is not None else "")
+            parts.append(f"{reason}={d['count']} "
+                         f"({d['with_hint']} hinted{hint})")
+        lines.append("shed reasons   " + "   ".join(parts))
     if "deadline_met_frac" in summary:
         lines.append(f"deadline met   {summary['deadline_met_frac']:.2%}")
     host = summary.get("host")
@@ -386,6 +502,18 @@ def format_summary(summary: dict) -> str:
                          f"(floor {dip['floor_tok_s']} tok/s vs median "
                          f"{dip['baseline_tok_s']} tok/s over "
                          f"{dip['bin_s']}s bins)")
+    fleet = summary.get("fleet")
+    if fleet:
+        reps = "  ".join(
+            f"{rid}:{info['state']} adm={info['admitted']} "
+            f"mig={info['migrated_in']}/{info['migrated_out']}"
+            for rid, info in fleet["replicas"].items())
+        lines.append(f"fleet          {reps}")
+        lines.append(
+            f"               deaths {fleet['replica_deaths']}   "
+            f"migrated {fleet['migrated']}   lost {fleet['lost']}   "
+            f"spillovers {fleet['spillovers']}   conservation "
+            + ("ok" if fleet["conservation_ok"] else "VIOLATED"))
     return "\n".join(lines) + "\n"
 
 
@@ -479,6 +607,12 @@ def _parse_range(spec: str):
     return int(lo), int(hi)
 
 
+def _parse_kill(spec: str):
+    # "12" -> (12, None); "12:40" -> (12, 40)
+    tick, sep, restore = spec.partition(":")
+    return int(tick), (int(restore) if sep else None)
+
+
 def _parse_buckets(spec: str):
     # "2x32,1x64" -> [(2, 32), (1, 64)]
     out = []
@@ -570,6 +704,25 @@ def main(argv=None) -> int:
                    help="watchdog on the per-tick packed-result fetch; "
                         "an over-budget fetch abandons the engine and "
                         "triggers a rebuild (--chaos)")
+    p.add_argument("--replicas", default=None, metavar="N[,N..]",
+                   help="serve through a FleetRouter over N ServingEngine "
+                        "replicas (docs/serving.md 'Fleet'); a comma list "
+                        "(e.g. 1,2,4) sweeps fleet sizes over the SAME "
+                        "workload and reports the goodput/SLO-met curve")
+    p.add_argument("--kill-replica", default=None, metavar="TICK[:RESTORE]",
+                   help="chaos: abruptly kill the lowest-slot healthy "
+                        "replica at router tick TICK (1-based, replayable "
+                        "— same surface as the fault plans); live streams "
+                        "migrate to survivors and resume bitwise. With "
+                        ":RESTORE, a fresh replica joins at that tick")
+    p.add_argument("--rolling-restart", type=int, default=None,
+                   metavar="TICK", help="start a zero-loss rolling restart "
+                        "of the whole fleet at router tick TICK (add the "
+                        "replacement first, then drain — capacity never "
+                        "dips)")
+    p.add_argument("--fleet-out", default=None, metavar="FILE",
+                   help="write the --replicas sweep as a FLEET_*-style "
+                        "JSON record (goodput/SLO curve per fleet size)")
     p.add_argument("--policy", default="fifo",
                    choices=("fifo", "priority", "edf", "fair"))
     p.add_argument("--queue-depth", type=int, default=64)
@@ -729,6 +882,119 @@ def main(argv=None) -> int:
         p.error("--chaos measures one fault-injected run; it does not "
                 "combine with the A/B modes or the mesh sweep (compare a "
                 "chaos run against a no-chaos run of the same workload)")
+
+    # -- fleet mode (--replicas): route through a FleetRouter -----------
+    if (args.kill_replica or args.rolling_restart is not None
+            or args.fleet_out) and not args.replicas:
+        p.error("--kill-replica / --rolling-restart / --fleet-out need "
+                "--replicas (they schedule chaos on the fleet router)")
+    if args.replicas:
+        try:
+            fleet_sizes = [int(x) for x in args.replicas.split(",")]
+        except ValueError:
+            p.error(f"--replicas {args.replicas!r} is not N or N,N,..")
+        if any(n < 1 for n in fleet_sizes):
+            p.error("--replicas sizes must be >= 1")
+        if (args.ab_pipeline or args.ab_mesh or meshes or args.mesh_out
+                or args.chaos):
+            p.error("--replicas does not combine with the pipeline/mesh "
+                    "A/B modes or engine-level --chaos — fleet chaos is "
+                    "--kill-replica / --rolling-restart (replica-level "
+                    "faults through the router's replayable tick hooks)")
+        kill_spec = _parse_kill(args.kill_replica) if args.kill_replica \
+            else None
+
+        from deepspeed_tpu.serving.fleet import attach_replica_telemetry
+        from deepspeed_tpu.serving.router import FleetRouter
+
+        def build_fleet(n: int, trace_out=None) -> FleetRouter:
+            # ONE shared hub for the whole fleet: the first replica's
+            # engine is built with the telemetry config (trace file /
+            # ops registry) and its hub becomes the base; every replica
+            # — including the first, and any --kill-replica :RESTORE or
+            # rolling-restart replacement — talks through a
+            # ReplicaTelemetry facade that tags its events and metrics
+            # with the replica id
+            holder: dict = {}
+
+            def factory(replica_id: str):
+                if "hub" not in holder:
+                    cb = build_cb(args.pipeline_depth, trace_out=trace_out)
+                    holder["hub"] = cb._eng.telemetry
+                else:
+                    cb = build_cb(args.pipeline_depth)
+                attach_replica_telemetry(cb, holder["hub"], replica_id)
+                return ServingEngine(
+                    cb, policy=args.policy,
+                    max_queue_depth=args.queue_depth,
+                    kv_budget_tokens=args.kv_budget, aging_s=args.aging_s)
+
+            return FleetRouter(factory, replicas=n)
+
+        def kill_lowest_healthy(router: FleetRouter):
+            for rid in router.replica_ids():  # slot order
+                if router.statusz()["replicas"][rid]["state"] == "healthy":
+                    router.kill(rid, detail="loadgen --kill-replica")
+                    return
+
+        def one_fleet_run(n: int, trace_out=None) -> dict:
+            router = build_fleet(n, trace_out=trace_out)
+            if kill_spec is not None:
+                tick, restore = kill_spec
+                router.at_tick(tick, kill_lowest_healthy)
+                if restore is not None:
+                    router.at_tick(restore, lambda r: r.add())
+            if args.rolling_restart is not None:
+                router.at_tick(args.rolling_restart,
+                               lambda r: r.rolling_restart())
+            if args.ops_port is not None:
+                ops = router.start_ops_server(port=args.ops_port)
+                print(f"fleet ops server live at {ops.url} "
+                      f"(/metrics /healthz /statusz)")
+            records, wall_s = run_load(router, workload, arrivals,
+                                       seed=args.seed)
+            summary = summarize(records, wall_s,
+                                tick_stats=router.tick_stats())
+            summary["fleet"] = fleet_scorecard(router, records)
+            if kill_spec is not None or args.rolling_restart is not None:
+                summary["chaos"] = chaos_scorecard(
+                    records, wall_s, router.recovery_stats())
+            router.close()
+            return summary
+
+        results = {}
+        for n in fleet_sizes:
+            trace = args.trace_out
+            if trace and len(fleet_sizes) > 1:
+                trace = f"{trace}.x{n}.jsonl"
+            results[str(n)] = one_fleet_run(n, trace_out=trace)
+        if args.fleet_out:
+            record = fleet_record(results, {
+                "requests": len(workload), "rate": args.rate,
+                "process": args.process, "seed": args.seed,
+                "pipeline_depth": args.pipeline_depth,
+                "slots": args.slots, "cache_len": args.cache_len,
+                "deadline_ms": args.deadline_ms, "preset": args.preset,
+                "kill_replica": args.kill_replica,
+                "rolling_restart": args.rolling_restart})
+            with open(args.fleet_out, "w") as fh:
+                json.dump(record, fh, indent=2, sort_keys=True)
+            print(f"fleet record written to {args.fleet_out}")
+        if args.as_json:
+            print(json.dumps(results if len(fleet_sizes) > 1
+                             else results[str(fleet_sizes[0])],
+                             indent=2, sort_keys=True))
+        elif len(fleet_sizes) > 1:
+            sys.stdout.write(format_fleet_sweep(results))
+        else:
+            sys.stdout.write(format_summary(results[str(fleet_sizes[0])]))
+        if args.trace_out:
+            print(f"trace written to {args.trace_out}"
+                  + (".x<N>.jsonl per fleet size"
+                     if len(fleet_sizes) > 1 else "")
+                  + " (summarize: python tools/ds_trace_report.py "
+                    "<trace> --serve)")
+        return 0
 
     def write_mesh_record(results):
         record = mesh_record(results, {

@@ -82,6 +82,30 @@ class RecoveryConfig:
                         f"got {type(spec).__name__}")
 
 
+def snapshot_request(req) -> dict:
+    """One request as a plain-data recovery entry (``ServeRequest``
+    shape: rid/engine_rid/prompt/tokens/max_new_tokens/priority/tenant/
+    deadline_ms/prefix_id). ``engine_rid`` may be None for a request
+    that never reached an engine (still queued host-side) — the fleet
+    router snapshots those too when it migrates a dead replica's work,
+    and re-admission simply assigns a natural rid."""
+    return {
+        "rid": int(req.rid),
+        "engine_rid": (int(req.engine_rid)
+                       if req.engine_rid is not None else None),
+        "prompt": [int(t) for t in req.prompt],
+        "emitted": [int(t) for t in req.tokens],
+        "max_new_tokens": int(req.max_new_tokens),
+        "priority": int(req.priority),
+        "tenant": str(req.tenant),
+        "deadline_ms": (float(req.deadline_ms)
+                        if req.deadline_ms is not None else None),
+        "submit_t": float(req.submit_t),
+        "prefix_id": (int(req.prefix_id)
+                      if req.prefix_id is not None else None),
+    }
+
+
 class RecoveryLog:
     """Scheduler-visible snapshots of every RUNNING request, keyed by
     serving rid — exactly what engine loss would otherwise destroy.
@@ -101,23 +125,9 @@ class RecoveryLog:
         return rid in self._entries
 
     def admit(self, req):
-        """Record a request at engine handover (``ServeRequest`` shape:
-        rid/engine_rid/prompt/tokens/max_new_tokens/priority/tenant/
-        deadline_ms/prefix_id)."""
-        self._entries[req.rid] = {
-            "rid": int(req.rid),
-            "engine_rid": int(req.engine_rid),
-            "prompt": [int(t) for t in req.prompt],
-            "emitted": [int(t) for t in req.tokens],
-            "max_new_tokens": int(req.max_new_tokens),
-            "priority": int(req.priority),
-            "tenant": str(req.tenant),
-            "deadline_ms": (float(req.deadline_ms)
-                            if req.deadline_ms is not None else None),
-            "submit_t": float(req.submit_t),
-            "prefix_id": (int(req.prefix_id)
-                          if req.prefix_id is not None else None),
-        }
+        """Record a request at engine handover (see
+        :func:`snapshot_request` for the entry shape)."""
+        self._entries[req.rid] = snapshot_request(req)
 
     def extend(self, rid: int, tokens: List[int]):
         """Append tokens that surfaced for ``rid`` this tick (no-op for
@@ -133,8 +143,12 @@ class RecoveryLog:
 
     def entries(self) -> List[dict]:
         """Live entries in deterministic re-admission order (by engine
-        rid — the submission order of the lost engine)."""
-        return sorted(self._entries.values(), key=lambda e: e["engine_rid"])
+        rid — the submission order of the lost engine; queued-request
+        entries with no engine rid sort last, by serving rid)."""
+        return sorted(self._entries.values(),
+                      key=lambda e: ((0, e["engine_rid"])
+                                     if e["engine_rid"] is not None
+                                     else (1, e["rid"])))
 
     def snapshot(self) -> List[dict]:
         """Deep-copied plain-data view (safe to serialize/mutate)."""

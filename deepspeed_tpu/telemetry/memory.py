@@ -23,6 +23,7 @@ guessed. :func:`program_memory` additionally reads a compiled program's
 implements it — the per-program-family view of scratch.
 """
 
+import sys
 from typing import Dict, Optional
 
 import numpy as np
@@ -58,6 +59,11 @@ def device_memory_limit(override_bytes: int = 0) -> Optional[int]:
     no meaningful HBM limit unless the config declares one)."""
     if override_bytes:
         return int(override_bytes)
+    if "jax" not in sys.modules:
+        # no jax in this process means no live devices to ask — a
+        # host-only fleet router must not pay the jax import just to
+        # read a limit that cannot exist
+        return None
     try:
         import jax
 
@@ -71,6 +77,8 @@ def device_memory_limit(override_bytes: int = 0) -> Optional[int]:
 def device_bytes_in_use() -> Optional[int]:
     """Live allocator ``bytes_in_use`` on device 0, or None where the
     backend keeps no stats (CPU) — feeds the ``scratch`` residual."""
+    if "jax" not in sys.modules:
+        return None  # host-only process: no allocator, no import
     try:
         import jax
 

@@ -1,0 +1,209 @@
+"""A host-only ContinuousBatchingEngine stand-in for jax-free serving
+tests (router, policies, admission): same public surface the serving
+layer drives, with a deterministic pure-function token stream that gives
+REAL bitwise-resume semantics — token i of request rid is
+``(rid * 1000003 + i * 101) % vocab`` regardless of which engine
+instance emits it, exactly the property ``fold_in(fold_in(key, rid),
+i)`` gives the real engine. So ``submit(rid=, gen_base=)`` resume, rid
+partitioning, and cross-replica migration are all testable for
+bitwise identity in milliseconds, no jax import anywhere."""
+
+from collections import deque
+from types import SimpleNamespace
+
+import numpy as np
+
+
+def fake_token(rid: int, index: int, vocab: int) -> int:
+    """The deterministic per-(rid, token-index) stream."""
+    return (rid * 1000003 + index * 101) % vocab
+
+
+class FakeEngine:
+    """Mirrors the ``ContinuousBatchingEngine`` surface ``ServingEngine``
+    uses: one pool, one token per request per tick, results keyed by
+    engine rid. Fault knobs: ``fail_next_step`` raises a clean error
+    before any mutation; ``poison_next_step`` raises mid-tick and marks
+    the engine poisoned (the unrecoverable shape)."""
+
+    def __init__(self, vocab_size: int = 101, cache_len: int = 64,
+                 slots: int = 4):
+        self.cfg = SimpleNamespace(vocab_size=vocab_size,
+                                   max_seq_len=cache_len)
+        self.cache_len = cache_len
+        self.slots = slots
+        self.pipeline_depth = 1
+        self.fetch_timeout_s = None
+        self.poisoned = False
+        self.fault_hook = None
+        self.request_event_hook = None
+        self.fail_next_step = 0        # clean failures to raise
+        self.poison_next_step = False  # poison on the next tick
+        self._eng = SimpleNamespace(telemetry=_DisabledTelemetry())
+        self._next_rid = 0
+        self._pending = []             # admitted next tick
+        self._active = {}              # rid -> state dict
+        self._results = {}             # rid -> full token array
+        self._inflight = deque()
+        self._tick_index = 0
+        self._stats = {"ticks": 0, "steps": 0, "dispatch_ms": 0.0,
+                       "block_ms": 0.0, "tokens": 0, "wasted": 0,
+                       "capacity_tokens": 0}
+        self._prefixes = {}
+        self._next_pid = 0
+
+    # -- admission ------------------------------------------------------
+    def validate_request(self, prompt_ids, max_new_tokens: int):
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds cache_len {self.cache_len}")
+        return prompt
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32, *,
+               rid=None, gen_base: int = 0) -> int:
+        prompt = self.validate_request(prompt_ids, max_new_tokens)
+        if rid is None:
+            rid = self._next_rid
+        else:
+            rid = int(rid)
+            if rid in self._active or rid in self._results or any(
+                    r["rid"] == rid for r in self._pending):
+                raise ValueError(f"rid {rid} already in use")
+        self._next_rid = max(self._next_rid, rid + 1)
+        self._pending.append({"rid": rid, "prompt": prompt,
+                              "max_new": int(max_new_tokens),
+                              "gen_base": int(gen_base), "emitted": []})
+        return rid
+
+    def register_prefix(self, prefix_ids) -> int:
+        pid = self._next_pid
+        self._next_pid += 1
+        self._prefixes[pid] = np.asarray(prefix_ids, np.int32).reshape(-1)
+        return pid
+
+    def unregister_prefix(self, pid: int):
+        self._prefixes.pop(pid, None)
+
+    def submit_with_prefix(self, pid: int, suffix, max_new_tokens: int) -> int:
+        full = np.concatenate([self._prefixes[pid],
+                               np.asarray(suffix, np.int32).reshape(-1)])
+        return self.submit(full, max_new_tokens)
+
+    # -- the tick -------------------------------------------------------
+    def pool_state(self):
+        return [{"length": self.cache_len, "slots": self.slots,
+                 "free": self.slots - len(self._active)}]
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or bool(self._active)
+
+    def step(self):
+        if self.fault_hook is not None:
+            self.fault_hook("dispatch", {"tick": self._tick_index})
+        self._tick_index += 1
+        if self.fail_next_step > 0:
+            self.fail_next_step -= 1
+            raise RuntimeError("injected clean tick failure")
+        if self.poison_next_step:
+            self.poison_next_step = False
+            self.poisoned = True
+            raise RuntimeError("injected poisoned tick failure")
+        # admit everything placeable, submission order
+        still = []
+        for req in self._pending:
+            if len(self._active) < self.slots:
+                self._active[req["rid"]] = req
+            else:
+                still.append(req)
+        self._pending = still
+        out = {}
+        finished = []
+        for rid, req in self._active.items():
+            idx = req["gen_base"] + len(req["emitted"])
+            tok = fake_token(rid, idx, self.cfg.vocab_size)
+            req["emitted"].append(tok)
+            out[rid] = [tok]
+            if len(req["emitted"]) + req["gen_base"] >= req["max_new"] \
+                    + req["gen_base"] and \
+                    len(req["emitted"]) >= req["max_new"]:
+                finished.append(rid)
+        for rid in finished:
+            req = self._active.pop(rid)
+            self._results[rid] = np.concatenate(
+                [req["prompt"], np.asarray(req["emitted"], np.int32)])
+            self._emit_request_event(rid, req)
+        self._stats["ticks"] += 1
+        self._stats["steps"] += 1
+        self._stats["tokens"] += sum(len(t) for t in out.values())
+        self._stats["capacity_tokens"] += self.slots
+        return out
+
+    def _emit_request_event(self, rid: int, req: dict):
+        tele = self._eng.telemetry
+        if not getattr(tele, "enabled", False):
+            return
+        event = {"request": int(rid), "path": "continuous", "batch": 1,
+                 "prompt_tokens": int(req["prompt"].size),
+                 "new_tokens": len(req["emitted"])}
+        if self.request_event_hook is not None:
+            enriched = self.request_event_hook(rid, event)
+            if enriched is not None:
+                event = enriched
+        tele.emit("inference_request", event)
+
+    def finished(self):
+        done, self._results = self._results, {}
+        return done
+
+    def cancel(self, rid: int) -> bool:
+        if rid in self._active:
+            self._active.pop(rid)
+            return True
+        n = len(self._pending)
+        self._pending = [r for r in self._pending if r["rid"] != rid]
+        return len(self._pending) < n
+
+    def abort_inflight(self) -> int:
+        return 0
+
+    # -- accounting -----------------------------------------------------
+    def tick_stats(self) -> dict:
+        s = dict(self._stats)
+        s["pipeline_depth"] = self.pipeline_depth
+        s["mean_emitted_per_tick"] = (round(s["tokens"] / s["ticks"], 3)
+                                      if s["ticks"] else 0.0)
+        s["block_ms_per_token"] = (round(s["block_ms"] / s["tokens"], 4)
+                                   if s["tokens"] else None)
+        host = s["dispatch_ms"] + s["block_ms"]
+        s["overlap_frac"] = (round(1.0 - s["block_ms"] / host, 4)
+                             if host > 0 else None)
+        return s
+
+    def hbm_components(self) -> dict:
+        return {"params": 0, "kv_cache": 0}
+
+    def memory_snapshot(self, reason: str):
+        return None
+
+
+class _DisabledTelemetry:
+    """The inert hub shape a telemetry-off engine carries."""
+
+    enabled = False
+
+    def __init__(self):
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+
+    def emit(self, kind, payload, **kw):
+        return None
+
+    def close(self):
+        pass

@@ -1,0 +1,187 @@
+"""RecoveryLog + snapshot_request unit tests — jax-free (FakeEngine),
+part of the fast pre-tier-1 CI stage (tools/ci_jaxfree_tests.py).
+
+The load-bearing case is the CROSS-PROCESS round trip: a subprocess
+drives a serving engine mid-stream, writes its RecoveryLog as JSONL, and
+exits; the parent restores the log onto a FRESH engine in THIS process
+and the resumed streams are bitwise the reference run's. That is the
+fleet-recovery story end to end: nothing about resume depends on
+in-process state."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine, fake_token  # noqa: E402
+
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.recovery import RecoveryLog, snapshot_request
+from deepspeed_tpu.serving.request import ServeRequest
+
+VOCAB = 997
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                         "..", "..", ".."))
+
+
+def _req(rid, prompt, max_new=6, engine_rid=None, tokens=(),
+         **kw) -> ServeRequest:
+    req = ServeRequest(rid=rid, prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=max_new, **kw)
+    req.engine_rid = engine_rid
+    req.tokens.extend(tokens)
+    return req
+
+
+class TestSnapshotRequest:
+    def test_shape_and_plain_data(self):
+        req = _req(3, [1, 2, 3], max_new=8, engine_rid=7, tokens=[9, 10],
+                   priority=2, tenant="a", deadline_ms=500.0)
+        req.submit_t = 1.5
+        entry = snapshot_request(req)
+        assert entry == {
+            "rid": 3, "engine_rid": 7, "prompt": [1, 2, 3],
+            "emitted": [9, 10], "max_new_tokens": 8, "priority": 2,
+            "tenant": "a", "deadline_ms": 500.0, "submit_t": 1.5,
+            "prefix_id": None,
+        }
+        # JSON-serializable as-is (no numpy scalars leak through)
+        json.dumps(entry)
+
+    def test_queued_request_has_no_engine_rid(self):
+        entry = snapshot_request(_req(1, [4, 5]))
+        assert entry["engine_rid"] is None
+        assert entry["emitted"] == []
+
+
+class TestRecoveryLog:
+    def test_admit_extend_retire(self):
+        log = RecoveryLog()
+        log.admit(_req(0, [1], engine_rid=0))
+        log.admit(_req(1, [2], engine_rid=1))
+        log.extend(0, [11, 12])
+        log.extend(99, [13])  # untracked rid: no-op
+        assert len(log) == 2 and 0 in log and 99 not in log
+        assert log.entries()[0]["emitted"] == [11, 12]
+        log.retire(0)
+        log.retire(0)  # idempotent
+        assert len(log) == 1 and 0 not in log
+
+    def test_entries_order_queued_last(self):
+        # running entries by engine rid (the lost engine's submission
+        # order), then queued ones (engine_rid None) by serving rid
+        log = RecoveryLog()
+        log.admit(_req(5, [1]))                   # queued
+        log.admit(_req(2, [1], engine_rid=9))
+        log.admit(_req(3, [1], engine_rid=4))
+        log.admit(_req(4, [1]))                   # queued
+        assert [e["rid"] for e in log.entries()] == [3, 2, 4, 5]
+
+    def test_snapshot_is_deep_copy(self):
+        log = RecoveryLog()
+        log.admit(_req(0, [1], engine_rid=0, tokens=[7]))
+        snap = log.snapshot()
+        snap[0]["emitted"].append(999)
+        assert log.entries()[0]["emitted"] == [7]
+
+    def test_jsonl_round_trip(self, tmp_path):
+        log = RecoveryLog()
+        log.admit(_req(0, [1, 2], engine_rid=0, tokens=[3],
+                       priority=1, tenant="t", deadline_ms=100.0))
+        log.admit(_req(1, [4]))
+        path = str(tmp_path / "recovery.jsonl")
+        log.to_jsonl(path)
+        restored = RecoveryLog.from_jsonl(path)
+        assert restored.entries() == log.entries()
+
+
+# the subprocess half of the cross-process round trip: drive an engine
+# mid-stream, dump its RecoveryLog, and print the reference (fault-free)
+# results for the same submissions. Stubs the jax-heavy package inits so
+# the child interpreter starts in milliseconds.
+_CHILD = """
+import json, sys, types
+
+def _stub(name, path):
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [path]
+    sys.modules[name] = pkg
+
+repo, test_dir, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+_stub("deepspeed_tpu", repo + "/deepspeed_tpu")
+_stub("deepspeed_tpu.utils", repo + "/deepspeed_tpu/utils")
+_stub("deepspeed_tpu.telemetry", repo + "/deepspeed_tpu/telemetry")
+sys.path.insert(0, test_dir)
+
+from fake_engine import FakeEngine
+from deepspeed_tpu.serving.engine import ServingEngine
+
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+MAX_NEW = [6, 5, 7]
+
+def submit_all(srv):
+    return [srv.submit(p, m) for p, m in zip(PROMPTS, MAX_NEW)]
+
+# the interrupted run: 3 ticks in, then "process loss" (just exit)
+srv = ServingEngine(FakeEngine(vocab_size=997, slots=4))
+submit_all(srv)
+for _ in range(3):
+    srv.step()
+srv._recovery_log.to_jsonl(out_path)
+
+# the reference run: identical submissions, no interruption
+ref = ServingEngine(FakeEngine(vocab_size=997, slots=4))
+adms = submit_all(ref)
+for _ in range(50):
+    if not ref.has_work():
+        break
+    ref.step()
+reference = {}
+for rid, req in ref.reap().items():
+    reference[str(req.engine_rid)] = [int(t) for t in req.result]
+print(json.dumps(reference))
+"""
+
+
+@pytest.mark.parametrize("fresh_vocab", [997])
+def test_cross_process_round_trip(tmp_path, fresh_vocab):
+    """Subprocess writes the log mid-stream; the parent restores onto a
+    fresh engine and every stream finishes bitwise-identical to the
+    subprocess's own fault-free reference run."""
+    out_path = str(tmp_path / "recovery.jsonl")
+    test_dir = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, REPO_ROOT, test_dir, out_path],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    reference = json.loads(proc.stdout)
+    assert len(reference) == 3
+
+    log = RecoveryLog.from_jsonl(out_path)
+    entries = log.entries()
+    assert len(entries) == 3
+    assert all(len(e["emitted"]) == 3 for e in entries)  # 3 ticks ran
+
+    fresh = ServingEngine(FakeEngine(vocab_size=fresh_vocab, slots=4))
+    for entry in entries:
+        adm = fresh.readmit(entry)
+        assert adm
+    for _ in range(50):
+        if not fresh.has_work():
+            break
+        fresh.step()
+    resumed = {str(req.engine_rid): [int(t) for t in req.result]
+               for req in fresh.reap().values()}
+    assert resumed == reference
+    # and the streams really are the pinned-rid deterministic ones
+    for entry in entries:
+        erid = entry["engine_rid"]
+        n_prompt = len(entry["prompt"])
+        full = reference[str(erid)]
+        gen = full[n_prompt:]
+        assert gen == [fake_token(erid, i, 997) for i in range(len(gen))]

@@ -1,0 +1,521 @@
+"""FleetRouter unit tests — jax-free (FakeEngine), part of the fast
+pre-tier-1 CI stage (tools/ci_jaxfree_tests.py).
+
+The FakeEngine's token stream is the same pure function of
+``(engine_rid, token_index)`` the real engine's folded RNG gives, so
+"resumes bitwise on a survivor" is a literal equality check here:
+whatever replica a request lands on, its generated tokens must equal
+``[fake_token(erid, i) for i in range(max_new)]`` for the engine rid its
+FIRST placement pinned."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine, fake_token  # noqa: E402
+
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.fleet import (
+    DEAD,
+    DRAINED,
+    FAILED,
+    HEALTHY,
+    RECOVERING,
+    RID_STRIDE,
+    ReplicaTelemetry,
+    ScopedRegistry,
+    attach_replica_telemetry,
+)
+from deepspeed_tpu.serving.router import FleetRouter
+from deepspeed_tpu.serving.request import CANCELLED, FINISHED, SHED
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+VOCAB = 997
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class HubStub:
+    """Minimal enabled telemetry hub: captures events, shares a registry."""
+
+    def __init__(self):
+        self.enabled = True
+        self.registry = MetricsRegistry()
+        self.events = []
+        self.closed = 0
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+    def close(self):
+        self.closed += 1
+
+    def of_kind(self, kind, event=None):
+        return [p for k, p in self.events
+                if k == kind and (event is None or p.get("event") == event)]
+
+
+def expected(erid, n, start=0):
+    return [fake_token(erid, i, VOCAB) for i in range(start, start + n)]
+
+
+def make_fleet(n=2, clock=None, slots=2, kv_budget=None, cache_len=64,
+               telemetry=None):
+    clock = clock or FakeClock()
+
+    def factory(replica_id):
+        kw = {} if kv_budget is None else {"kv_budget_tokens": kv_budget}
+        return ServingEngine(
+            FakeEngine(vocab_size=VOCAB, cache_len=cache_len, slots=slots),
+            clock=clock, **kw)
+
+    router = FleetRouter(factory, replicas=n, clock=clock,
+                         telemetry=telemetry)
+    return router, clock
+
+
+def run_fleet(router, clock, max_ticks=300, dt=0.01, until=None):
+    n = 0
+    while router.has_work() or (until is not None and not until()):
+        assert n < max_ticks, "fleet did not converge"
+        router.step()
+        clock.advance(dt)
+        n += 1
+    return n
+
+
+class TestRouting:
+    def test_single_replica_bitwise_and_conservation(self):
+        router, clock = make_fleet(1, slots=2)
+        prompts = [np.arange(1, 5), np.arange(1, 6), np.arange(1, 7)]
+        adms = [router.submit(p, max_new_tokens=6) for p in prompts]
+        assert all(adms)
+        run_fleet(router, clock)
+        # slot 0 keeps engine-rid base 0: submission order pins rids 0..2
+        for erid, (adm, p) in enumerate(zip(adms, prompts)):
+            res = router.result(adm.rid)
+            np.testing.assert_array_equal(res[:p.size], p)
+            assert list(res[p.size:]) == expected(erid, 6)
+        st = router.statusz()
+        assert st["submitted"] == 3 and st["admitted"] == 3
+        assert st["shed"] == 0 and st["lost"] == 0
+        assert st["health"] == "ok"
+
+    def test_least_loaded_placement(self):
+        router, clock = make_fleet(2, slots=2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=6)
+        b = router.submit(np.arange(1, 5), max_new_tokens=6)
+        assert a and b
+        st = router.statusz()["replicas"]
+        # first lands on r0 (tie broken by slot), second on the now-
+        # emptier r1
+        assert st["r0"]["admitted"] == 1
+        assert st["r1"]["admitted"] == 1
+
+    def test_spillover_when_least_loaded_would_shed(self):
+        clock = FakeClock()
+        hub = HubStub()
+        budgets = {"r0": 12, "r1": 1000}
+
+        def factory(replica_id):
+            return ServingEngine(
+                FakeEngine(vocab_size=VOCAB, cache_len=64, slots=2),
+                clock=clock, kv_budget_tokens=budgets[replica_id])
+
+        router = FleetRouter(factory, replicas=2, clock=clock,
+                             telemetry=hub)
+        # need 20 > r0's 12-token budget: r0 (least loaded, slot tie)
+        # would shed, the verdict spills to r1
+        adm = router.submit(np.arange(1, 11), max_new_tokens=10)
+        assert adm
+        st = router.statusz()
+        assert st["spillovers"] == 1
+        assert st["replicas"]["r1"]["admitted"] == 1
+        spill = hub.of_kind("router_event", "spillover")
+        assert spill and spill[0]["from_replica"] == "r0" \
+            and spill[0]["replica"] == "r1"
+        route = hub.of_kind("router_event", "route")
+        assert route[0]["attempts"] == 2
+
+    def test_shed_hint_backs_replica_off(self):
+        router, clock = make_fleet(1, slots=2, kv_budget=30)
+        a = router.submit(np.arange(1, 6), max_new_tokens=5)   # need 10
+        assert a
+        run_fleet(router, clock)                # completion rate observed
+        router.result(a.rid)
+        # hold 20 of the 30-token budget, then ask for 12 more
+        hold = router.submit(np.arange(1, 11), max_new_tokens=10)
+        assert hold
+        b = router.submit(np.arange(1, 5), max_new_tokens=8)   # need 12
+        assert not b and b.reason == "kv_budget"
+        assert b.retry_after_s is not None and b.retry_after_s > 0
+        # the hint backed r0 off: the fleet has nobody to even ask
+        c = router.submit(np.arange(1, 3), max_new_tokens=2)
+        assert not c and c.reason == "no_replicas"
+        assert c.retry_after_s is not None
+        clock.advance(b.retry_after_s + 0.001)
+        d = router.submit(np.arange(1, 3), max_new_tokens=2)
+        assert d
+
+    def test_all_dead_sheds_no_replicas(self):
+        router, clock = make_fleet(1)
+        router.kill("r0")
+        adm = router.submit(np.arange(1, 4), max_new_tokens=4)
+        assert not adm and adm.reason == "no_replicas"
+        assert adm.retry_after_s is None
+        assert router.health() == "dead"
+
+
+class TestFailover:
+    def test_kill_migrates_running_stream_bitwise(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, slots=2, telemetry=hub)
+        a = router.submit(np.arange(1, 5), max_new_tokens=8)   # r0, erid 0
+        b = router.submit(np.arange(1, 5), max_new_tokens=8)   # r1
+        for _ in range(3):
+            router.step()
+            clock.advance(0.01)
+        router.kill("r0")
+        run_fleet(router, clock)
+        res_a = router.result(a.rid)
+        assert list(res_a[4:]) == expected(0, 8)               # bitwise
+        res_b = router.result(b.rid)
+        assert list(res_b[4:]) == expected(RID_STRIDE, 8)
+        st = router.statusz()
+        assert st["migrated"] == 1 and st["lost"] == 0
+        assert st["replica_deaths"] == 1
+        assert st["replicas"]["r0"]["state"] == DEAD
+        assert st["replicas"]["r0"]["migrated_out"] == 1
+        assert st["replicas"]["r1"]["migrated_in"] == 1
+        mig = hub.of_kind("router_event", "migrated")
+        assert mig and mig[0]["from_replica"] == "r0" \
+            and mig[0]["to_replica"] == "r1" \
+            and mig[0]["tokens_emitted"] == 3 == mig[0]["gen_base"]
+
+    def test_queued_request_migrates_with_fresh_rid(self):
+        router, clock = make_fleet(1, slots=1)
+        a = router.submit(np.arange(1, 4), max_new_tokens=6)   # running
+        b = router.submit(np.arange(1, 4), max_new_tokens=6)   # queued
+        assert a and b
+        router.step()
+        router.add()                                           # r1, slot 1
+        router.kill("r0")
+        run_fleet(router, clock)
+        # a resumes its pinned rid-0 stream on r1; b never reached r0's
+        # engine, so it starts fresh under r1's own partition
+        assert list(router.result(a.rid)[3:]) == expected(0, 6)
+        assert list(router.result(b.rid)[3:]) == expected(RID_STRIDE, 6)
+        assert router.statusz()["migrated"] == 2
+
+    def test_unplaceable_requests_shed_honestly(self):
+        clock = FakeClock()
+        budgets = {"r0": 1000, "r1": 12}
+
+        def factory(replica_id):
+            return ServingEngine(
+                FakeEngine(vocab_size=VOCAB, cache_len=64, slots=2),
+                clock=clock, kv_budget_tokens=budgets[replica_id])
+
+        router = FleetRouter(factory, replicas=2, clock=clock)
+        adm = router.submit(np.arange(1, 11), max_new_tokens=10)  # need 20
+        assert adm
+        router.step()
+        router.kill("r0")     # survivor's budget can never hold need 20
+        reaped = router.reap()
+        assert reaped[adm.rid].state == SHED
+        st = router.statusz()
+        assert st["lost"] == 1 and st["migrated"] == 0
+        # conservation: admitted == finished + shed (+ expired/cancelled)
+        assert st["admitted"] == 1
+
+    def test_step_exception_evicts_and_migrates(self):
+        router, clock = make_fleet(2, slots=2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=6)
+        b = router.submit(np.arange(1, 5), max_new_tokens=6)
+        router.step()
+        clock.advance(0.01)
+        router._replicas["r0"].serving._cb.poison_next_step = True
+        router.step()          # r0's tick raises -> evicted mid-step
+        assert router._replicas["r0"].state == DEAD
+        run_fleet(router, clock)
+        assert list(router.result(a.rid)[4:]) == expected(0, 6)
+        assert list(router.result(b.rid)[4:]) == expected(RID_STRIDE, 6)
+
+    def test_stream_survives_migration(self):
+        router, clock = make_fleet(2, slots=2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=8)
+        router.at_tick(4, lambda rt: rt.kill("r0"))
+        toks = list(router.stream(a.rid))
+        assert toks == expected(0, 8)          # bitwise through the kill
+        assert router.statusz()["replicas"]["r0"]["state"] == DEAD
+
+
+class TestHealthLadder:
+    def test_probe_marks_recovering_and_back(self):
+        router, clock = make_fleet(2)
+        rep = router._replicas["r0"]
+        rep.serving._breaker_open = True       # PR 7 circuit breaker open
+        router.probe()
+        assert rep.state == RECOVERING
+        # not placeable while recovering: both submits land on r1
+        for _ in range(2):
+            assert router.submit(np.arange(1, 4), max_new_tokens=4)
+        assert router.statusz()["replicas"]["r1"]["admitted"] == 2
+        rep.serving._breaker_open = False
+        router.probe()
+        assert rep.state == HEALTHY
+
+    def test_probe_marks_poisoned_failed_then_evicted(self):
+        router, clock = make_fleet(2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=6)   # r0
+        router.step()
+        clock.advance(0.01)
+        router._replicas["r0"].serving._cb.poisoned = True
+        router.probe()
+        assert router._replicas["r0"].state == FAILED
+        router.step()                          # eviction + migration
+        assert router._replicas["r0"].state == DEAD
+        run_fleet(router, clock)
+        assert list(router.result(a.rid)[4:]) == expected(0, 6)
+
+    def test_probe_thread_smoke(self):
+        router, clock = make_fleet(1)
+        t = router.start_probe(interval_s=0.01)
+        assert router.start_probe() is t       # idempotent
+        time.sleep(0.05)
+        router.stop_probe()
+        assert router._probe_thread is None
+        router.close()
+
+    def test_fleet_health_words(self):
+        router, clock = make_fleet(2)
+        assert router.health() == "ok"
+        router.drain("r0")
+        assert router.health() == "ok"         # r1 still takes traffic
+        router.drain("r1")
+        assert router.health() == "draining"
+        router.step()                          # both dry -> retired
+        assert router.health() == "dead"
+
+
+class TestDrainAndRolling:
+    def test_drain_retires_with_zero_loss(self):
+        router, clock = make_fleet(2, slots=2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=6)   # r0
+        router.drain("r0")
+        st = router.statusz()["replicas"]["r0"]["statusz"]
+        assert st["draining"] is True and st["residue_running"] == 1
+        assert st["residue_tokens"] == 6
+        b = router.submit(np.arange(1, 5), max_new_tokens=6)   # spills: r1
+        assert b
+        run_fleet(router, clock)
+        assert router.statusz()["replicas"]["r0"]["state"] == DRAINED
+        # drained replica's results still reachable through the fleet
+        assert list(router.result(a.rid)[4:]) == expected(0, 6)
+        assert list(router.result(b.rid)[4:]) == expected(RID_STRIDE, 6)
+        assert router.statusz()["lost"] == 0
+
+    def test_rolling_restart_zero_loss_under_load(self):
+        router, clock = make_fleet(2, slots=2)
+        adms = [router.submit(np.arange(1, 6), max_new_tokens=6)
+                for _ in range(4)]
+        assert all(adms)
+        router.rolling_restart()
+        mid = {}
+
+        def submit_mid(rt):
+            mid["adm"] = rt.submit(np.arange(1, 6), max_new_tokens=4)
+
+        router.at_tick(3, submit_mid)
+        run_fleet(router, clock, until=lambda: router._rolling is None)
+        assert router._rolling is None
+        assert mid["adm"]                      # admitted mid-restart
+        for adm in adms:
+            assert len(router.result(adm.rid)) == 5 + 6
+        assert len(router.result(mid["adm"].rid)) == 5 + 4
+        st = router.statusz()
+        assert st["lost"] == 0 and st["replica_deaths"] == 0
+        states = {rid: info["state"] for rid, info in st["replicas"].items()}
+        assert states["r0"] == DRAINED and states["r1"] == DRAINED
+        assert states["r2"] == HEALTHY and states["r3"] == HEALTHY
+
+
+class TestRequestSurface:
+    def test_cancel_and_errors(self):
+        router, clock = make_fleet(1, slots=1)
+        a = router.submit(np.arange(1, 4), max_new_tokens=4)
+        b = router.submit(np.arange(1, 4), max_new_tokens=4)   # queued
+        assert router.cancel(b.rid) is True
+        assert router.cancel(b.rid) is False   # already terminal
+        assert router.cancel(12345) is False
+        run_fleet(router, clock)
+        reaped = router.reap()
+        assert reaped[b.rid].state == CANCELLED
+        assert reaped[a.rid].state == FINISHED  # reap pops finished too
+        assert reaped[a.rid].result is not None
+        with pytest.raises(KeyError):
+            router.result(a.rid)               # reaped already
+        with pytest.raises(KeyError):
+            router.stream(99999)
+
+    def test_statusz_and_aggregates(self):
+        router, clock = make_fleet(2, slots=2)
+        a = router.submit(np.arange(1, 5), max_new_tokens=6)
+        assert router.vocab_size == VOCAB
+        assert router.committed_tokens() == 4 + 6
+        run_fleet(router, clock)
+        ts = router.tick_stats()
+        assert ts["ticks"] > 0 and ts["tokens"] == 6
+        assert 0.0 <= ts["utilization"] <= 1.0
+        rs = router.recovery_stats()
+        assert rs["fleet_migrated"] == 0 and rs["fleet_replica_deaths"] == 0
+        router.result(a.rid)
+
+    def test_fleet_counters_and_events_with_hub(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        a = router.submit(np.arange(1, 5), max_new_tokens=4)
+        run_fleet(router, clock)
+        router.result(a.rid)
+        router.kill("r1")
+        router.close()
+        dump = hub.registry.dump()
+        assert dump["counters"]["fleet_submitted_total"] == 1
+        assert dump["counters"]["fleet_admitted_total"] == 1
+        assert dump["counters"]["fleet_replica_deaths_total"] == 1
+        assert "fleet_replicas" in dump["gauges"]
+        assert hub.of_kind("router_event", "route")
+        assert hub.of_kind("router_event", "replica_added")
+        assert hub.of_kind("router_event", "kill")
+        assert hub.closed == 1                 # base hub closed ONCE
+        router.close()                         # idempotent
+        assert hub.closed == 1
+
+
+class TestEngineFleetSurface:
+    """The ServingEngine fleet-membership APIs the router drives."""
+
+    def _srv(self, clock=None, slots=2, **kw):
+        return ServingEngine(FakeEngine(vocab_size=VOCAB, slots=slots),
+                             clock=clock or FakeClock(), **kw)
+
+    def test_admission_outlook_has_no_side_effects(self):
+        srv = self._srv()
+        assert srv.admission_outlook(10) == ("admitted", "")
+        assert srv.queue_depth() == 0 and srv.committed_tokens() == 0
+        assert not srv.has_work()
+        srv.drain()
+        assert srv.admission_outlook(10) == ("shed", "draining")
+        srv.resume()
+        srv.kv_budget_tokens = 8
+        assert srv.admission_outlook(10) == ("shed", "kv_budget")
+
+    def test_readmit_fully_emitted_entry_synthesizes_finish(self):
+        srv = self._srv()
+        entry = {"rid": 0, "engine_rid": 5, "prompt": [1, 2],
+                 "emitted": [7, 8, 9], "max_new_tokens": 3, "priority": 0,
+                 "tenant": "default", "deadline_ms": None, "submit_t": 0.0,
+                 "prefix_id": None}
+        adm = srv.readmit(entry)
+        assert adm and adm.status == "admitted"
+        req = srv.reap()[adm.rid]
+        assert req.state == FINISHED
+        assert list(req.result) == [1, 2, 7, 8, 9]
+
+    def test_readmit_over_budget_raises(self):
+        srv = self._srv(kv_budget_tokens=10)
+        entry = {"rid": 0, "engine_rid": None, "prompt": [1] * 8,
+                 "emitted": [], "max_new_tokens": 8, "priority": 0,
+                 "tenant": "default", "deadline_ms": None, "submit_t": 0.0,
+                 "prefix_id": None}
+        with pytest.raises(ValueError):
+            srv.readmit(entry)
+
+    def test_readmit_rid_collision_leaves_no_state(self):
+        srv = self._srv()
+        adm = srv.submit(np.arange(1, 4), max_new_tokens=4)
+        srv.step()                              # engine rid 0 is live
+        entry = {"rid": 9, "engine_rid": 0, "prompt": [1, 2],
+                 "emitted": [3], "max_new_tokens": 4, "priority": 0,
+                 "tenant": "default", "deadline_ms": None, "submit_t": 0.0,
+                 "prefix_id": None}
+        with pytest.raises(ValueError):
+            srv.readmit(entry)
+        assert len(srv.recovery_snapshot()) == 1   # only the original
+        assert srv.request(adm.rid) is not None
+
+    def test_release_detaches_without_accounting(self):
+        srv = self._srv()
+        adm = srv.submit(np.arange(1, 4), max_new_tokens=4)
+        srv.step()
+        req = srv.release(adm.rid)
+        assert req is not None and req.state == "running"
+        assert srv.request(adm.rid) is None
+        assert srv.committed_tokens() == 0
+        assert srv.recovery_snapshot() == []
+        assert srv.release(adm.rid) is None     # gone already
+        assert not srv.has_work()
+
+    def test_abandon_marks_lost_as_shed(self):
+        srv = self._srv()
+        a = srv.submit(np.arange(1, 4), max_new_tokens=4)
+        srv.step()
+        lost = srv.abandon("replica r9 lost: test")
+        assert set(lost) == {a.rid}
+        assert srv.reap()[a.rid].state == SHED
+
+    def test_set_rid_base_partitions_namespace(self):
+        srv = self._srv()
+        srv.set_rid_base(3 * RID_STRIDE)
+        adm = srv.submit(np.arange(1, 4), max_new_tokens=2)
+        srv.step()
+        assert srv.request(adm.rid).engine_rid == 3 * RID_STRIDE
+
+
+class TestReplicaTelemetry:
+    def test_scoped_registry_labels(self):
+        base = MetricsRegistry()
+        scoped = ScopedRegistry(base, "r3")
+        scoped.counter("serve_finished_total").inc()
+        scoped.gauge("serve_queue_depth", {"pool": "a"}).set(2)
+        dump = base.dump()
+        assert dump["counters"]["serve_finished_total{replica=r3}"] == 1
+        key = next(k for k in dump["gauges"] if "pool=a" in k)
+        assert "replica=r3" in key
+
+    def test_replica_telemetry_tags_events(self):
+        hub = HubStub()
+        tele = ReplicaTelemetry(hub, "r1")
+        assert tele.enabled is True
+        tele.emit("serving_event", {"event": "shed", "reason": "kv_budget"})
+        kind, payload = hub.events[0]
+        assert kind == "serving_event" and payload["replica"] == "r1"
+        tele.close()                            # facade no-op
+        assert hub.closed == 0
+
+    def test_attach_replica_telemetry(self):
+        hub = HubStub()
+        eng = FakeEngine(vocab_size=VOCAB)
+        attach_replica_telemetry(eng, hub, "r0")
+        srv = ServingEngine(eng, clock=FakeClock())
+        adm = srv.submit(np.arange(1, 4), max_new_tokens=3)
+        for _ in range(10):
+            if not srv.has_work():
+                break
+            srv.step()
+        reqs = [p for k, p in hub.events if k == "inference_request"]
+        assert reqs and reqs[0]["replica"] == "r0"
+        assert adm

@@ -1,0 +1,170 @@
+"""Shed-hint honesty: the retry_after_s a client sees in its Admission
+verdict is the SAME hint the trace records, and the two scorecards that
+bucket sheds by reason — ds_loadgen's in-process summary and
+ds_trace_report's event-stream reconstruction — agree on the same run.
+
+jax-free (FakeEngine), part of the fast pre-tier-1 CI stage
+(tools/ci_jaxfree_tests.py). Pinned semantics:
+
+- ``recovering`` sheds (circuit breaker open) are HINTED with the
+  breaker's remaining outage — wait here, the engine is coming back;
+- ``draining`` sheds are deliberately HINTLESS — the replica is being
+  retired, the client must go elsewhere, not wait;
+- whatever hint the Admission carried appears bit-identically in the
+  ``serving_event`` shed record (or is absent from both).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine  # noqa: E402
+
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.loadgen import run_load, summarize
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "..", "..", "tools")
+sys.path.insert(0, os.path.abspath(TOOLS))
+import ds_trace_report  # noqa: E402
+
+VOCAB = 997
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class HubStub:
+    def __init__(self):
+        self.enabled = True
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+    def close(self):
+        pass
+
+    def sheds(self):
+        return [p for k, p in self.events
+                if k == "serving_event" and p.get("event") == "shed"]
+
+    def as_trace(self):
+        """The events as ds_trace_report sees them after a JSONL round
+        trip: one dict per line with the ``kind`` discriminator."""
+        return [{"kind": k, **p} for k, p in self.events]
+
+
+def make_engine(hub=None, clock=None, **kw):
+    fake = FakeEngine(vocab_size=VOCAB, cache_len=64,
+                      slots=kw.pop("slots", 2))
+    if hub is not None:
+        fake._eng.telemetry = hub
+    return ServingEngine(fake, clock=clock or FakeClock(), **kw)
+
+
+class TestAdmissionEventAgreement:
+    def test_recovering_shed_hint_matches_event(self):
+        clock = FakeClock()
+        hub = HubStub()
+        srv = make_engine(hub, clock)
+        srv._breaker_open = True            # PR 7 circuit breaker open
+        srv._outage_start = clock()
+        adm = srv.submit(np.arange(1, 5), max_new_tokens=8)
+        assert not adm
+        assert adm.reason == "recovering"
+        assert adm.retry_after_s is not None and adm.retry_after_s > 0
+        (ev,) = hub.sheds()
+        assert ev["reason"] == "recovering"
+        assert ev["retry_after_s"] == adm.retry_after_s
+
+    def test_recovering_hint_is_remaining_outage_not_stale(self):
+        clock = FakeClock()
+        hub = HubStub()
+        srv = make_engine(hub, clock)
+        srv._breaker_open = True
+        srv._outage_start = clock()
+        first = srv.submit(np.arange(1, 5), max_new_tokens=8)
+        clock.advance(0.1)                  # outage partially elapsed
+        second = srv.submit(np.arange(1, 5), max_new_tokens=8)
+        assert second.retry_after_s <= first.retry_after_s
+        evs = hub.sheds()
+        assert [e["retry_after_s"] for e in evs] == [
+            first.retry_after_s, second.retry_after_s]
+
+    def test_draining_shed_is_hintless_in_both(self):
+        hub = HubStub()
+        srv = make_engine(hub)
+        srv.drain()
+        adm = srv.submit(np.arange(1, 5), max_new_tokens=8)
+        assert not adm
+        assert adm.reason == "draining"
+        assert adm.retry_after_s is None    # go elsewhere, don't wait
+        (ev,) = hub.sheds()
+        assert ev["reason"] == "draining"
+        assert "retry_after_s" not in ev
+
+    def test_cold_start_queue_full_hint_absent_from_both(self):
+        # with zero completions there is no drain rate to extrapolate
+        # from: no hint in the verdict, no field in the event
+        hub = HubStub()
+        srv = make_engine(hub, slots=1, max_queue_depth=1)
+        assert srv.submit(np.arange(1, 5), max_new_tokens=8)  # staged
+        assert srv.submit(np.arange(1, 5), max_new_tokens=8)  # queued
+        adm = srv.submit(np.arange(1, 5), max_new_tokens=8)
+        assert not adm and adm.reason == "queue_full"
+        assert adm.retry_after_s is None
+        (ev,) = hub.sheds()
+        assert "retry_after_s" not in ev
+
+
+class TestScorecardAgreement:
+    """ds_loadgen's in-process summary and ds_trace_report's
+    reconstruction from the serving_event stream must report the SAME
+    shed_by_reason table for one run — the contract both cite."""
+
+    def _run(self):
+        clock = FakeClock()
+        hub = HubStub()
+        srv = make_engine(hub, clock, slots=2, max_queue_depth=2)
+        # wave 1 (2 requests) finishes and establishes a completion
+        # rate; wave 2 (8 requests at once) overflows the depth-2 queue
+        # so its sheds carry rate-derived retry hints
+        workload = [{"prompt_tokens": 4, "max_new_tokens": 4}
+                    for _ in range(10)]
+        arrivals = [0.0, 0.0] + [1.0] * 8
+        records, wall_s = run_load(srv, workload, arrivals,
+                                   clock=clock, sleep=clock.advance)
+        return records, wall_s, hub
+
+    def test_shed_by_reason_tables_agree(self):
+        records, wall_s, hub = self._run()
+        summary = summarize(records, wall_s)
+        table = ds_trace_report.serve_table(hub.as_trace())
+        assert "shed_by_reason" in summary, summary
+        assert summary["shed_by_reason"] == table["shed_by_reason"]
+        qf = summary["shed_by_reason"]["queue_full"]
+        # wave 2: 2 staged into the free slots, 2 queued, 4 shed
+        assert qf["count"] == 4
+        # wave-2 sheds happen after wave 1 finished: every verdict is
+        # hinted, and the hints survived the event round trip
+        assert qf["with_hint"] == 4
+        assert qf["retry_after_s_mean"] > 0
+
+    def test_shed_counts_agree_with_lifecycle(self):
+        records, wall_s, hub = self._run()
+        summary = summarize(records, wall_s)
+        table = ds_trace_report.serve_table(hub.as_trace())
+        assert summary["outcomes"].get("shed", 0) == table["shed"] == 4
+        assert summary["outcomes"].get("finished", 0) == 6

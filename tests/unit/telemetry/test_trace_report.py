@@ -91,10 +91,31 @@ def test_serve_table():
     assert table["recovery_ms_p50"] == 42.5
     assert table["recovery_ms_max"] == 42.5
     assert table["outage_ms_total"] == 55.0
+    # honest-retry accounting per shed reason (loadgen's in-process
+    # summary computes the same table; the two must agree)
+    assert table["shed_by_reason"] == {
+        "queue_full": {"count": 1, "with_hint": 1,
+                       "retry_after_s_mean": 0.4}}
+    # fleet section from the router_event journal + replica tags: one
+    # kill (r0), its stream migrated to r1 mid-token, one spillover
+    fleet = table["fleet"]
+    assert fleet["router_events"] == 6
+    assert fleet["replica_deaths"] == 1 and fleet["lost"] == 0
+    assert fleet["migrated"] == 1 and fleet["spillovers"] == 1
+    assert fleet["replicas"]["r0"] == {
+        "admitted": 1, "finished": 0, "shed": 0, "good_tokens": 0,
+        "migrated_in": 0, "migrated_out": 1, "goodput_tok_s": 0.0}
+    r1 = fleet["replicas"]["r1"]
+    assert r1["admitted"] == 1 and r1["finished"] == 2
+    assert r1["shed"] == 2 and r1["migrated_in"] == 1
+    assert r1["good_tokens"] == 8
+    assert abs(r1["goodput_tok_s"] - 8 / 0.6) < 0.01
     text = ds_trace_report.format_serve_table(table)
     assert "serving summary" in text and "shed rate" in text
     assert "tick host" in text and "blocked/token" in text
     assert "recovery" in text and "rebuilds 1" in text
+    assert "shed reasons" in text and "queue_full=1" in text
+    assert "fleet" in text and "mig in/out" in text
     assert "UNRECOVERABLE" not in text
 
 

@@ -256,6 +256,75 @@ def serve_table(events):
         out["outage_ms_total"] = round(sum(
             float(e.get("outage_ms", 0.0)) for e in by_fault.get("breaker", [])
             if e.get("state") == "closed"), 3)
+    # honest-retry accounting per shed reason, from the event stream
+    # alone: MUST agree with what ds_loadgen's in-process summary reports
+    # for the same run (tests/unit/serving/test_shed_hints.py) — a shed
+    # verdict whose Admission carried retry_after_s carries the same hint
+    # in its serving_event record
+    reasons = {}
+    for e in by_event.get("shed", []):
+        d = reasons.setdefault(str(e.get("reason", "?")),
+                               {"count": 0, "with_hint": 0, "hints": []})
+        d["count"] += 1
+        ra = e.get("retry_after_s")
+        if isinstance(ra, (int, float)) and not isinstance(ra, bool):
+            d["with_hint"] += 1
+            d["hints"].append(float(ra))
+    if reasons:
+        out["shed_by_reason"] = {
+            k: {"count": v["count"], "with_hint": v["with_hint"],
+                "retry_after_s_mean": (round(sum(v["hints"]) / len(v["hints"]),
+                                             4) if v["hints"] else None)}
+            for k, v in sorted(reasons.items())}
+    # fleet section: router_event is the FleetRouter's journal (routing,
+    # spillover, migration, replica lifecycle) and every replica-scoped
+    # serving event carries a ``replica`` tag — together they yield the
+    # per-replica breakdown without any in-process state
+    routers = [e for e in events if e.get("kind") == "router_event"]
+    if routers:
+        per = {}
+
+        def _rep(rid):
+            return per.setdefault(str(rid), {
+                "admitted": 0, "finished": 0, "shed": 0, "good_tokens": 0,
+                "migrated_in": 0, "migrated_out": 0})
+
+        deaths = lost = migrated = spillovers = no_replica_sheds = 0
+        for e in routers:
+            ev = e.get("event")
+            if ev == "route":
+                _rep(e.get("replica"))["admitted"] += 1
+            elif ev == "spillover":
+                spillovers += 1
+            elif ev == "migrated":
+                _rep(e.get("to_replica"))["migrated_in"] += 1
+                _rep(e.get("from_replica"))["migrated_out"] += 1
+                migrated += 1
+            elif ev == "replica_dead":
+                deaths += 1
+                lost += int(e.get("lost", 0))
+            elif ev == "shed":
+                no_replica_sheds += 1
+        for e in lifecycle:
+            if (e.get("event") in ("shed", "expired")
+                    and e.get("replica") is not None):
+                _rep(e["replica"])["shed"] += 1
+        for e in finished:
+            if e.get("replica") is not None:
+                r = _rep(e["replica"])
+                r["finished"] += 1
+                if e.get("deadline_met", True) is True:
+                    r["good_tokens"] += int(e.get("new_tokens", 0))
+        if span > 0:
+            for r in per.values():
+                r["goodput_tok_s"] = round(r["good_tokens"] / span, 3)
+        out["fleet"] = {
+            "replicas": {k: per[k] for k in sorted(per)},
+            "router_events": len(routers),
+            "replica_deaths": deaths, "lost": lost,
+            "migrated": migrated, "spillovers": spillovers,
+            "no_replica_sheds": no_replica_sheds,
+        }
     return out
 
 
@@ -314,6 +383,29 @@ def format_serve_table(table):
         if table.get("unrecoverable"):
             lines.append(f"                  UNRECOVERABLE terminal "
                          f"failure(s): {table['unrecoverable']}")
+    if "shed_by_reason" in table:
+        parts = []
+        for reason, v in table["shed_by_reason"].items():
+            hint = (f" ~{_fmt(v['retry_after_s_mean'])}s"
+                    if v["retry_after_s_mean"] is not None else "")
+            parts.append(f"{reason}={v['count']} "
+                         f"({v['with_hint']} hinted{hint})")
+        lines.append(f"shed reasons      {'   '.join(parts)}")
+    fleet = table.get("fleet")
+    if fleet:
+        lines.append(f"fleet             deaths {fleet['replica_deaths']}"
+                     f"   migrated {fleet['migrated']}"
+                     f"   lost {fleet['lost']}"
+                     f"   spillovers {fleet['spillovers']}"
+                     + (f"   no-replica sheds {fleet['no_replica_sheds']}"
+                        if fleet.get("no_replica_sheds") else ""))
+        lines.append("  replica    admitted  finished  shed   mig in/out"
+                     "   goodput tok/s")
+        for rid, r in fleet["replicas"].items():
+            mig = f"{r['migrated_in']}/{r['migrated_out']}"
+            lines.append(f"  {rid:<10} {r['admitted']:<9} {r['finished']:<9} "
+                         f"{r['shed']:<6} {mig:<12} "
+                         f"{_fmt(r.get('goodput_tok_s', '-'))}")
     return "\n".join(lines) + "\n"
 
 
