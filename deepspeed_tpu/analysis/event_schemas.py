@@ -167,6 +167,32 @@ EVENT_SCHEMAS = {
             "replica": "str",
         },
     },
+    "train_fault": {
+        # training-column fault/recovery lifecycle (runtime/resilience.py
+        # TrainSupervisor + runtime/engine.py checkpoint refusal),
+        # discriminated by "event": fault | retried | rebuild |
+        # snapshot | ckpt_torn | ckpt_refused | failed
+        "required": {"event": "str"},
+        "optional": {
+            "error": "str",
+            "detail": "str",
+            "step": "int",
+            "micro": "int",
+            "attempt": "int",
+            "poisoned": "bool",
+            "source": "str",        # rebuild provenance: memory | disk | cold
+            "resume_step": "int",
+            "replayed_steps": "int",
+            "recovery_ms": "number",
+            "checkpoint_ms": "number",
+            "rebuilds": "int",
+            "degraded": "bool",
+            "world_size": "int",
+            "tag": "str",
+            "reason": "str",
+            "committed": "bool",
+        },
+    },
     "memory_snapshot": {
         "required": {
             "reason": "str",

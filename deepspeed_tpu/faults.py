@@ -1,0 +1,393 @@
+"""Deterministic fault injection shared by the serving AND training columns.
+
+TPU pods are preemptible by design: a tick dispatch can raise, a device
+fetch can hang, a whole engine can vanish mid-generation — or mid-step.
+This module makes those failures *expressible and replayable* so both
+recovery layers (serving/engine.py "Fault tolerance", runtime/resilience.py
+TrainSupervisor) can be tested to the same bitwise-parity bar as every
+perf change:
+
+- a **fault plan** is a seeded, deterministic schedule of faults keyed on
+  a monotonically increasing clock — the global serving tick for the
+  serving domain, the global optimizer step for the train domain —
+  replayable JSONL exactly like the loadgen workloads (``dump``/``load``
+  round-trip, ``synth`` for seeded random plans);
+- an **injector** is the plan, armed: installed as the engine's
+  ``fault_hook`` (an explicit injection point the engine calls — no
+  monkeypatching), it raises the planned exception when its clock value
+  comes up.
+
+Two domains instantiate the machinery:
+
+=======  =========================================  =======================
+domain   hook points                                clock
+=======  =========================================  =======================
+serving  ``dispatch`` / ``retire`` / ``set_row``    serving ticks, counted
+         (:data:`HOOK_POINTS`)                      by the injector itself
+train    ``micro_dispatch`` / ``step_fetch`` /      global optimizer step,
+         ``checkpoint_write`` / ``preempt``         read from ``info``
+         (:data:`TRAIN_HOOK_POINTS`)
+=======  =========================================  =======================
+
+The exception taxonomies the recovery ladders decide by:
+
+- serving: :class:`TickDispatchError` (raised before any engine mutation —
+  retryable), :class:`FetchHang` (poisons the tick pipeline → rebuild),
+  :class:`EnginePreempted` (whole-engine loss, optionally degraded).
+- train: :class:`MicroDispatchError` (raised at the top of a micro-step,
+  before the RNG splits or ``grad_acc`` is donated — cleanly retryable),
+  :class:`StepFetchHang` (the loss/grad-norm fetch hung past the
+  watchdog — in-flight state is poisoned, rebuild from snapshot),
+  :class:`TornCheckpointWrite` (the commit marker was never placed — the
+  tag on disk is torn and must be refused at load),
+  :class:`TrainPreempted` (process loss; host snapshots are gone, resume
+  comes from the last committed tag on disk, optionally at a degraded
+  chip count).
+
+Deliberately jax-free (stdlib only): plans are authored, validated and
+round-tripped without paying a jax import, same as the scheduler and
+supervisor policies — tools/ci_jaxfree_tests.py enforces it.
+``serving/faults.py`` re-exports the serving domain unchanged.
+"""
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# exception taxonomy — serving
+# ---------------------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    """Base class for injected faults; ``fault`` carries the plan entry
+    that fired (tick/step, kind, point)."""
+
+    def __init__(self, message: str, fault: Optional[dict] = None):
+        super().__init__(message)
+        self.fault = fault or {}
+
+
+class TickDispatchError(InjectedFault):
+    """A transient tick-dispatch failure raised at the ``dispatch`` hook,
+    BEFORE the engine mutates any state — the retryable fault class."""
+
+
+class FetchHang(InjectedFault, TimeoutError):
+    """A device fetch that hung past the watchdog (injected stand-in for
+    the real ``fetch_timeout_s`` timeout): the in-flight tick's results
+    are unrecoverable, the engine is poisoned."""
+
+
+class EnginePreempted(InjectedFault):
+    """Whole-engine preemption (the pod slice was reclaimed). ``degrade``
+    signals the replacement must be smaller — the graceful-degradation
+    path rebuilds on the next configured subset mesh."""
+
+    def __init__(self, message: str, fault: Optional[dict] = None,
+                 degrade: bool = False):
+        super().__init__(message, fault)
+        self.degrade = degrade
+
+
+# ---------------------------------------------------------------------------
+# exception taxonomy — train
+# ---------------------------------------------------------------------------
+
+
+class MicroDispatchError(InjectedFault):
+    """A transient micro-step dispatch failure raised at the
+    ``micro_dispatch`` hook, BEFORE the engine consumed its RNG or donated
+    ``grad_acc`` — the cleanly retryable train fault class (same batch,
+    same RNG: a retried micro-step is bitwise the micro-step)."""
+
+
+class StepFetchHang(InjectedFault, TimeoutError):
+    """The optimizer-step metrics fetch (loss / grad-norm / overflow flag)
+    hung past ``fetch_timeout_s``: the in-flight step's host view is
+    unrecoverable and the engine is poisoned — rebuild from the last
+    committed snapshot."""
+
+
+class TornCheckpointWrite(InjectedFault):
+    """The process died (or the writer failed) between the array commit
+    and the commit-marker placement: the tag on disk is torn/markerless
+    and ``load_checkpoint`` must refuse it."""
+
+
+class TrainPreempted(InjectedFault):
+    """Whole-process preemption mid-training: host snapshot buffers are
+    lost with the process, so resume restores the last *committed* tag
+    from disk. ``degrade`` signals the replacement slice is smaller — the
+    supervisor escalates through the elastic triad recompute."""
+
+    def __init__(self, message: str, fault: Optional[dict] = None,
+                 degrade: bool = False):
+        super().__init__(message, fault)
+        self.degrade = degrade
+
+
+# ---------------------------------------------------------------------------
+# generic machinery
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlannedFault:
+    """One planned fault: fires at the first hook call at ``point`` whose
+    clock has reached ``tick``, then ``count - 1`` more consecutive times
+    (``count > 1`` models a persistent failure that exhausts the retry
+    budget and forces escalation). Domain subclasses pin ``KINDS`` (fault
+    kind → natural hook point), ``POINTS`` and the JSONL ``TICK_KEY``."""
+
+    tick: int
+    kind: str
+    point: str = ""         # defaults to the kind's natural hook point
+    count: int = 1
+    degrade: bool = False   # preempt only: replacement capacity must shrink
+    fired: int = field(default=0, compare=False)
+
+    KINDS: ClassVar[Dict[str, str]] = {}
+    POINTS: ClassVar[Tuple[str, ...]] = ()
+    TICK_KEY: ClassVar[str] = "tick"
+
+    def __post_init__(self):
+        cls = type(self)
+        if self.kind not in cls.KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(choose from {sorted(cls.KINDS)})")
+        if not self.point:
+            self.point = cls.KINDS[self.kind]
+        if self.point not in cls.POINTS:
+            raise ValueError(f"unknown hook point {self.point!r} "
+                             f"(choose from {cls.POINTS})")
+        if self.tick < 0:
+            raise ValueError(f"fault {cls.TICK_KEY} must be >= 0")
+        if self.count < 1:
+            raise ValueError("fault count must be >= 1")
+
+    def to_dict(self) -> dict:
+        out = {type(self).TICK_KEY: self.tick, "kind": self.kind,
+               "point": self.point}
+        if self.count != 1:
+            out["count"] = self.count
+        if self.degrade:
+            out["degrade"] = True
+        return out
+
+
+class PlannedFaultSchedule:
+    """An ordered, replayable schedule of :class:`PlannedFault` entries."""
+
+    fault_cls = PlannedFault
+
+    def __init__(self, faults: List[PlannedFault]):
+        self.faults = sorted(faults, key=lambda f: (f.tick, f.point, f.kind))
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+    def __iter__(self):
+        return iter(self.faults)
+
+    @classmethod
+    def synth(cls, seed: int = 0, n_faults: int = 3, first_tick: int = 2,
+              tick_span: int = 100, kinds: Optional[List[str]] = None,
+              degrade_last: bool = False):
+        """A seeded random plan: ``n_faults`` faults uniformly over
+        ``[first_tick, first_tick + tick_span)``, kinds drawn from
+        ``kinds`` (default: the domain's full taxonomy). Fully determined
+        by ``seed`` — the chaos-soak analogue of ``synth_workload``."""
+        rng = random.Random(seed)
+        kinds = list(kinds or cls.fault_cls.KINDS)
+        ticks = sorted(rng.randrange(first_tick, first_tick + tick_span)
+                       for _ in range(n_faults))
+        faults = [cls.fault_cls(tick=t, kind=rng.choice(kinds))
+                  for t in ticks]
+        if degrade_last and faults:
+            faults[-1].kind = "preempt"
+            faults[-1].point = cls.fault_cls.KINDS["preempt"]
+            faults[-1].degrade = True
+        return cls(faults)
+
+    def dump(self, path: str):
+        """Write the plan as replayable JSONL (one fault per line)."""
+        with open(path, "w") as fh:
+            for f in self.faults:
+                fh.write(json.dumps(f.to_dict()) + "\n")
+
+    @classmethod
+    def load(cls, path: str):
+        key = cls.fault_cls.TICK_KEY
+        faults = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                tick = rec.get(key, rec.get("tick"))
+                faults.append(cls.fault_cls(
+                    tick=int(tick), kind=rec["kind"],
+                    point=rec.get("point", ""),
+                    count=int(rec.get("count", 1)),
+                    degrade=bool(rec.get("degrade", False))))
+        if not faults:
+            raise ValueError(f"no fault records in {path}")
+        return cls(faults)
+
+
+class PlannedFaultInjector:
+    """A fault plan, armed as an engine fault hook.
+
+    Install with ``engine.fault_hook = injector``; the engine calls
+    ``injector(point, info)`` at each hook point and the injector raises
+    the planned exception when a fault is due. How the clock advances is
+    the domain's choice: the serving injector counts ticks ITSELF (one
+    per ``dispatch`` call) so a single plan stays meaningful across
+    engine rebuilds; the train injector reads the global optimizer step
+    from ``info`` so the clock survives rebuilds for free (a restored
+    engine resumes the step counter)."""
+
+    tick_point: ClassVar[Optional[str]] = None   # hook point that counts
+    tick_info_key: ClassVar[Optional[str]] = None  # info key that sets it
+    tick_label: ClassVar[str] = "tick"
+    info_renames: ClassVar[Dict[str, str]] = {}
+    EXCEPTIONS: ClassVar[Dict[str, type]] = {}
+    PREEMPT_EXCEPTION: ClassVar[type] = EnginePreempted
+
+    def __init__(self, plan: PlannedFaultSchedule):
+        self.plan = plan
+        self.tick = 0                  # the domain clock, as observed
+        self.fired: List[dict] = []    # log of injected faults, in order
+
+    def pending(self) -> int:
+        """Faults that have not fully fired yet."""
+        return sum(1 for f in self.plan if f.fired < f.count)
+
+    def _due(self, point: str) -> Optional[PlannedFault]:
+        for f in self.plan:
+            if f.point == point and f.fired < f.count and self.tick >= f.tick:
+                return f
+        return None
+
+    def __call__(self, point: str, info: dict):
+        cls = type(self)
+        if (cls.tick_info_key is not None and info
+                and cls.tick_info_key in info):
+            self.tick = int(info[cls.tick_info_key])
+        elif cls.tick_point is not None and point == cls.tick_point:
+            self.tick += 1
+        fault = self._due(point)
+        if fault is None:
+            return
+        fault.fired += 1
+        # plan fields win; the hook's engine-local clock (which resets on
+        # every rebuild) is kept under its own key so a fired record can
+        # be diffed against the plan without ambiguity
+        record = dict(fault.to_dict(), fired_tick=self.tick)
+        for key, value in (info or {}).items():
+            record.setdefault(cls.info_renames.get(key, key), value)
+        self.fired.append(record)
+        msg = (f"injected {fault.kind} at {cls.tick_label} {self.tick} "
+               f"(plan {type(fault).TICK_KEY} {fault.tick}, point {point})")
+        exc = cls.EXCEPTIONS.get(fault.kind)
+        if exc is not None:
+            raise exc(msg, record)
+        raise cls.PREEMPT_EXCEPTION(msg, record, degrade=fault.degrade)
+
+
+# ---------------------------------------------------------------------------
+# serving domain (re-exported unchanged by serving/faults.py)
+# ---------------------------------------------------------------------------
+
+# fault kind -> the engine hook point it fires at by default
+FAULT_KINDS: Dict[str, str] = {
+    "dispatch_error": "dispatch",  # raised before the tick mutates anything
+    "fetch_hang": "retire",        # raised at the packed-result fetch
+    "preempt": "dispatch",         # whole-engine loss (before mutation)
+}
+HOOK_POINTS = ("dispatch", "retire", "set_row")
+
+
+@dataclass
+class Fault(PlannedFault):
+    """One planned serving fault, keyed on the global serving tick."""
+
+    KINDS: ClassVar[Dict[str, str]] = FAULT_KINDS
+    POINTS: ClassVar[Tuple[str, ...]] = HOOK_POINTS
+    TICK_KEY: ClassVar[str] = "tick"
+
+
+class FaultPlan(PlannedFaultSchedule):
+    """An ordered, replayable schedule of serving :class:`Fault` entries."""
+
+    fault_cls = Fault
+
+
+class FaultInjector(PlannedFaultInjector):
+    """The serving plan, armed as ``ContinuousBatchingEngine.fault_hook``.
+    Counts serving ticks itself (one per ``dispatch`` call) so one plan
+    spans engine rebuilds — the replacement engine's private tick counter
+    restarts, the plan's does not. The serving layer re-installs the hook
+    on every rebuilt engine."""
+
+    tick_point = "dispatch"
+    tick_label = "serving tick"
+    info_renames = {"tick": "engine_tick"}
+    EXCEPTIONS = {"dispatch_error": TickDispatchError,
+                  "fetch_hang": FetchHang}
+    PREEMPT_EXCEPTION = EnginePreempted
+
+
+# ---------------------------------------------------------------------------
+# train domain (consumed by runtime/engine.py + runtime/resilience.py)
+# ---------------------------------------------------------------------------
+
+# fault kind -> the train-engine hook point it fires at by default
+TRAIN_FAULT_KINDS: Dict[str, str] = {
+    "dispatch_error": "micro_dispatch",  # before RNG split / grad_acc donate
+    "fetch_hang": "step_fetch",          # at the loss/grad-norm fetch
+    "torn_write": "checkpoint_write",    # between array commit and marker
+    "preempt": "preempt",                # process loss, between steps
+}
+TRAIN_HOOK_POINTS = ("micro_dispatch", "step_fetch", "checkpoint_write",
+                     "preempt")
+
+
+@dataclass
+class TrainFault(PlannedFault):
+    """One planned train fault, keyed on the global optimizer step (the
+    fault becomes due once the engine's ``global_steps``-derived step
+    index reaches ``tick``; JSONL spells the field ``step``)."""
+
+    KINDS: ClassVar[Dict[str, str]] = TRAIN_FAULT_KINDS
+    POINTS: ClassVar[Tuple[str, ...]] = TRAIN_HOOK_POINTS
+    TICK_KEY: ClassVar[str] = "step"
+
+    @property
+    def step(self) -> int:
+        return self.tick
+
+
+class TrainFaultPlan(PlannedFaultSchedule):
+    """An ordered, replayable schedule of :class:`TrainFault` entries."""
+
+    fault_cls = TrainFault
+
+
+class TrainFaultInjector(PlannedFaultInjector):
+    """The train plan, armed as ``TpuEngine.fault_hook`` (the supervisor
+    re-installs it on every rebuilt engine). The clock is the global
+    optimizer step the hook site reports in ``info["step"]`` — it
+    survives rebuilds because a restored engine resumes the counter, and
+    a fault that fired during a replayed step does not re-fire
+    (``fired`` lives in the plan, not the engine)."""
+
+    tick_info_key = "step"
+    tick_label = "global step"
+    EXCEPTIONS = {"dispatch_error": MicroDispatchError,
+                  "fetch_hang": StepFetchHang,
+                  "torn_write": TornCheckpointWrite}
+    PREEMPT_EXCEPTION = TrainPreempted

@@ -1,0 +1,150 @@
+"""Checkpoint integrity: per-leaf checksum manifests + atomic commit
+markers.
+
+A checkpoint directory written by the engine carries three sidecar files
+next to the orbax arrays (docs/checkpointing.md "Integrity"):
+
+- ``ds_metadata.json`` — step counters / LR-scheduler state / client
+  state, written strictly AFTER the arrays commit (pre-existing).
+- ``ds_manifest.json`` — one entry per state-tree leaf: CRC32 of the
+  host bytes, dtype, shape. Load recomputes and compares, so silent
+  array corruption (a torn shard, a bad byte on the wire) is caught
+  before training resumes on garbage.
+- ``ds_commit.json`` — the atomic commit marker, placed LAST via
+  tmp+``os.replace``. Its presence is the durability contract: a tag
+  without it is torn (the writer died mid-commit) and
+  ``load_checkpoint`` refuses it, falling back to the previous good tag.
+
+Everything here is jax-free (numpy + stdlib): the TrainSupervisor's
+restore policy scans tags and verifies manifests without paying a jax
+import, and tools/ci_jaxfree_tests.py holds it to that.
+"""
+
+import json
+import os
+import re
+import zlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+MANIFEST_FILE = "ds_manifest.json"
+COMMIT_MARKER = "ds_commit.json"
+_TAG_RE = re.compile(r"^global_step(\d+)$")
+
+
+class TornCheckpointError(RuntimeError):
+    """The checkpoint tag is torn: its commit marker is missing (the
+    writer died between the array commit and the marker placement) or a
+    leaf's bytes no longer match the manifest. Resuming from it would
+    silently train on corrupt or half-written state — refuse and fall
+    back to the previous good tag."""
+
+
+def leaf_crc(arr) -> int:
+    """CRC32 of a leaf's host bytes (canonical C-contiguous layout, so
+    the value is independent of how the leaf was sharded on device)."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+
+
+def manifest_from_leaves(named_leaves: Iterable[Tuple[str, "np.ndarray"]]) -> dict:
+    """Build the per-leaf manifest from ``(dotted_key, host_array)``
+    pairs (the caller flattens the state tree — with jax where the tree
+    holds device arrays, or plain recursion for host snapshots)."""
+    leaves: Dict[str, dict] = {}
+    for key, arr in named_leaves:
+        a = np.asarray(arr)
+        leaves[key] = {
+            "crc32": leaf_crc(a),
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+        }
+    return {"version": 1, "leaf_count": len(leaves), "leaves": leaves}
+
+
+def verify_leaves(named_leaves: Iterable[Tuple[str, "np.ndarray"]],
+                  manifest: dict) -> List[str]:
+    """Compare restored leaves against a manifest; returns human-readable
+    mismatch descriptions (empty list = intact). Leaves absent from
+    either side are mismatches too — a dropped optimizer moment is as
+    fatal as a flipped bit."""
+    expected = dict(manifest.get("leaves", {}))
+    problems = []
+    for key, arr in named_leaves:
+        want = expected.pop(key, None)
+        if want is None:
+            problems.append(f"unexpected leaf {key!r} (not in manifest)")
+            continue
+        got = leaf_crc(arr)
+        if got != int(want["crc32"]):
+            problems.append(
+                f"leaf {key!r} checksum mismatch: "
+                f"manifest {want['crc32']:#010x}, restored {got:#010x}")
+    for key in expected:
+        problems.append(f"missing leaf {key!r} (in manifest, not restored)")
+    return problems
+
+
+def write_json_atomic(path: str, obj: dict):
+    """tmp + ``os.replace``: readers see the old content or the new,
+    never a half-written file (the satellite fix the plain ``latest``
+    pointer write needed, applied to every sidecar)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, default=str)
+    os.replace(tmp, path)
+
+
+def write_commit_marker(path: str, extra: Optional[dict] = None):
+    """Place the commit marker for checkpoint directory ``path`` —
+    atomically, and only call this once everything else is durable."""
+    marker = {"committed": True, "tag": os.path.basename(path)}
+    if extra:
+        marker.update(extra)
+    write_json_atomic(os.path.join(path, COMMIT_MARKER), marker)
+
+
+def is_committed(path: str) -> bool:
+    """True iff checkpoint directory ``path`` carries a commit marker."""
+    return os.path.exists(os.path.join(path, COMMIT_MARKER))
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """The tag's ``ds_manifest.json``, or None when the save predates
+    manifests (or disabled them via ``checkpoint.integrity_manifest``)."""
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if not os.path.exists(mpath):
+        return None
+    with open(mpath) as fh:
+        return json.load(fh)
+
+
+def tag_step(tag: str) -> Optional[int]:
+    """The step a ``global_step<N>`` tag names, or None for foreign tags."""
+    m = _TAG_RE.match(tag)
+    return int(m.group(1)) if m else None
+
+
+def scan_tags(save_dir: str) -> List[Tuple[int, str, bool]]:
+    """Every ``global_step<N>`` tag under ``save_dir`` as
+    ``(step, tag, committed)``, newest first — the restore-candidate
+    order the fallback ladder walks."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in os.listdir(save_dir):
+        step = tag_step(name)
+        if step is None or not os.path.isdir(os.path.join(save_dir, name)):
+            continue
+        out.append((step, name, is_committed(os.path.join(save_dir, name))))
+    out.sort(key=lambda t: t[0], reverse=True)
+    return out
+
+
+def latest_committed_tag(save_dir: str) -> Optional[str]:
+    """Newest tag whose commit marker is present, or None."""
+    for _step, tag, committed in scan_tags(save_dir):
+        if committed:
+            return tag
+    return None

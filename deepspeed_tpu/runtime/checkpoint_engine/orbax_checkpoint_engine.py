@@ -14,9 +14,21 @@ import json
 import os
 
 import jax
+import numpy as np
 
+from deepspeed_tpu.runtime.checkpoint_engine import integrity
 from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import CheckpointEngine
+from deepspeed_tpu.runtime.checkpoint_engine.integrity import TornCheckpointError
 from deepspeed_tpu.utils.logging import logger
+
+
+def named_host_leaves(tree):
+    """``(key, host_array)`` pairs for every leaf of ``tree``, keys from
+    jax's keystr so save-side manifests and load-side verification agree
+    on naming regardless of which side flattened the tree."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp), np.asarray(jax.device_get(leaf)))
+            for kp, leaf in flat]
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
@@ -26,19 +38,31 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         self._ocp = ocp
         self._use_ocdbt = use_ocdbt
 
-    def save(self, path: str, state_tree, metadata: dict) -> None:
+    def save(self, path: str, state_tree, metadata: dict, manifest=None,
+             pre_commit=None) -> None:
+        """``manifest`` is the per-leaf checksum table
+        (integrity.manifest_from_leaves); ``pre_commit`` runs after every
+        sidecar except the commit marker is durable — it is the torn-write
+        injection window: a raise there leaves a markerless tag that
+        ``load`` refuses, exactly like a writer killed mid-commit."""
         ocp = self._ocp
         path = os.path.abspath(path)
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(use_ocdbt=self._use_ocdbt))
         ckptr.save(path, state_tree, force=True)
         if jax.process_index() == 0:
-            with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
-                json.dump(metadata, fh, default=str)
+            _commit_sidecars(path, metadata, manifest, pre_commit)
 
-    def load(self, path: str, template_tree):
+    def load(self, path: str, template_tree, require_commit: bool = True,
+             verify_integrity: bool = True):
         ocp = self._ocp
         path = os.path.abspath(path)
         self.wait()
+        if require_commit and not integrity.is_committed(path):
+            raise TornCheckpointError(
+                f"{path} has no {integrity.COMMIT_MARKER} — torn/uncommitted "
+                "checkpoint (writer died mid-commit); load an earlier tag"
+            )
         def _restore_arg(x):
             if isinstance(x, jax.Array):
                 return ocp.ArrayRestoreArgs(sharding=x.sharding, global_shape=x.shape, dtype=x.dtype)
@@ -65,6 +89,16 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             )
         with open(meta_path) as fh:
             metadata = json.load(fh)
+        if verify_integrity:
+            manifest = integrity.read_manifest(path)
+            if manifest is not None:
+                problems = integrity.verify_leaves(
+                    named_host_leaves(restored), manifest)
+                if problems:
+                    raise TornCheckpointError(
+                        f"{path} failed integrity verification "
+                        f"({len(problems)} leaf mismatch(es)): "
+                        + "; ".join(problems[:3]))
         return restored, metadata
 
     def wait(self) -> None:
@@ -76,6 +110,24 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         the commit fence — 'latest' pointers and anything else that must
         only ever name durable checkpoints goes through here."""
         callback()
+
+
+def _commit_sidecars(path: str, metadata: dict, manifest, pre_commit):
+    """Sidecar ordering contract (docs/checkpointing.md "Integrity"):
+    arrays are already durable when this runs; metadata next (its presence
+    implies the arrays committed), then the checksum manifest, then — past
+    the injectable ``pre_commit`` window — the atomic commit marker. A
+    death anywhere before the marker leaves a tag that loads as torn."""
+    integrity.write_json_atomic(os.path.join(path, "ds_metadata.json"),
+                                metadata)
+    if manifest is not None:
+        integrity.write_json_atomic(
+            os.path.join(path, integrity.MANIFEST_FILE), manifest)
+    if pre_commit is not None:
+        pre_commit()
+    extra = ({"leaf_count": manifest.get("leaf_count")}
+             if manifest is not None else None)
+    integrity.write_commit_marker(path, extra=extra)
 
 
 # Engines with a pending (unfenced) save are pinned by a STRONG reference
@@ -106,22 +158,24 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
 
     def __init__(self, use_ocdbt: bool = True):
         super().__init__(use_ocdbt=use_ocdbt)
-        self._async = self._ocp.AsyncCheckpointer(self._ocp.PyTreeCheckpointHandler())
+        self._async = self._ocp.AsyncCheckpointer(
+            self._ocp.PyTreeCheckpointHandler(use_ocdbt=use_ocdbt))
         self._pending_meta = None
         self._pending_commits = []
 
-    def save(self, path: str, state_tree, metadata: dict) -> None:
+    def save(self, path: str, state_tree, metadata: dict, manifest=None,
+             pre_commit=None) -> None:
         ocp = self._ocp
         path = os.path.abspath(path)
         self.wait()  # one save in flight at a time; flushes prior metadata
         self._async.save(path, args=ocp.args.PyTreeSave(state_tree), force=True)
         # orbax commits the directory via tmp+rename AFTER the background
-        # serialization finishes — the metadata file can only be placed once
-        # that rename happened, so it rides the next fence (wait()/load()/
-        # next save()/atexit). A metadata file present on disk therefore
-        # implies the arrays are durable, matching the sync engine's
-        # "metadata last" ordering.
-        self._pending_meta = (path, dict(metadata))
+        # serialization finishes — the metadata/manifest/commit-marker
+        # sidecars can only be placed once that rename happened, so they
+        # ride the next fence (wait()/load()/next save()/atexit). A commit
+        # marker present on disk therefore implies the arrays are durable,
+        # matching the sync engine's "marker last" ordering.
+        self._pending_meta = (path, dict(metadata), manifest, pre_commit)
         _PENDING_ASYNC_ENGINES.add(self)
 
     def on_commit(self, callback) -> None:
@@ -137,14 +191,24 @@ class AsyncOrbaxCheckpointEngine(OrbaxCheckpointEngine):
             self._async.wait_until_finished()
             marker_written = True
             if self._pending_meta is not None:
-                path, metadata = self._pending_meta
+                path, metadata, manifest, pre_commit = self._pending_meta
                 # the directory can legitimately be gone (test tmp dirs
                 # removed between save and teardown drain) — skip the write
                 # but don't break the fence
                 if jax.process_index() == 0:
                     if os.path.isdir(path):
-                        with open(os.path.join(path, "ds_metadata.json"), "w") as fh:
-                            json.dump(metadata, fh, default=str)
+                        try:
+                            _commit_sidecars(path, metadata, manifest,
+                                             pre_commit)
+                        except BaseException:
+                            # torn commit: sidecars before the marker may be
+                            # on disk but the marker is not — the tag must
+                            # load as uncommitted, nothing may point 'latest'
+                            # at it, and a later fence must NOT retroactively
+                            # commit it (a real writer death has no retry)
+                            self._pending_meta = None
+                            self._pending_commits.clear()
+                            raise
                     else:
                         marker_written = False
                         logger.warning(

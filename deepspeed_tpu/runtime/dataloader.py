@@ -36,6 +36,10 @@ class TpuDataLoader:
         # feed the SAME full global batch, never a slice.
         self.process_shard = process_shard
         self.epoch = 0
+        # resume cursor: batches already yielded this epoch (state_dict),
+        # and how many to skip on the next pass (load_state_dict)
+        self._batches_yielded = 0
+        self._resume_batch = 0
         try:
             self._len = len(dataset)
         except TypeError:
@@ -48,6 +52,28 @@ class TpuDataLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+    def state_dict(self) -> dict:
+        """Resume cursor: how far into the deterministic (seed, epoch)
+        stream this loader has advanced. Restoring it on a fresh loader
+        replays the exact same batch sequence from that point — the
+        checkpoint client_state carries it so resumed training sees the
+        batches the crashed run would have seen (bitwise)."""
+        return {"epoch": self.epoch, "batch": self._batches_yielded,
+                "seed": self.seed}
+
+    def load_state_dict(self, state: dict):
+        if self._len is None:
+            raise TypeError(
+                "cannot resume an iterable dataset without __len__ — its "
+                "stream position is not replayable from a cursor")
+        if "seed" in state and int(state["seed"]) != int(self.seed):
+            raise ValueError(
+                f"dataloader cursor was taken under seed {state['seed']}, "
+                f"this loader uses seed {self.seed} — the shuffle orders "
+                "differ, so the cursor does not name the same batches")
+        self.epoch = int(state.get("epoch", 0))
+        self._resume_batch = int(state.get("batch", 0))
 
     def __iter__(self):
         if self._len is None:
@@ -64,10 +90,16 @@ class TpuDataLoader:
         shard = (self.process_shard if self.process_shard is not None
                  else self.batch_size % pcount == 0)
         per_proc = self.batch_size // pcount if shard and self.batch_size % pcount == 0 else self.batch_size
-        for start in range(0, n - (self.batch_size - 1 if self.drop_last else 0), self.batch_size):
+        skip, self._resume_batch = self._resume_batch, 0
+        for b, start in enumerate(range(
+                0, n - (self.batch_size - 1 if self.drop_last else 0),
+                self.batch_size)):
+            if b < skip:
+                continue
             idx = order[start : start + self.batch_size]
             if pcount > 1 and shard and self.batch_size % pcount == 0:
                 idx = idx[pidx * per_proc : (pidx + 1) * per_proc]
+            self._batches_yielded = b + 1
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 
     def _iter_iterable(self):
@@ -100,3 +132,11 @@ class RepeatingLoader:
                 self.loader.set_epoch(getattr(self.loader, "epoch", 0) + 1)
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+    def state_dict(self) -> dict:
+        return self.loader.state_dict()
+
+    def load_state_dict(self, state: dict):
+        self.loader.load_state_dict(state)
+        # drop the live iterator: the next __next__ must honor the cursor
+        self.data_iter = iter(self.loader)

@@ -38,6 +38,7 @@ from deepspeed_tpu.ops.adam.basic_optimizers import SGD, Adagrad, Lion
 from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
 from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
 from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.checkpoint_engine import integrity as ckpt_integrity
 from deepspeed_tpu.runtime.config import TpuConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaleState, create_loss_scaler
 from deepspeed_tpu.runtime.lr_schedules import create_lr_scheduler
@@ -514,10 +515,23 @@ class TpuEngine:
             OrbaxCheckpointEngine,
         )
 
+        # ocdbt's multi-host aggregation buys nothing for a single-writer
+        # checkpoint and costs ~3x writer CPU — off unless asked for
+        use_ocdbt = config.checkpoint.get("use_ocdbt", False)
         if config.checkpoint.get("async_save", False):
-            self.checkpoint_engine = AsyncOrbaxCheckpointEngine()
+            self.checkpoint_engine = AsyncOrbaxCheckpointEngine(use_ocdbt=use_ocdbt)
         else:
-            self.checkpoint_engine = OrbaxCheckpointEngine()
+            self.checkpoint_engine = OrbaxCheckpointEngine(use_ocdbt=use_ocdbt)
+
+        # --- fault surface (docs/training.md "Fault tolerance"): the
+        # TrainSupervisor installs an injector as fault_hook and arms the
+        # step-fetch watchdog; both stay inert for plain training. poisoned
+        # flips when a failure lands PAST a mutation barrier (grad_acc or
+        # params already donated) — host state can no longer be trusted and
+        # the supervisor must rebuild from the last committed snapshot.
+        self.fault_hook = None          # callable(point, info) or None
+        self.fetch_timeout_s = None     # step-fetch watchdog seconds; None = off
+        self.poisoned = False
 
         # --- activation checkpointing (reference: engine.py:872
         # _configure_checkpointing); models read the policy via
@@ -1081,6 +1095,23 @@ class TpuEngine:
         return loss
 
     def _forward_impl(self, batch, rng=None):
+        if self.fault_hook is not None:
+            # fires BEFORE the RNG splits or grad_acc is donated: an
+            # injected micro_dispatch fault here leaves the engine exactly
+            # as it was, so the supervisor's retry of the same batch is
+            # bitwise the micro-step that would have run
+            self.fault_hook("micro_dispatch",
+                            {"step": self.global_steps + 1,
+                             "micro": self.micro_steps})
+        try:
+            return self._forward_body(batch, rng)
+        except BaseException:
+            # anything past the dispatch barrier may have consumed RNG or
+            # donated grad_acc — poison so recovery rebuilds, never retries
+            self.poisoned = True
+            raise
+
+    def _forward_body(self, batch, rng=None):
         self.timers(EngineTimers.FORWARD).start()
         self.tput_timer.start()
         if self.curriculum_scheduler is not None:
@@ -1172,6 +1203,34 @@ class TpuEngine:
         if not self.is_gradient_accumulation_boundary():
             self.tput_timer.stop(global_step=False)
             return
+        try:
+            self._step_body()
+        except BaseException:
+            # the apply program donates params/master/opt_state/grad_acc on
+            # dispatch — any failure inside the step body (including a hung
+            # or injected step_fetch) leaves state unaccounted for
+            self.poisoned = True
+            raise
+
+    def _guarded_fetch(self, metrics):
+        """The loss/grad-norm host fetch, under the ``step_fetch`` fault
+        hook and the post-hoc ``fetch_timeout_s`` watchdog (same
+        no-threads design as the serving retire watchdog: time the
+        blocking fetch, raise TimeoutError when it overran — the step's
+        host view is then untrustworthy and step() poisons the engine)."""
+        if self.fault_hook is not None:
+            self.fault_hook("step_fetch", {"step": self.global_steps + 1})
+        if self.fetch_timeout_s is None:
+            return
+        t0 = time.perf_counter()
+        jax.block_until_ready(metrics)
+        dt = time.perf_counter() - t0
+        if dt > self.fetch_timeout_s:
+            raise TimeoutError(
+                f"step {self.global_steps + 1} metrics fetch took {dt:.3f}s "
+                f"> fetch_timeout_s={self.fetch_timeout_s}")
+
+    def _step_body(self):
         assert self.optimizer is not None, "step() requires an optimizer (config or client-provided)"
         tele = self.telemetry.enabled
         t_step = time.time() if tele else 0.0
@@ -1191,6 +1250,7 @@ class TpuEngine:
                 self.params, self.master_params, self.opt_state, self.grad_acc, self.scale_state, lr
             )
         self._last_metrics = metrics
+        self._guarded_fetch(metrics)
         self.global_steps += 1
         if self.pld is not None:
             self.pld.update_state(self.global_steps)
@@ -1512,9 +1572,8 @@ class TpuEngine:
             tree["host_opt"] = sd
         return tree
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
-        tag = tag if tag is not None else f"global_step{self.global_steps}"
-        meta = {
+    def _checkpoint_meta(self, client_state=None) -> dict:
+        return {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
@@ -1524,21 +1583,62 @@ class TpuEngine:
             "zero_stage": self.zero_stage,
             "dtype": str(self.model_dtype.__name__),
         }
-        self.checkpoint_engine.save(os.path.join(save_dir, tag), self._state_tree(), meta)
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        state_tree=None, manifest=None):
+        """``state_tree``/``manifest`` let the TrainSupervisor commit an
+        already-captured host snapshot (numpy leaves save fine through
+        orbax and restore onto device templates) without a second
+        device_get pass; plain callers leave both None."""
+        from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+            named_host_leaves,
+        )
+
+        tag = tag if tag is not None else f"global_step{self.global_steps}"
+        meta = self._checkpoint_meta(client_state)
+        tree = state_tree if state_tree is not None else self._state_tree()
+        if manifest is None and self.config.checkpoint.get("integrity_manifest", True):
+            manifest = ckpt_integrity.manifest_from_leaves(named_host_leaves(tree))
+        pre_commit = None
+        if self.fault_hook is not None:
+            hook, step = self.fault_hook, self.global_steps
+
+            def pre_commit():
+                # the torn-write injection window: arrays/metadata/manifest
+                # are durable, the commit marker is not yet placed
+                hook("checkpoint_write", {"step": step, "tag": tag})
+
+        self.checkpoint_engine.save(os.path.join(save_dir, tag), tree, meta,
+                                    manifest=manifest, pre_commit=pre_commit)
         if save_latest and jax.process_index() == 0:
 
             def _write_latest():
                 # runs at commit time ('latest' must only ever name durable
-                # checkpoints; async saves defer this to their fence)
+                # checkpoints; async saves defer this to their fence) and is
+                # atomic — a reader sees the old pointer or the new, never a
+                # torn half-written tag name
                 os.makedirs(save_dir, exist_ok=True)
-                with open(os.path.join(save_dir, "latest"), "w") as fh:
+                tmp = os.path.join(save_dir, f".latest.tmp.{os.getpid()}")
+                with open(tmp, "w") as fh:
                     fh.write(tag)
+                os.replace(tmp, os.path.join(save_dir, "latest"))
 
             self.checkpoint_engine.on_commit(_write_latest)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 
-    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True, load_lr_scheduler_states=True):
+    def _ckpt_refused(self, tag, reason):
+        logger.warning(f"refusing checkpoint tag {tag!r}: {reason}")
+        if self.telemetry.enabled:
+            self.telemetry.emit(
+                "train_fault",
+                {"event": "ckpt_refused", "tag": str(tag),
+                 "reason": str(reason)},
+            )
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True, verify_integrity=True):
+        explicit = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -1546,9 +1646,41 @@ class TpuEngine:
                 return None, {}
             with open(latest) as fh:
                 tag = fh.read().strip()
+        candidates = [tag]
+        if not explicit:
+            # resume from the newest restorable state, not just what
+            # 'latest' names: scan every global_step tag newest-first
+            # (torn ones get REFUSED with a ckpt_refused event and the
+            # walk falls back), keeping the latest pointer as the lead
+            # candidate when it names a foreign (non-global_step) tag
+            scanned = [t for _s, t, _c in ckpt_integrity.scan_tags(load_dir)]
+            candidates = scanned if tag in scanned else [tag] + scanned
+        restored = meta = None
+        errors = []
+        for cand in candidates:
+            path = os.path.join(load_dir, cand)
+            try:
+                restored, meta = self.checkpoint_engine.load(
+                    path, self._state_tree(), verify_integrity=verify_integrity)
+                tag = cand
+                break
+            except ckpt_integrity.TornCheckpointError as e:
+                self._ckpt_refused(cand, str(e))
+                errors.append(f"{cand}: {e}")
+        if restored is None:
+            raise ckpt_integrity.TornCheckpointError(
+                f"no committed checkpoint restorable from {load_dir} "
+                f"(refused: {'; '.join(errors) or 'none found'})")
         path = os.path.join(load_dir, tag)
-        template = self._state_tree()
-        restored, meta = self.checkpoint_engine.load(path, template)
+        self._restore_state(restored, meta, load_optimizer_states,
+                            load_lr_scheduler_states)
+        log_dist(f"loaded checkpoint {path} at step {self.global_steps}", ranks=[0])
+        return path, meta.get("client_state", {})
+
+    def _restore_state(self, restored, meta, load_optimizer_states=True,
+                       load_lr_scheduler_states=True):
+        """Place a restored state tree + metadata onto this engine — shared
+        by disk loads and the supervisor's host-snapshot restores."""
         self.params = restored["params"]
         if "grad_acc" in restored:
             self.grad_acc = restored["grad_acc"]
@@ -1585,8 +1717,58 @@ class TpuEngine:
         self.skipped_steps = meta.get("skipped_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        log_dist(f"loaded checkpoint {path} at step {self.global_steps}", ranks=[0])
-        return path, meta.get("client_state", {})
+        self.poisoned = False
+
+    # ---- host snapshots (TrainSupervisor double buffer) -------------------
+
+    def rng_state(self):
+        """Host copy of the training RNG key (raw uint32 words)."""
+        return np.asarray(jax.device_get(self._rng))
+
+    def set_rng_state(self, key):
+        self._rng = jnp.asarray(np.asarray(key))
+
+    def host_state_snapshot(self, client_state=None):
+        """One atomic unit of training state on host: ``(host_tree, meta,
+        manifest)`` with the full state tree pulled to numpy, checkpoint
+        metadata (step counters / LR scheduler / client state), and the
+        per-leaf checksum manifest. Captured at a step boundary it is
+        everything needed for a bitwise resume."""
+        from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+            named_host_leaves,
+        )
+
+        tree = self._state_tree()
+        host_tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+        meta = self._checkpoint_meta(client_state)
+        manifest = ckpt_integrity.manifest_from_leaves(named_host_leaves(host_tree))
+        return host_tree, meta, manifest
+
+    def restore_from_host_state(self, host_tree, meta, verify_integrity=None):
+        """Place a :meth:`host_state_snapshot` back onto this engine's
+        device templates (shardings come from the current state tree, so
+        the same snapshot restores onto a rebuilt engine)."""
+        template = self._state_tree()
+
+        def _place(t, h):
+            if isinstance(t, jax.Array):
+                return jax.device_put(np.asarray(h), t.sharding)
+            return h
+
+        if verify_integrity is not None:
+            from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+                named_host_leaves,
+            )
+
+            problems = ckpt_integrity.verify_leaves(
+                named_host_leaves(host_tree), verify_integrity)
+            if problems:
+                raise ckpt_integrity.TornCheckpointError(
+                    f"host snapshot failed integrity verification "
+                    f"({len(problems)} leaf mismatch(es)): "
+                    + "; ".join(problems[:3]))
+        restored = jax.tree.map(_place, template, host_tree)
+        self._restore_state(restored, meta)
 
 
 # Alias with reference-familiar name

@@ -1,215 +1,37 @@
-"""Deterministic fault injection for the serving stack.
+"""Deterministic fault injection for the serving stack — the serving
+domain of the shared fault layer (:mod:`deepspeed_tpu.faults`), re-exported
+under its original home.
 
-TPU pods are preemptible by design: a serving process must expect tick
-dispatches to raise, device fetches to hang, and whole engines to vanish
-mid-generation. This module makes those failures *expressible and
-replayable* so the recovery layer (serving/engine.py "Fault tolerance",
-docs/serving.md) can be tested to the same bitwise-parity bar as every
-perf change:
-
-- :class:`FaultPlan` — a seeded, deterministic schedule of faults keyed
-  on the global serving tick counter, replayable JSONL exactly like the
-  loadgen workloads (``dump``/``load`` round-trip, ``synth`` for seeded
-  random plans).
-- :class:`FaultInjector` — the plan, armed. Installed as
-  ``ContinuousBatchingEngine.fault_hook`` (an explicit injection point
-  the engine calls at ``dispatch`` / ``retire`` / ``set_row`` — no
-  monkeypatching), it raises the planned exception when its tick comes
-  up. The injector owns the tick counter, so one plan spans engine
-  rebuilds: tick indices are *serving* ticks, not per-engine ticks.
-- The exception taxonomy recovery decides by: :class:`TickDispatchError`
-  (transient, raised before any engine mutation — retryable),
-  :class:`FetchHang` (a hung/expired device fetch — poisons the tick
-  pipeline, engine rebuild), :class:`EnginePreempted` (whole-engine
-  loss, optionally with capacity: rebuild, possibly on a smaller mesh).
-
-Deliberately jax-free (stdlib only): plans are authored, validated and
-round-tripped without paying a jax import, same as the scheduler
-policies.
+The machinery (seeded :class:`FaultPlan` schedules, JSONL round-trip, the
+armed :class:`FaultInjector` hook, the :class:`TickDispatchError` /
+:class:`FetchHang` / :class:`EnginePreempted` taxonomy the recovery
+ladder decides by) lives in ``deepspeed_tpu/faults.py`` so the training
+column (runtime/resilience.py) shares one implementation; see that
+module's docstring for the full domain table. This shim exists so every
+serving import path (`serving/engine.py`, tests, docs/serving.md) keeps
+working unchanged, and stays jax-free like the rest of the policy layer.
 """
 
-import json
-import random
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from deepspeed_tpu.faults import (
+    FAULT_KINDS,
+    HOOK_POINTS,
+    EnginePreempted,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    FetchHang,
+    InjectedFault,
+    TickDispatchError,
+)
 
-# fault kind -> the engine hook point it fires at by default
-FAULT_KINDS: Dict[str, str] = {
-    "dispatch_error": "dispatch",  # raised before the tick mutates anything
-    "fetch_hang": "retire",        # raised at the packed-result fetch
-    "preempt": "dispatch",         # whole-engine loss (before mutation)
-}
-HOOK_POINTS = ("dispatch", "retire", "set_row")
-
-
-class InjectedFault(RuntimeError):
-    """Base class for injected serving faults; ``fault`` carries the plan
-    entry that fired (tick, kind, point)."""
-
-    def __init__(self, message: str, fault: Optional[dict] = None):
-        super().__init__(message)
-        self.fault = fault or {}
-
-
-class TickDispatchError(InjectedFault):
-    """A transient tick-dispatch failure raised at the ``dispatch`` hook,
-    BEFORE the engine mutates any state — the retryable fault class."""
-
-
-class FetchHang(InjectedFault, TimeoutError):
-    """A device fetch that hung past the watchdog (injected stand-in for
-    the real ``fetch_timeout_s`` timeout): the in-flight tick's results
-    are unrecoverable, the engine is poisoned."""
-
-
-class EnginePreempted(InjectedFault):
-    """Whole-engine preemption (the pod slice was reclaimed). ``degrade``
-    signals the replacement must be smaller — the graceful-degradation
-    path rebuilds on the next configured subset mesh."""
-
-    def __init__(self, message: str, fault: Optional[dict] = None,
-                 degrade: bool = False):
-        super().__init__(message, fault)
-        self.degrade = degrade
-
-
-@dataclass
-class Fault:
-    """One planned fault: fires at the first hook call at ``point`` whose
-    serving-tick counter has reached ``tick``, then ``count - 1`` more
-    consecutive times (``count > 1`` models a persistent failure that
-    exhausts the retry budget and forces escalation)."""
-
-    tick: int
-    kind: str
-    point: str = ""         # defaults to the kind's natural hook point
-    count: int = 1
-    degrade: bool = False   # preempt only: replacement mesh must shrink
-    fired: int = field(default=0, compare=False)
-
-    def __post_init__(self):
-        if self.kind not in FAULT_KINDS:
-            raise ValueError(f"unknown fault kind {self.kind!r} "
-                             f"(choose from {sorted(FAULT_KINDS)})")
-        if not self.point:
-            self.point = FAULT_KINDS[self.kind]
-        if self.point not in HOOK_POINTS:
-            raise ValueError(f"unknown hook point {self.point!r} "
-                             f"(choose from {HOOK_POINTS})")
-        if self.tick < 0:
-            raise ValueError("fault tick must be >= 0")
-        if self.count < 1:
-            raise ValueError("fault count must be >= 1")
-
-    def to_dict(self) -> dict:
-        out = {"tick": self.tick, "kind": self.kind, "point": self.point}
-        if self.count != 1:
-            out["count"] = self.count
-        if self.degrade:
-            out["degrade"] = True
-        return out
-
-
-class FaultPlan:
-    """An ordered, replayable schedule of :class:`Fault` entries."""
-
-    def __init__(self, faults: List[Fault]):
-        self.faults = sorted(faults, key=lambda f: (f.tick, f.point, f.kind))
-
-    def __len__(self) -> int:
-        return len(self.faults)
-
-    def __iter__(self):
-        return iter(self.faults)
-
-    @classmethod
-    def synth(cls, seed: int = 0, n_faults: int = 3, first_tick: int = 2,
-              tick_span: int = 100, kinds: Optional[List[str]] = None,
-              degrade_last: bool = False) -> "FaultPlan":
-        """A seeded random plan: ``n_faults`` faults uniformly over
-        ``[first_tick, first_tick + tick_span)``, kinds drawn from
-        ``kinds`` (default: all three). Fully determined by ``seed`` —
-        the chaos-soak analogue of ``synth_workload``."""
-        rng = random.Random(seed)
-        kinds = list(kinds or FAULT_KINDS)
-        ticks = sorted(rng.randrange(first_tick, first_tick + tick_span)
-                       for _ in range(n_faults))
-        faults = [Fault(tick=t, kind=rng.choice(kinds)) for t in ticks]
-        if degrade_last and faults:
-            faults[-1].kind = "preempt"
-            faults[-1].point = FAULT_KINDS["preempt"]
-            faults[-1].degrade = True
-        return cls(faults)
-
-    def dump(self, path: str):
-        """Write the plan as replayable JSONL (one fault per line)."""
-        with open(path, "w") as fh:
-            for f in self.faults:
-                fh.write(json.dumps(f.to_dict()) + "\n")
-
-    @classmethod
-    def load(cls, path: str) -> "FaultPlan":
-        faults = []
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
-                faults.append(Fault(tick=int(rec["tick"]), kind=rec["kind"],
-                                    point=rec.get("point", ""),
-                                    count=int(rec.get("count", 1)),
-                                    degrade=bool(rec.get("degrade", False))))
-        if not faults:
-            raise ValueError(f"no fault records in {path}")
-        return cls(faults)
-
-
-class FaultInjector:
-    """A :class:`FaultPlan`, armed as an engine fault hook.
-
-    Install with ``engine.fault_hook = injector``; the engine calls
-    ``injector(point, info)`` at each hook point and the injector raises
-    the planned exception when a fault is due. The injector counts
-    serving ticks ITSELF (one per ``dispatch`` call) so a single plan
-    stays meaningful across engine rebuilds — the replacement engine's
-    private tick counter restarts, the plan's does not. The serving
-    layer re-installs the hook on every rebuilt engine.
-    """
-
-    def __init__(self, plan: FaultPlan):
-        self.plan = plan
-        self.tick = 0                  # global serving ticks observed
-        self.fired: List[dict] = []    # log of injected faults, in order
-
-    def pending(self) -> int:
-        """Faults that have not fully fired yet."""
-        return sum(1 for f in self.plan if f.fired < f.count)
-
-    def _due(self, point: str) -> Optional[Fault]:
-        for f in self.plan:
-            if f.point == point and f.fired < f.count and self.tick >= f.tick:
-                return f
-        return None
-
-    def __call__(self, point: str, info: dict):
-        if point == "dispatch":
-            self.tick += 1
-        fault = self._due(point)
-        if fault is None:
-            return
-        fault.fired += 1
-        # plan fields win; the hook's engine-local tick (which resets on
-        # every rebuild) is kept under its own key so a fired record can
-        # be diffed against the plan without ambiguity
-        record = dict(fault.to_dict(), fired_tick=self.tick)
-        for key, value in (info or {}).items():
-            record.setdefault("engine_tick" if key == "tick" else key, value)
-        self.fired.append(record)
-        msg = (f"injected {fault.kind} at serving tick {self.tick} "
-               f"(plan tick {fault.tick}, point {point})")
-        if fault.kind == "dispatch_error":
-            raise TickDispatchError(msg, record)
-        if fault.kind == "fetch_hang":
-            raise FetchHang(msg, record)
-        raise EnginePreempted(msg, record, degrade=fault.degrade)
+__all__ = [
+    "FAULT_KINDS",
+    "HOOK_POINTS",
+    "EnginePreempted",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "FetchHang",
+    "InjectedFault",
+    "TickDispatchError",
+]

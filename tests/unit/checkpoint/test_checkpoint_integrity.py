@@ -1,0 +1,101 @@
+"""Checkpoint-integrity sidecars (runtime/checkpoint_engine/integrity.py):
+per-leaf CRC manifests, atomic commit markers, torn-tag detection, and
+the newest-first committed-tag scan the restore fallback ladder walks.
+Pure numpy + stdlib — runs in tools/ci_jaxfree_tests.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine import integrity
+
+
+def _leaves():
+    return [("params.w", np.arange(6, dtype=np.float32).reshape(2, 3)),
+            ("opt.m", np.zeros(4, dtype=np.float32))]
+
+
+class TestManifest:
+    def test_build_and_verify_roundtrip(self):
+        man = integrity.manifest_from_leaves(_leaves())
+        assert man["version"] == 1 and man["leaf_count"] == 2
+        assert man["leaves"]["params.w"]["shape"] == [2, 3]
+        assert integrity.verify_leaves(_leaves(), man) == []
+
+    def test_crc_is_layout_canonical(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        assert integrity.leaf_crc(a) == integrity.leaf_crc(
+            np.asfortranarray(a))
+
+    def test_flipped_bit_detected(self):
+        man = integrity.manifest_from_leaves(_leaves())
+        bad = _leaves()
+        bad[0][1][0, 0] += 1.0
+        problems = integrity.verify_leaves(bad, man)
+        assert len(problems) == 1 and "checksum mismatch" in problems[0]
+
+    def test_missing_and_unexpected_leaves_detected(self):
+        man = integrity.manifest_from_leaves(_leaves())
+        only_one = _leaves()[:1]
+        assert any("missing leaf" in p
+                   for p in integrity.verify_leaves(only_one, man))
+        extra = _leaves() + [("ghost", np.zeros(1))]
+        assert any("unexpected leaf" in p
+                   for p in integrity.verify_leaves(extra, man))
+
+
+class TestCommitMarker:
+    def test_marker_roundtrip_and_atomic_write(self, tmp_path):
+        tag = tmp_path / "global_step3"
+        tag.mkdir()
+        assert not integrity.is_committed(str(tag))
+        integrity.write_commit_marker(str(tag), extra={"leaf_count": 2})
+        assert integrity.is_committed(str(tag))
+        marker = json.loads((tag / integrity.COMMIT_MARKER).read_text())
+        assert marker["committed"] is True and marker["leaf_count"] == 2
+        # no tmp litter left behind by the atomic replace
+        assert all(not n.endswith(f".tmp.{os.getpid()}")
+                   for n in os.listdir(tag))
+
+    def test_write_json_atomic_replaces(self, tmp_path):
+        p = tmp_path / "f.json"
+        integrity.write_json_atomic(str(p), {"v": 1})
+        integrity.write_json_atomic(str(p), {"v": 2})
+        assert json.loads(p.read_text()) == {"v": 2}
+
+
+class TestTagScan:
+    def _mk(self, root, step, committed):
+        d = root / f"global_step{step}"
+        d.mkdir()
+        if committed:
+            integrity.write_commit_marker(str(d))
+
+    def test_scan_newest_first_with_commit_bits(self, tmp_path):
+        self._mk(tmp_path, 2, True)
+        self._mk(tmp_path, 10, False)   # torn
+        self._mk(tmp_path, 6, True)
+        (tmp_path / "not_a_tag").mkdir()
+        (tmp_path / "global_step9").write_text("a file, not a tag dir")
+        scanned = integrity.scan_tags(str(tmp_path))
+        assert scanned == [(10, "global_step10", False),
+                           (6, "global_step6", True),
+                           (2, "global_step2", True)]
+        assert integrity.latest_committed_tag(str(tmp_path)) == "global_step6"
+
+    def test_empty_and_missing_dirs(self, tmp_path):
+        assert integrity.scan_tags(str(tmp_path / "nope")) == []
+        assert integrity.latest_committed_tag(str(tmp_path)) is None
+
+    def test_tag_step_parsing(self):
+        assert integrity.tag_step("global_step42") == 42
+        assert integrity.tag_step("my_tag") is None
+
+
+class TestTornCheckpointError:
+    def test_taxonomy(self):
+        assert issubclass(integrity.TornCheckpointError, RuntimeError)
+        with pytest.raises(integrity.TornCheckpointError):
+            raise integrity.TornCheckpointError("torn")

@@ -1,0 +1,354 @@
+"""The PR's parity gate: fault-injected training through the
+TrainSupervisor must produce a per-step loss stream BITWISE identical to
+the fault-free run at the same world size — clean micro-dispatch retries,
+poisoned-engine rebuilds from host snapshots, torn checkpoint writes
+refused at restore with fallback to the previous good tag, and
+whole-process preemptions resumed from disk. Plus the engine-level
+integrity/atomicity seams and the elastic degraded restart (2 -> 1 via
+the triad recompute)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.faults import (
+    TrainFault,
+    TrainFaultInjector,
+    TrainFaultPlan,
+)
+from deepspeed_tpu.runtime.checkpoint_engine import integrity
+from deepspeed_tpu.runtime.dataloader import TpuDataLoader
+from deepspeed_tpu.runtime.resilience import (
+    TrainingFailed,
+    TrainSupervisor,
+)
+
+HIDDEN = 8
+BATCH = 16
+
+
+def _loss_fn(params, batch, rng):
+    import jax.numpy as jnp
+
+    return jnp.mean((batch["x"] @ params["block"]["w"] + params["block"]["b"]) ** 2)
+
+
+def _params():
+    import jax.numpy as jnp
+
+    return {"block": {"w": jnp.full((HIDDEN, HIDDEN), 0.25, jnp.float32),
+                      "b": jnp.zeros((HIDDEN,), jnp.float32)}}
+
+
+def _config(world=8, micro=1):
+    return {
+        "train_batch_size": BATCH,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 5, "warmup_max_lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": 1, "fsdp": world},
+        "steps_per_print": 10_000,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": BATCH,
+            "micro_batch_sizes": [1, 2, 4, 8],
+            "min_gpus": 1,
+            "max_gpus": 8,
+            "version": 0.2,
+        },
+    }
+
+
+def _dataset(n=64, seed=11):
+    rng = np.random.default_rng(seed)
+    return [{"x": rng.normal(size=(HIDDEN,)).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _loader(seed=0):
+    return TpuDataLoader(_dataset(), batch_size=BATCH, seed=seed, shuffle=True)
+
+
+def make_factory(base_config):
+    """PR-7 style engine factory: rebuilds get a fresh mesh (a device
+    subset when mesh_shape names a smaller world) and a fresh engine."""
+
+    def factory(config=None, mesh_shape=None):
+        cfg = dict(config if config is not None else base_config)
+        if mesh_shape is not None:
+            cfg["mesh"] = dict(mesh_shape)
+        comm.destroy()
+        world = int(np.prod([s for s in cfg["mesh"].values() if s > 0]))
+        devices = (jax.devices()[:world]
+                   if 0 < world < len(jax.devices()) else None)
+        mesh = comm.init_distributed(mesh_shape=cfg["mesh"], devices=devices,
+                                     verbose=False)
+        engine, *_ = deepspeed_tpu.initialize(
+            loss_fn=_loss_fn, params=_params(), config=cfg, mesh=mesh)
+        return engine
+
+    return factory
+
+
+def _run_fault_free(num_steps, recovery=None):
+    sup = TrainSupervisor(make_factory(_config()), _loader(),
+                          recovery=recovery)
+    return sup.run(num_steps), sup
+
+
+class TestBitwiseParity:
+    def test_chaos_run_matches_fault_free_bitwise(self, tmp_path):
+        """The acceptance plan: a clean micro-dispatch retry (step 3), a
+        torn checkpoint write (step 4, refused at the step-5 preemption's
+        disk restore with fallback to the step-2 tag), a whole-process
+        preemption (step 5), and a fetch-timeout poisoning (step 7,
+        rebuilt from the in-memory step-6 snapshot). The per-step loss
+        stream over 8 steps must equal the fault-free run's bit for bit."""
+        ref_losses, _ = _run_fault_free(8)
+
+        plan = TrainFaultPlan([
+            TrainFault(tick=3, kind="dispatch_error"),
+            TrainFault(tick=4, kind="torn_write"),
+            TrainFault(tick=5, kind="preempt"),
+            TrainFault(tick=7, kind="fetch_hang"),
+        ])
+        inj = TrainFaultInjector(plan)
+        snap_dir = str(tmp_path / "snaps")
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"snapshot_every_n_steps": 2, "snapshot_dir": snap_dir,
+                      "backoff_s": 0.0})
+        losses = sup.run(8)
+
+        assert inj.pending() == 0, "every planned fault must have fired"
+        assert [f["kind"] for f in inj.fired] == [
+            "dispatch_error", "torn_write", "preempt", "fetch_hang"]
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+
+        stats = sup.recovery_stats()
+        assert stats["retries"] == 1          # the clean step-3 retry
+        assert stats["rebuilds"] == 2         # disk restore + memory rebuild
+        assert stats["torn_writes"] == 1
+        assert stats["faults"] >= 4
+        # the replay re-saved global_step4 cleanly over the torn tag
+        assert integrity.is_committed(os.path.join(snap_dir, "global_step4"))
+        assert integrity.latest_committed_tag(snap_dir) == "global_step8"
+
+    def test_replayable_from_jsonl_plan(self, tmp_path):
+        """The plan round-trips through JSONL and drives an identical
+        chaos run — the replayability leg of the acceptance gate."""
+        plan = TrainFaultPlan([TrainFault(tick=2, kind="dispatch_error"),
+                               TrainFault(tick=3, kind="preempt")])
+        plan_path = str(tmp_path / "plan.jsonl")
+        plan.dump(plan_path)
+        ref_losses, _ = _run_fault_free(4)
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(),
+            fault_hook=TrainFaultInjector(TrainFaultPlan.load(plan_path)),
+            recovery={"snapshot_every_n_steps": 2,
+                      "snapshot_dir": str(tmp_path / "s"), "backoff_s": 0.0})
+        losses = sup.run(4)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+
+    def test_preempt_before_any_snapshot_cold_restarts_bitwise(self, tmp_path):
+        """A preemption before the first committed snapshot falls all the
+        way back to a cold restart at step 0 — still bitwise (fresh
+        deterministic init + rewound cursor)."""
+        ref_losses, _ = _run_fault_free(3)
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=2, kind="preempt")]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"snapshot_every_n_steps": 0, "backoff_s": 0.0})
+        losses = sup.run(3)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+
+    def test_async_save_torn_at_fence_still_bitwise(self, tmp_path):
+        """With ``checkpoint.async_save``, the step-4 save's sidecars ride
+        the next fence — the injected tear surfaces there (at the step-5
+        preemption's restore), is attributed to the pending global_step4
+        tag, and the restore falls back to global_step2. Bitwise parity
+        must survive the deferred-commit path too."""
+        cfg = _config()
+        cfg["checkpoint"] = {"async_save": True}
+        ref_losses, _ = _run_fault_free(6)
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=4, kind="torn_write"),
+            TrainFault(tick=5, kind="preempt")]))
+        snap_dir = str(tmp_path / "snaps")
+        sup = TrainSupervisor(
+            make_factory(cfg), _loader(), fault_hook=inj,
+            recovery={"snapshot_every_n_steps": 2, "snapshot_dir": snap_dir,
+                      "backoff_s": 0.0})
+        losses = sup.run(6)
+
+        assert inj.pending() == 0
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["torn_writes"] == 1
+        assert stats["rebuilds"] == 1          # the disk restore
+        # run() end-fences the last async save, so 'latest' is durable
+        assert integrity.latest_committed_tag(snap_dir) == "global_step6"
+
+
+class TestEscalationLadder:
+    def test_fetch_watchdog_poisons_engine(self):
+        eng = make_factory(_config(micro=2))()  # gas=1: step runs every micro
+        eng.fetch_timeout_s = 1e-12  # any real fetch overruns
+        dl = _loader()
+        batch = next(iter(dl))
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        with pytest.raises(TimeoutError, match="metrics fetch"):
+            eng.step()
+        assert eng.poisoned is True
+
+    def test_max_rebuilds_exhaustion_is_terminal(self, tmp_path):
+        # an unbounded stream of preemptions burns the whole budget
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=1, kind="preempt", count=99)]))
+        snap_dir = str(tmp_path / "s")
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"max_rebuilds": 2, "snapshot_every_n_steps": 0,
+                      "snapshot_dir": snap_dir, "backoff_s": 0.0})
+        with pytest.raises(TrainingFailed, match="max_rebuilds=2"):
+            sup.run(3)
+        assert sup.recovery_stats()["rebuilds"] == 2
+
+    def test_clean_retry_exhaustion_escalates_to_rebuild(self):
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=2, kind="dispatch_error", count=3)]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"max_step_retries": 1, "snapshot_every_n_steps": 1,
+                      "backoff_s": 0.0})
+        losses = sup.run(3)
+        stats = sup.recovery_stats()
+        # fire#1 -> retry(1) -> fire#2 exhausts the retry budget -> rebuild;
+        # the replay absorbs fire#3 with one more clean retry(2)
+        assert stats["retries"] == 2 and stats["rebuilds"] == 1
+        assert len(losses) == 3 and np.all(np.isfinite(losses))
+
+
+class TestCheckpointIntegritySeams:
+    # gas=1 configs: forward/backward/step are driven by hand here
+    def test_latest_pointer_is_atomic_and_marker_present(self, tmp_path):
+        eng = make_factory(_config(micro=2))()
+        dl = _loader()
+        it = iter(dl)
+        for _ in range(2):
+            batch = next(it)
+            loss = eng.forward(batch)
+            eng.backward(loss)
+            eng.step()
+        ckpt = str(tmp_path / "ck")
+        eng.save_checkpoint(ckpt)
+        tag_dir = os.path.join(ckpt, "global_step2")
+        assert integrity.is_committed(tag_dir)
+        manifest = integrity.read_manifest(tag_dir)
+        assert manifest is not None and manifest["leaf_count"] > 0
+        assert open(os.path.join(ckpt, "latest")).read() == "global_step2"
+        # no tmp litter from the atomic pointer/sidecar writes
+        assert not [n for n in os.listdir(ckpt) if ".tmp." in n]
+
+    def test_markerless_tag_refused_with_fallback(self, tmp_path):
+        factory = make_factory(_config(micro=2))
+        eng = factory()
+        dl = _loader()
+        it = iter(dl)
+        ckpt = str(tmp_path / "ck")
+        for step in (1, 2):
+            batch = next(it)
+            loss = eng.forward(batch)
+            eng.backward(loss)
+            eng.step()
+            eng.save_checkpoint(ckpt)
+        # tear the newest tag the way a mid-commit writer death would
+        os.remove(os.path.join(ckpt, "global_step2", integrity.COMMIT_MARKER))
+        fresh = factory()
+        path, _ = fresh.load_checkpoint(ckpt)
+        assert path.endswith("global_step1")
+        assert fresh.global_steps == 1
+
+    def test_all_torn_raises(self, tmp_path):
+        factory = make_factory(_config(micro=2))
+        eng = factory()
+        dl = _loader()
+        batch = next(iter(dl))
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+        ckpt = str(tmp_path / "ck")
+        eng.save_checkpoint(ckpt)
+        os.remove(os.path.join(ckpt, "global_step1", integrity.COMMIT_MARKER))
+        with pytest.raises(integrity.TornCheckpointError,
+                           match="no committed checkpoint"):
+            factory().load_checkpoint(ckpt)
+
+    def test_checksum_corruption_refused(self, tmp_path):
+        factory = make_factory(_config(micro=2))
+        eng = factory()
+        dl = _loader()
+        batch = next(iter(dl))
+        loss = eng.forward(batch)
+        eng.backward(loss)
+        eng.step()
+        ckpt = str(tmp_path / "ck")
+        eng.save_checkpoint(ckpt, tag="only")
+        man_path = os.path.join(ckpt, "only", integrity.MANIFEST_FILE)
+        man = json.load(open(man_path))
+        first = next(iter(man["leaves"]))
+        man["leaves"][first]["crc32"] = (man["leaves"][first]["crc32"] ^ 1)
+        integrity.write_json_atomic(man_path, man)
+        with pytest.raises(integrity.TornCheckpointError,
+                           match="integrity verification"):
+            factory().load_checkpoint(ckpt, tag="only")
+        # verification is opt-out for forensics
+        path, _ = factory().load_checkpoint(ckpt, tag="only",
+                                            verify_integrity=False)
+        assert path is not None
+
+
+class TestElasticDegradedRestart:
+    def test_degrading_preemption_resumes_at_world_1(self, tmp_path):
+        """Satellite: world 2 -> 1. A degrade=True preemption recomputes
+        the elastic batch triad via rescale_config, rebuilds on a 1-chip
+        mesh, restores the committed tag (orbax re-shards), and finishes
+        the run with finite, consistent losses."""
+        base = _config(world=2, micro=8)  # gas=1 at world 2
+        ref_losses, _ = (lambda: (
+            TrainSupervisor(make_factory(base), _loader()).run(6), None))()
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=4, kind="preempt", degrade=True)]))
+        sup = TrainSupervisor(
+            make_factory(base), _loader(), fault_hook=inj,
+            base_config=base,
+            recovery={"snapshot_every_n_steps": 2,
+                      "snapshot_dir": str(tmp_path / "s"),
+                      "degrade_world_sizes": [1], "backoff_s": 0.0})
+        losses = sup.run(6)
+        assert inj.pending() == 0
+        stats = sup.recovery_stats()
+        assert stats["rebuilds"] == 1 and stats["world_size"] == 1
+        assert sup.engine.mesh.devices.size == 1
+        assert len(losses) == 6 and np.all(np.isfinite(losses))
+        # same math at a different sharding: close, not necessarily bitwise
+        np.testing.assert_allclose(np.asarray(losses, np.float32),
+                                   np.asarray(ref_losses, np.float32),
+                                   rtol=1e-4, atol=1e-6)

@@ -1,0 +1,96 @@
+"""Policy-layer pieces of runtime/resilience.py that never touch jax:
+TrainRecoveryConfig validation/parsing, global-batch → micro-batch
+slicing, TrainSnapshot bookkeeping, TrainingFailed metadata. Runs in
+tools/ci_jaxfree_tests.py — the supervisor's decision logic must stay
+importable without an accelerator stack."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.resilience import (
+    TrainingFailed,
+    TrainRecoveryConfig,
+    TrainSnapshot,
+    leading_rows,
+    slice_micro_batches,
+)
+
+
+class TestTrainRecoveryConfig:
+    def test_defaults_and_validation(self):
+        cfg = TrainRecoveryConfig()
+        assert cfg.fetch_timeout_s is None and cfg.max_step_retries == 2
+        assert cfg.snapshot_every_n_steps == 100 and cfg.snapshot_dir is None
+        assert cfg.verify_integrity is True
+        with pytest.raises(ValueError, match="max_step_retries"):
+            TrainRecoveryConfig(max_step_retries=-1)
+        with pytest.raises(ValueError, match="backoff_s"):
+            TrainRecoveryConfig(backoff_s=-0.1)
+        with pytest.raises(ValueError, match="max_rebuilds"):
+            TrainRecoveryConfig(max_rebuilds=0)
+        with pytest.raises(ValueError, match="snapshot_every_n_steps"):
+            TrainRecoveryConfig(snapshot_every_n_steps=-1)
+        with pytest.raises(ValueError, match="fetch_timeout_s"):
+            TrainRecoveryConfig(fetch_timeout_s=0.0)
+        with pytest.raises(ValueError, match="degrade_world_sizes"):
+            TrainRecoveryConfig(degrade_world_sizes=[2, 0])
+
+    def test_parse_forms(self):
+        assert TrainRecoveryConfig.parse(None).max_step_retries == 2
+        cfg = TrainRecoveryConfig(max_rebuilds=3)
+        assert TrainRecoveryConfig.parse(cfg) is cfg
+        parsed = TrainRecoveryConfig.parse(
+            {"snapshot_every_n_steps": 2, "snapshot_dir": "/tmp/x"})
+        assert parsed.snapshot_every_n_steps == 2
+        with pytest.raises(TypeError, match="TrainRecoveryConfig or dict"):
+            TrainRecoveryConfig.parse("fast")
+
+
+class TestMicroSlicing:
+    def test_dict_batch_slices_row_contiguously(self):
+        batch = {"x": np.arange(24).reshape(12, 2),
+                 "y": np.arange(12)}
+        assert leading_rows(batch) == 12
+        micros = slice_micro_batches(batch, 3)
+        assert len(micros) == 3
+        assert micros[0]["x"].shape == (4, 2)
+        np.testing.assert_array_equal(micros[1]["y"], np.arange(4, 8))
+        # concatenating the micros reconstructs the global batch exactly
+        np.testing.assert_array_equal(
+            np.concatenate([m["x"] for m in micros]), batch["x"])
+
+    def test_nested_and_tuple_batches(self):
+        batch = ({"a": np.zeros((8, 3))}, np.ones((8,)))
+        micros = slice_micro_batches(batch, 2)
+        assert isinstance(micros[0], tuple)
+        assert micros[0][0]["a"].shape == (4, 3)
+
+    def test_gas_one_is_identity(self):
+        batch = {"x": np.arange(6)}
+        (only,) = slice_micro_batches(batch, 1)
+        np.testing.assert_array_equal(only["x"], batch["x"])
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError, match="does not split"):
+            slice_micro_batches({"x": np.zeros((10, 1))}, 3)
+        with pytest.raises(ValueError, match="does not split"):
+            slice_micro_batches({"x": np.zeros((10, 1))}, 0)
+
+
+class TestSnapshotAndFailure:
+    def test_snapshot_client_state_copy(self):
+        snap = TrainSnapshot(
+            step=4, host_tree={"w": np.zeros(2)}, manifest=None,
+            meta={"client_state": {"rng_key": [1, 2],
+                                   "data_cursor": {"epoch": 0, "batch": 4}}},
+            rng_key=np.asarray([1, 2], dtype=np.uint32))
+        cs = snap.client_state()
+        cs["rng_key"] = [9, 9]  # mutating the copy...
+        assert snap.meta["client_state"]["rng_key"] == [1, 2]
+
+    def test_training_failed_carries_resume_metadata(self):
+        err = TrainingFailed("boom", steps_completed=7,
+                             last_committed_tag="global_step6")
+        assert isinstance(err, RuntimeError)
+        assert err.steps_completed == 7
+        assert err.last_committed_tag == "global_step6"

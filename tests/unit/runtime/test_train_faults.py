@@ -1,0 +1,148 @@
+"""Train-domain fault plumbing (deepspeed_tpu/faults.py): TrainFault
+validation / plan synthesis / JSONL round-trip keyed on the global
+optimizer step, TrainFaultInjector firing semantics off ``info["step"]``,
+and the shared-module re-export contract the serving shim relies on.
+No jax, no engine — runs in tools/ci_jaxfree_tests.py."""
+
+import dataclasses
+
+import pytest
+
+from deepspeed_tpu.faults import (
+    TRAIN_FAULT_KINDS,
+    MicroDispatchError,
+    StepFetchHang,
+    InjectedFault,
+    TornCheckpointWrite,
+    TrainFault,
+    TrainFaultInjector,
+    TrainFaultPlan,
+    TrainPreempted,
+)
+
+
+class TestTrainFaultPlan:
+    def test_fault_validation_and_default_points(self):
+        assert TrainFault(tick=3, kind="dispatch_error").point == "micro_dispatch"
+        assert TrainFault(tick=3, kind="fetch_hang").point == "step_fetch"
+        assert TrainFault(tick=3, kind="torn_write").point == "checkpoint_write"
+        assert TrainFault(tick=3, kind="preempt").point == "preempt"
+        assert TrainFault(tick=4, kind="preempt").step == 4
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            TrainFault(tick=1, kind="meteor_strike")
+        with pytest.raises(ValueError, match="unknown hook point"):
+            TrainFault(tick=1, kind="preempt", point="teatime")
+        with pytest.raises(ValueError, match="step must be >= 0"):
+            TrainFault(tick=-1, kind="preempt")
+        with pytest.raises(ValueError, match="count must be >= 1"):
+            TrainFault(tick=1, kind="preempt", count=0)
+
+    def test_to_dict_spells_step(self):
+        d = TrainFault(tick=6, kind="torn_write").to_dict()
+        assert d["step"] == 6 and "tick" not in d
+
+    def test_plan_sorts_and_roundtrips(self, tmp_path):
+        plan = TrainFaultPlan([TrainFault(tick=9, kind="fetch_hang"),
+                               TrainFault(tick=2, kind="dispatch_error", count=3),
+                               TrainFault(tick=5, kind="preempt", degrade=True)])
+        assert [f.step for f in plan] == [2, 5, 9]
+        path = tmp_path / "plan.jsonl"
+        plan.dump(str(path))
+        loaded = TrainFaultPlan.load(str(path))
+        assert [dataclasses.asdict(f) for f in loaded] == \
+            [dataclasses.asdict(f) for f in plan]
+        assert loaded.faults[1].degrade is True
+        assert loaded.faults[0].count == 3
+
+    def test_load_accepts_legacy_tick_key(self, tmp_path):
+        path = tmp_path / "plan.jsonl"
+        path.write_text('{"tick": 4, "kind": "preempt", "point": "preempt"}\n')
+        loaded = TrainFaultPlan.load(str(path))
+        assert loaded.faults[0].step == 4
+
+    def test_load_empty_rejected(self, tmp_path):
+        path = tmp_path / "empty.jsonl"
+        path.write_text("\n")
+        with pytest.raises(ValueError, match="no fault records"):
+            TrainFaultPlan.load(str(path))
+
+    def test_synth_seeded_and_deterministic(self):
+        a = TrainFaultPlan.synth(seed=7, n_faults=5, first_tick=3, tick_span=50)
+        b = TrainFaultPlan.synth(seed=7, n_faults=5, first_tick=3, tick_span=50)
+        assert [f.to_dict() for f in a] == [f.to_dict() for f in b]
+        assert len(a) == 5
+        assert all(3 <= f.step < 53 for f in a)
+        assert all(f.kind in TRAIN_FAULT_KINDS for f in a)
+        c = TrainFaultPlan.synth(seed=8, n_faults=5, first_tick=3, tick_span=50)
+        assert [f.to_dict() for f in a] != [f.to_dict() for f in c]
+        d = TrainFaultPlan.synth(seed=7, n_faults=2, degrade_last=True)
+        assert d.faults[-1].kind == "preempt" and d.faults[-1].degrade
+
+
+class TestTrainFaultInjector:
+    def test_clock_reads_info_step_and_fires_once(self):
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=2, kind="dispatch_error"),
+            TrainFault(tick=4, kind="preempt", degrade=True)]))
+        inj("micro_dispatch", {"step": 1, "micro": 0})  # nothing due
+        with pytest.raises(MicroDispatchError) as ei:
+            inj("micro_dispatch", {"step": 2, "micro": 0})
+        assert ei.value.fault["kind"] == "dispatch_error"
+        assert ei.value.fault["fired_tick"] == 2
+        inj("micro_dispatch", {"step": 2, "micro": 0})  # exhausted: no refire
+        inj("preempt", {"step": 3})
+        with pytest.raises(TrainPreempted) as ep:
+            inj("preempt", {"step": 4})
+        assert ep.value.degrade is True
+        assert inj.pending() == 0
+        assert [f["kind"] for f in inj.fired] == ["dispatch_error", "preempt"]
+
+    def test_clock_survives_engine_rebuild(self):
+        # the step clock comes from info["step"] (the restored engine's
+        # counter), so a fresh hook installation keeps the plan position
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=5, kind="fetch_hang")]))
+        inj("step_fetch", {"step": 3})
+        inj2_view = inj  # same injector re-armed on the rebuilt engine
+        with pytest.raises(StepFetchHang) as ei:
+            inj2_view("step_fetch", {"step": 5})
+        assert isinstance(ei.value, TimeoutError)   # watchdog taxonomy
+        assert isinstance(ei.value, InjectedFault)
+        inj("step_fetch", {"step": 6})              # exhausted
+
+    def test_torn_write_at_checkpoint_point(self):
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=4, kind="torn_write")]))
+        inj("checkpoint_write", {"step": 2, "tag": "global_step2"})
+        with pytest.raises(TornCheckpointWrite) as ei:
+            inj("checkpoint_write", {"step": 4, "tag": "global_step4"})
+        assert ei.value.fault["tag"] == "global_step4"
+        inj("checkpoint_write", {"step": 6, "tag": "global_step6"})
+
+    def test_persistent_fault_fires_count_times(self):
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=1, kind="dispatch_error", count=3)]))
+        for _ in range(3):
+            with pytest.raises(MicroDispatchError):
+                inj("micro_dispatch", {"step": 1, "micro": 0})
+        inj("micro_dispatch", {"step": 1, "micro": 0})  # drained
+        assert len(inj.fired) == 3
+
+
+class TestSharedModuleContract:
+    def test_serving_shim_reexports_same_objects(self):
+        import deepspeed_tpu.faults as shared
+        import deepspeed_tpu.serving.faults as shim
+
+        assert shim.Fault is shared.Fault
+        assert shim.FaultPlan is shared.FaultPlan
+        assert shim.FaultInjector is shared.FaultInjector
+        assert shim.EnginePreempted is shared.EnginePreempted
+        assert shim.InjectedFault is shared.InjectedFault
+
+    def test_train_and_serving_taxonomies_share_base(self):
+        from deepspeed_tpu.faults import EnginePreempted
+
+        assert issubclass(MicroDispatchError, InjectedFault)
+        assert issubclass(TrainPreempted, InjectedFault)
+        assert not issubclass(TrainPreempted, EnginePreempted)

@@ -219,6 +219,83 @@ def test_cli_serve_flag(tmp_path):
     assert "no serving events" in proc.stderr
 
 
+# ---------------------------------------------------------------------------
+# --train: TrainSupervisor recovery scorecard over train_fault events
+# ---------------------------------------------------------------------------
+
+def _train_fault_events():
+    mk = lambda ev, **f: dict({"schema": 1, "kind": "train_fault",
+                               "event": ev}, **f)
+    return [
+        mk("fault", error="MicroDispatchError", step=3),
+        mk("retried", step=3, micro=0, attempt=1),
+        mk("fault", error="TrainPreempted", step=5),
+        mk("ckpt_torn", step=4, tag="global_step4", detail="injected"),
+        mk("ckpt_refused", tag="global_step4", reason="missing marker"),
+        mk("rebuild", step=5, source="disk", resume_step=2,
+           replayed_steps=2, recovery_ms=120.5, rebuilds=1,
+           degraded=False, world_size=8),
+        mk("rebuild", step=7, source="memory", resume_step=6,
+           replayed_steps=0, recovery_ms=80.1, rebuilds=2,
+           degraded=True, world_size=4),
+        mk("snapshot", step=2, tag="global_step2", checkpoint_ms=12.0,
+           committed=True),
+        mk("snapshot", step=4, tag="global_step4", checkpoint_ms=14.0,
+           committed=False),
+        {"schema": 1, "kind": "train_step", "step_ms": 500.0},
+        {"schema": 1, "kind": "train_step", "step_ms": 540.0},
+    ]
+
+
+def test_train_table():
+    table = ds_trace_report.train_table(_train_fault_events())
+    assert table["faults"] == 2 and table["retries"] == 1
+    assert table["rebuilds"] == 2
+    assert table["rebuilds_by_source"] == {"disk": 1, "memory": 1}
+    assert table["replayed_steps"] == 2
+    assert table["degraded_rebuilds"] == 1 and table["final_world_size"] == 4
+    assert table["recovery_ms_max"] == 120.5
+    assert table["snapshots"] == 2 and table["snapshots_committed"] == 1
+    assert table["checkpoint_ms_max"] == 14.0
+    assert table["torn_writes"] == 1 and table["refused_tags"] == 1
+    assert table["terminal_failures"] == 0
+    # 26 ms of checkpointing over 1040 ms of stepping
+    assert table["snapshot_overhead_frac"] == 0.025
+
+    text = ds_trace_report.format_train_table(table)
+    assert "faults 2" in text and "rebuilds 2" in text
+    assert "disk=1" in text and "memory=1" in text
+    assert "torn writes 1" in text and "refused tags 1" in text
+    assert "2.50% of step time" in text
+    assert "TERMINAL" not in text
+
+
+def test_train_table_empty_without_train_faults():
+    events = [{"schema": 1, "kind": "train_step", "step_ms": 1.0}]
+    assert ds_trace_report.train_table(events) == {}
+    assert ds_trace_report.format_train_table({}) == ""
+
+
+def test_cli_train_flag(tmp_path):
+    trace = tmp_path / "train.jsonl"
+    trace.write_text("\n".join(json.dumps(e)
+                               for e in _train_fault_events()) + "\n")
+    proc = subprocess.run(
+        [sys.executable, CLI, str(trace), "--train", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    table = json.loads(proc.stdout)["train"]
+    assert table["rebuilds"] == 2 and table["snapshots"] == 2
+    # a trace with no train_fault events exits 1 (same contract as --serve)
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--train"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no train_fault events" in proc.stderr
+
+
 def test_cli_json_mode():
     proc = subprocess.run(
         [sys.executable, CLI, FIXTURE, "--json", "--kind", "inference_request"],

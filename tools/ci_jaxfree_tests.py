@@ -31,13 +31,17 @@ import types
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# test files in the jax-free stage (tests/unit/serving)
+# test files in the jax-free stage (serving bookkeeping + the train
+# column's fault plans / recovery policy / checkpoint-integrity sidecars)
 JAXFREE_TESTS = [
     "tests/unit/serving/test_router.py",
     "tests/unit/serving/test_recovery_log.py",
     "tests/unit/serving/test_policies.py",
     "tests/unit/serving/test_faults.py",
     "tests/unit/serving/test_shed_hints.py",
+    "tests/unit/runtime/test_train_faults.py",
+    "tests/unit/runtime/test_resilience_policy.py",
+    "tests/unit/checkpoint/test_checkpoint_integrity.py",
 ]
 
 

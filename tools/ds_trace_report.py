@@ -409,6 +409,119 @@ def format_serve_table(table):
     return "\n".join(lines) + "\n"
 
 
+def train_table(events):
+    """Training-run recovery scorecard over ``train_fault`` events (the
+    TrainSupervisor's fault/recovery journal — docs/telemetry.md) plus
+    per-step ``train_step`` timing when present: observed faults and
+    clean micro-step retries, engine rebuilds split by restore source
+    (memory snapshot / disk checkpoint / cold restart) with replayed
+    steps and recovery_ms percentiles, snapshot cadence with
+    checkpoint_ms percentiles, torn checkpoint writes and refused tags
+    (the integrity walk's evidence), degraded restarts with the final
+    world size, and terminal failures. Empty dict when the trace holds
+    no training fault activity."""
+    faults = [e for e in events if e.get("kind") == "train_fault"]
+    if not faults:
+        return {}
+    by_event = {}
+    for e in faults:
+        by_event.setdefault(e.get("event", "?"), []).append(e)
+    rebuilds = by_event.get("rebuild", [])
+    snapshots = by_event.get("snapshot", [])
+    out = {"fault_events": len(faults),
+           "faults": len(by_event.get("fault", [])),
+           "retries": len(by_event.get("retried", [])),
+           "rebuilds": len(rebuilds)}
+    by_source = {}
+    for e in rebuilds:
+        src = str(e.get("source", "?"))
+        by_source[src] = by_source.get(src, 0) + 1
+    if by_source:
+        out["rebuilds_by_source"] = by_source
+    out["replayed_steps"] = sum(int(e.get("replayed_steps", 0))
+                                for e in rebuilds)
+    degraded = [e for e in rebuilds if e.get("degraded") is True]
+    if degraded:
+        out["degraded_rebuilds"] = len(degraded)
+        ws = [int(e["world_size"]) for e in degraded
+              if isinstance(e.get("world_size"), int)
+              and not isinstance(e.get("world_size"), bool)]
+        if ws:
+            out["final_world_size"] = ws[-1]
+    rms = sorted(float(e["recovery_ms"]) for e in rebuilds
+                 if isinstance(e.get("recovery_ms"), (int, float))
+                 and not isinstance(e.get("recovery_ms"), bool))
+    if rms:
+        out["recovery_ms_p50"] = percentile(rms, 50.0)
+        out["recovery_ms_max"] = rms[-1]
+    if snapshots:
+        out["snapshots"] = len(snapshots)
+        out["snapshots_committed"] = sum(1 for e in snapshots
+                                         if e.get("committed") is True)
+        cms = sorted(float(e["checkpoint_ms"]) for e in snapshots
+                     if isinstance(e.get("checkpoint_ms"), (int, float))
+                     and not isinstance(e.get("checkpoint_ms"), bool))
+        if cms:
+            out["checkpoint_ms_p50"] = percentile(cms, 50.0)
+            out["checkpoint_ms_max"] = cms[-1]
+    out["torn_writes"] = len(by_event.get("ckpt_torn", []))
+    out["refused_tags"] = len(by_event.get("ckpt_refused", []))
+    out["terminal_failures"] = len(by_event.get("failed", []))
+    # snapshot overhead against the train_step stream when both exist:
+    # checkpoint_ms total over step_ms total = the cadence's step-time tax
+    steps = [e for e in events if e.get("kind") == "train_step"]
+    step_ms = sum(float(e["step_ms"]) for e in steps
+                  if isinstance(e.get("step_ms"), (int, float))
+                  and not isinstance(e.get("step_ms"), bool))
+    ckpt_total = sum(float(e.get("checkpoint_ms", 0.0)) for e in snapshots
+                     if isinstance(e.get("checkpoint_ms"), (int, float))
+                     and not isinstance(e.get("checkpoint_ms"), bool))
+    if step_ms > 0 and ckpt_total > 0:
+        out["snapshot_overhead_frac"] = round(ckpt_total / step_ms, 4)
+    return out
+
+
+def format_train_table(table):
+    if not table:
+        return ""
+    lines = ["== training recovery (train_fault) =="]
+    lines.append(f"recovery          faults {table['faults']}"
+                 f"   retries {table['retries']}"
+                 f"   rebuilds {table['rebuilds']}"
+                 + (f" ({table['degraded_rebuilds']} degraded"
+                    f" -> world {table['final_world_size']})"
+                    if table.get("degraded_rebuilds") else ""))
+    tail = []
+    if table.get("rebuilds_by_source"):
+        srcs = " ".join(f"{k}={v}" for k, v in
+                        sorted(table["rebuilds_by_source"].items()))
+        tail.append(f"sources {srcs}")
+    if table.get("replayed_steps"):
+        tail.append(f"replayed steps {table['replayed_steps']}")
+    if "recovery_ms_p50" in table:
+        tail.append(f"recovery_ms p50 {_fmt(table['recovery_ms_p50'])}"
+                    f" max {_fmt(table['recovery_ms_max'])}")
+    if tail:
+        lines.append(f"                  {'   '.join(tail)}")
+    if table.get("snapshots"):
+        line = (f"snapshots         {table['snapshots']}"
+                f"   committed {table['snapshots_committed']}")
+        if "checkpoint_ms_p50" in table:
+            line += (f"   checkpoint_ms p50 {_fmt(table['checkpoint_ms_p50'])}"
+                     f" max {_fmt(table['checkpoint_ms_max'])}")
+        lines.append(line)
+    if "snapshot_overhead_frac" in table:
+        lines.append(f"snapshot overhead {table['snapshot_overhead_frac'] * 100:.2f}%"
+                     f" of step time")
+    if table.get("torn_writes") or table.get("refused_tags"):
+        lines.append(f"integrity         torn writes {table['torn_writes']}"
+                     f"   refused tags {table['refused_tags']}")
+    if table.get("terminal_failures"):
+        lines.append(f"                  TERMINAL failure(s): "
+                     f"{table['terminal_failures']}")
+    return "\n".join(lines) + "\n"
+
+
 def memory_table(events):
     """Per-component HBM table over ``memory_snapshot`` events (the live
     ops plane's attribution — docs/telemetry.md): peak and latest bytes
@@ -654,6 +767,11 @@ def main(argv=None):
                     help="only the serving summary (queue-wait/TTFT "
                          "percentiles, shed rate, deadline-met fraction, "
                          "goodput over ServingEngine events)")
+    ap.add_argument("--train", action="store_true",
+                    help="only the training recovery summary (faults/"
+                         "retries/rebuilds by source, snapshot cadence & "
+                         "checkpoint_ms, torn/refused checkpoints over "
+                         "TrainSupervisor train_fault events)")
     ap.add_argument("--memory", action="store_true",
                     help="only the per-component HBM table (peak + latest "
                          "bytes per chip over memory_snapshot events)")
@@ -732,6 +850,17 @@ def main(argv=None):
             sys.stdout.write(format_serve_table(table))
         return 0
 
+    if args.train:
+        table = train_table(events)
+        if not table:
+            print("no train_fault events in the trace", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"train": table}, indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_train_table(table))
+        return 0
+
     if args.memory:
         table = memory_table(events)
         if not table:
@@ -756,6 +885,9 @@ def main(argv=None):
             table = serve_table(events)
             if table:
                 sys.stdout.write("\n" + format_serve_table(table))
+            table = train_table(events)
+            if table:
+                sys.stdout.write("\n" + format_train_table(table))
             table = memory_table(events)
             if table:
                 sys.stdout.write("\n" + format_memory_table(table))
