@@ -110,9 +110,10 @@ EVENT_SCHEMAS = {
     "router_event": {
         # fleet router lifecycle (serving/router.py), discriminated by
         # "event": route | spillover | shed | backoff | migrated |
-        # replica_added | replica_dead | replica_drained | drain | kill |
-        # replica_recovering | replica_recovered | replica_failed |
-        # rolling_restart | rolling_restart_done
+        # rebalanced | rebalance | replica_added | replica_dead |
+        # replica_drained | drain | kill | replica_recovering |
+        # replica_recovered | replica_failed | rolling_restart |
+        # rolling_restart_done
         "required": {"event": "str"},
         "optional": {
             "replica": "str",
@@ -132,6 +133,32 @@ EVENT_SCHEMAS = {
             "lost": "int",
             "replicas": "int",
             "tick": "int",
+        },
+    },
+    "fleet_scale": {
+        # fleet autoscaler journal (serving/autoscaler.py) plus the
+        # scenario marker (serving/scenarios.py), discriminated by
+        # "event": autoscaler | scenario | scale_up | scale_down |
+        # scale_down_skipped | degrade
+        "required": {"event": "str"},
+        "optional": {
+            "replica": "str",
+            "replicas": "int",
+            "reason": "str",
+            "from_level": "int",
+            "to_level": "int",
+            "queue_depth": "int",
+            "shed_recent": "int",
+            "committed_frac": "number",
+            "breakers_open": "int",
+            "tick": "int",
+            "min_replicas": "int",
+            "max_replicas": "int",
+            "cooldown_s": "number",
+            "rebalanced": "int",
+            "scenario": "str",
+            "requests": "int",
+            "seed": "int",
         },
     },
     "serving_tick": {

@@ -14,6 +14,14 @@ from deepspeed_tpu.serving.fleet import (
     attach_replica_telemetry,
 )
 from deepspeed_tpu.serving.router import FleetRouter, FleetStream
+from deepspeed_tpu.serving.autoscaler import AutoscalerConfig, FleetAutoscaler
+from deepspeed_tpu.serving.scenarios import (
+    ChaosAction,
+    Scenario,
+    TenantMix,
+    builtin_matrix,
+    scenario_scorecard,
+)
 from deepspeed_tpu.serving.faults import (
     EnginePreempted,
     Fault,
@@ -54,6 +62,9 @@ __all__ = [
     "ServingEngine", "TokenStream",
     "FleetRouter", "FleetStream", "Replica", "ReplicaTelemetry",
     "attach_replica_telemetry", "RID_STRIDE",
+    "AutoscalerConfig", "FleetAutoscaler",
+    "Scenario", "TenantMix", "ChaosAction", "builtin_matrix",
+    "scenario_scorecard",
     "SchedulerPolicy", "FifoPolicy", "PriorityPolicy", "EdfPolicy",
     "FairSharePolicy", "resolve_policy",
     "Admission", "ServeRequest",

@@ -142,6 +142,13 @@ class FleetRouter:
         self._deaths = 0
         self._ops_server = None
         self._closed = False
+        self._on_step_hooks: List[Callable] = []
+        # Degradation-ladder knobs (driven by serving.autoscaler): cap
+        # output length for no-SLO tenants, then shed batch backfill
+        # before interactive — both consulted in submit() for requests
+        # with no deadline, both journaled by the autoscaler.
+        self.cap_new_tokens_no_slo: Optional[int] = None
+        self.shed_backfill = False
         self._tele = telemetry
         for _ in range(replicas):
             self.add()
@@ -218,6 +225,96 @@ class FleetRouter:
         with self._lock:
             return list(self._replicas)
 
+    def on_step(self, fn: Callable[["FleetRouter"], None]):
+        """Register a recurring hook run at the END of every ``step()``
+        (after replicas stepped, before gauges) — the autoscaler's
+        attachment point: its policy reads/acts on the main thread, so
+        its ``fleet_scale`` events hit the trace writer safely."""
+        self._on_step_hooks.append(fn)
+
+    @property
+    def telemetry(self):
+        """The fleet's base telemetry hub (events + metrics registry)."""
+        return self._tele
+
+    def scale_in_candidate(self) -> Optional[str]:
+        """The replica an autoscaler may safely drain, or None.
+
+        Residue-aware: never the last placeable replica, never a
+        non-healthy one, and — the scale-in correctness rule — never a
+        replica that holds the ONLY copy of a recovering request's
+        RecoveryLog residue (breaker open or engine health not ``ok``
+        while outstanding ``residue_tokens`` remain: draining it would
+        strand mid-stream state no survivor has). Among the eligible,
+        prefer the emptiest (least residue, then least committed KV)."""
+        with self._lock:
+            if self._placeable_count() <= 1:
+                return None
+            reps = [r for r in self._replicas.values()
+                    if r.state == HEALTHY]
+        eligible = []
+        for rep in reps:
+            st = rep.serving.statusz()
+            residue = int(st.get("residue_tokens", 0))
+            if residue > 0 and (st.get("breaker_open")
+                                or rep.serving.health() != "ok"):
+                continue  # sole copy of recovering residue: not drainable
+            eligible.append((residue, rep.serving.committed_tokens(),
+                             rep.slot, rep.replica_id))
+        if not eligible:
+            return None
+        return min(eligible)[3]
+
+    def rebalance_queued(self, max_moves: Optional[int] = None) -> int:
+        """Spread host-side QUEUED (never-started) requests across the
+        fleet: pop entries off the deepest healthy queue and re-admit
+        them on a lighter replica until depths are within one of each
+        other (or ``max_moves``). Returns the number moved.
+
+        Why this exists: placement happens at submit time, so a burst
+        that lands on a small fleet stays trapped on the old replicas'
+        queues — ``add()``-ing a replica only helps FUTURE arrivals. The
+        autoscaler calls this right after scale-out so new capacity
+        rescues the very burst that triggered it. Only queued entries
+        move (``engine_rid`` None — no KV state, no stream to resume);
+        running streams stay pinned where their cache lives. A request
+        is released from its source only AFTER a survivor admitted it,
+        so a failed placement leaves it exactly where it was."""
+        moved = 0
+        while max_moves is None or moved < max_moves:
+            with self._lock:
+                reps = [r for r in self._replicas.values()
+                        if r.state == HEALTHY]
+            if len(reps) < 2:
+                break
+            depths = sorted((int(r.serving.statusz()["queue_depth"]),
+                             r.slot, r) for r in reps)
+            (lo, _, dst), (hi, _, src) = depths[0], depths[-1]
+            if hi - lo <= 1:
+                break  # balanced: moving more would just shuffle work
+            queued = [e for e
+                      in src.serving.recovery_snapshot(include_queued=True)
+                      if e.get("engine_rid") is None]
+            if not queued:
+                break  # statusz raced a drain; nothing concrete to move
+            entry = queued[-1]  # tail = least-urgent under the policy
+            lrid = entry["rid"]
+            frid = src.local_to_fleet.get(lrid)
+            old = src.serving.request(lrid)
+            if frid is None or old is None:
+                break
+            # target ONLY the shallowest queue: each move strictly
+            # shrinks the imbalance, so the loop terminates
+            if not self._place_entry(entry, src, frid, old.on_token,
+                                     event="rebalanced", targets=[dst]):
+                break  # the lightest replica won't admit it; keep at src
+            src.serving.release(lrid)
+            moved += 1
+        if moved:
+            self._event({"event": "rebalance", "migrated": moved})
+            self._flush_events()
+        return moved
+
     # -- routing --------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                priority: int = 0, tenant: str = "default",
@@ -232,6 +329,16 @@ class FleetRouter:
         fleet-scoped."""
         self._submitted += 1
         self._counter("fleet_submitted_total")
+        if deadline_ms is None:   # degradation ladder: no-SLO traffic
+            if self.shed_backfill:
+                self._shed += 1
+                self._counter("fleet_shed_total")
+                self._event({"event": "shed",
+                             "reason": "degraded_backfill"})
+                return Admission(status=SHED, reason="degraded_backfill")
+            if self.cap_new_tokens_no_slo is not None:
+                max_new_tokens = min(int(max_new_tokens),
+                                     int(self.cap_new_tokens_no_slo))
         need = int(np.asarray(prompt_ids, np.int32).reshape(-1).size) \
             + int(max_new_tokens)
         now = self._clock()
@@ -345,6 +452,8 @@ class FleetRouter:
             if rep.state == DRAINING and not rep.serving.has_work():
                 self._retire(rep)
         self._advance_rolling()
+        for fn in list(self._on_step_hooks):
+            fn(self)
         self._flush_events()
         self._update_gauges()
         return out
@@ -402,12 +511,17 @@ class FleetRouter:
         self._update_gauges()
 
     def _place_entry(self, entry: dict, dead: Replica, frid: int,
-                     on_token) -> bool:
-        """Try every survivor (least-loaded first) for one recovery
-        entry. True when one admitted/queued it — the route now points
-        there and the stream continues."""
+                     on_token, event: str = "migrated",
+                     targets: Optional[List[Replica]] = None) -> bool:
+        """Try every survivor (least-loaded first, or the explicit
+        ``targets`` list in order) for one recovery entry. True when one
+        admitted/queued it — the route now points there and the stream
+        continues. ``event`` discriminates death migration
+        (``migrated``, counted as such) from queue rebalancing
+        (``rebalanced``, counted separately: nothing died)."""
         now = self._clock()
-        for surv in self._candidates(now):
+        cands = targets if targets is not None else self._candidates(now)
+        for surv in cands:
             if surv is dead:
                 continue
             try:
@@ -419,11 +533,14 @@ class FleetRouter:
             with self._lock:
                 self._routes[frid] = (surv.replica_id, adm.rid)
             surv.local_to_fleet[adm.rid] = frid
-            surv.migrated_in += 1
-            self._migrated += 1
-            self._counter("fleet_migrated_total")
+            if event == "migrated":
+                surv.migrated_in += 1
+                self._migrated += 1
+                self._counter("fleet_migrated_total")
+            else:
+                self._counter("fleet_rebalanced_total")
             self._event({
-                "event": "migrated", "request": frid,
+                "event": event, "request": frid,
                 "from_replica": dead.replica_id,
                 "to_replica": surv.replica_id,
                 "tokens_emitted": len(entry.get("emitted", [])),
@@ -666,6 +783,15 @@ class FleetRouter:
         return self._ops_server
 
     # -- aggregate views (ds_loadgen drives these) ----------------------
+    def steppable_engines(self) -> List[Tuple[str, object]]:
+        """``(replica_id, serving_engine)`` for every in-rotation replica
+        — the autoscaler's actuation surface (per-engine ``kv_budget``
+        tightening on the degradation ladder)."""
+        with self._lock:
+            return [(r.replica_id, r.serving)
+                    for r in self._replicas.values()
+                    if r.state in STEPPABLE]
+
     @property
     def vocab_size(self) -> int:
         return next(iter(self._replicas.values())).serving.vocab_size

@@ -1,0 +1,326 @@
+"""Fleet autoscaler (serving/autoscaler.py) — jax-free (FakeEngine),
+part of the fast pre-tier-1 CI stage (tools/ci_jaxfree_tests.py).
+
+The hysteresis proofs the ISSUE names live here: a sawtooth load gets
+at most one scale decision per cooldown window, and the degradation
+ladder's entry/exit is symmetric (same rungs, reverse order) with every
+transition journaled as a ``fleet_scale`` event."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine  # noqa: E402
+
+from deepspeed_tpu.serving.autoscaler import AutoscalerConfig, FleetAutoscaler
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.router import FleetRouter
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+VOCAB = 997
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class HubStub:
+    def __init__(self):
+        self.enabled = True
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+    def close(self):
+        pass
+
+    def of_kind(self, kind, event=None):
+        return [p for k, p in self.events
+                if k == kind and (event is None or p.get("event") == event)]
+
+
+def make_fleet(n=1, clock=None, slots=2, kv_budget=None, telemetry=None):
+    clock = clock or FakeClock()
+
+    def factory(replica_id):
+        kw = {} if kv_budget is None else {"kv_budget_tokens": kv_budget}
+        return ServingEngine(FakeEngine(vocab_size=VOCAB, cache_len=64,
+                                        slots=slots), clock=clock, **kw)
+
+    return FleetRouter(factory, replicas=n, clock=clock,
+                       telemetry=telemetry), clock
+
+
+def submit_burst(router, n, max_new=12, prompt=4):
+    admitted = []
+    for _ in range(n):
+        adm = router.submit(list(range(prompt)), max_new_tokens=max_new)
+        if adm:
+            admitted.append(adm.rid)
+    return admitted
+
+
+def tick(router, clock, n=1, dt=0.05):
+    for _ in range(n):
+        router.step()
+        clock.advance(dt)
+
+
+class TestScaleOut:
+    def test_queue_pressure_adds_replica(self):
+        hub = HubStub()
+        router, clock = make_fleet(1, slots=2, telemetry=hub)
+        scaler = FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=3, cooldown_s=0.1), clock=clock)
+        submit_burst(router, 8)  # 2 run, 6 queue on the single replica
+        tick(router, clock, 2)
+        assert router.statusz()["placeable"] == 2
+        ups = hub.of_kind("fleet_scale", "scale_up")
+        assert ups and ups[0]["replicas"] == 2
+        assert ups[0]["queue_depth"] >= 4
+        assert scaler.scale_ups == 1
+        assert hub.registry.counter(
+            "fleet_scale_up_total").value == scaler.scale_ups
+        # the new replica rescues the backlog that TRIGGERED the
+        # scale-out, not just future arrivals: queued requests spread
+        assert ups[0]["rebalanced"] >= 1
+        assert len(hub.of_kind("router_event", "rebalanced")) \
+            == ups[0]["rebalanced"]
+
+    def test_never_above_max_replicas(self):
+        router, clock = make_fleet(1, slots=1, telemetry=HubStub())
+        FleetAutoscaler(router, AutoscalerConfig(
+            max_replicas=2, cooldown_s=0.0), clock=clock)
+        submit_burst(router, 12, max_new=20)
+        tick(router, clock, 30)
+        assert router.statusz()["placeable"] <= 2
+
+    def test_attach_emits_config_marker(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=4, cooldown_s=1.5), clock=clock)
+        marks = hub.of_kind("fleet_scale", "autoscaler")
+        assert marks == [{"event": "autoscaler", "min_replicas": 1,
+                          "max_replicas": 4, "cooldown_s": 1.5,
+                          "replicas": 2}]
+
+
+class TestScaleIn:
+    def _calm(self, router, clock, ticks=40):
+        tick(router, clock, ticks)
+
+    def test_sustained_calm_drains_down_to_min(self):
+        hub = HubStub()
+        router, clock = make_fleet(3, telemetry=hub)
+        scaler = FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=3, cooldown_s=0.2,
+            down_stable_ticks=4), clock=clock)
+        self._calm(router, clock, 60)
+        assert router.statusz()["placeable"] == 1
+        assert scaler.scale_downs == 2
+        downs = hub.of_kind("fleet_scale", "scale_down")
+        assert [d["replicas"] for d in downs] == [2, 1]
+        # graceful exit: drained, not dead — nothing lost
+        assert router.statusz()["lost"] == 0
+        assert hub.registry.counter("fleet_scale_down_total").value == 2
+
+    def test_never_below_min_replicas(self):
+        router, clock = make_fleet(2, telemetry=HubStub())
+        FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=2, max_replicas=4, cooldown_s=0.0,
+            down_stable_ticks=2), clock=clock)
+        self._calm(router, clock, 40)
+        assert router.statusz()["placeable"] == 2
+
+    def test_residue_refusal_journals_skip(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        # give BOTH replicas recovering residue: mid-stream work plus an
+        # open breaker — scale_in_candidate must refuse each (sole copy
+        # of a recovering request's RecoveryLog residue)
+        submit_burst(router, 4, max_new=30)
+        tick(router, clock, 2)
+        for _rid, eng in router.steppable_engines():
+            assert eng.statusz()["residue_tokens"] > 0
+            eng._breaker_open = True
+        FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=2, cooldown_s=0.0,
+            down_stable_ticks=1, down_occupancy=1.0), clock=clock)
+        router.step()  # occupancy low + queue 0? queue may be nonzero;
+        clock.advance(0.05)
+        # force the underload path by draining the queue first
+        tick(router, clock, 40)
+        # breakers stay open (we pinned them), so overload keeps firing
+        # scale decisions — but never a scale_down of a residue holder
+        assert not hub.of_kind("fleet_scale", "scale_down")
+
+
+class TestDegradeLadder:
+    def _capped(self, hub=None, kv_budget=120):
+        hub = hub or HubStub()
+        router, clock = make_fleet(1, slots=1, kv_budget=kv_budget,
+                                   telemetry=hub)
+        scaler = FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=1, cooldown_s=0.1,
+            down_stable_ticks=2, degrade_kv_frac=0.5,
+            degrade_new_tokens_cap=4), clock=clock)
+        return router, clock, scaler, hub
+
+    def test_entry_and_exit_symmetric_and_journaled(self):
+        router, clock, scaler, hub = self._capped()
+        submit_burst(router, 10, max_new=25)  # sustained overload, capped
+        tick(router, clock, 40)
+        entries = [(d["from_level"], d["to_level"])
+                   for d in hub.of_kind("fleet_scale", "degrade")]
+        assert entries[:3] == [(0, 1), (1, 2), (2, 3)]
+        assert scaler.degrade_level == 3
+        assert router.shed_backfill is True
+        assert router.cap_new_tokens_no_slo == 4
+        eng = dict(router.steppable_engines())["r0"]
+        assert eng.kv_budget_tokens == 60  # 120 * 0.5
+        # load subsides: walk back down the SAME rungs in reverse
+        tick(router, clock, 120)
+        assert not router.has_work()
+        exits = [(d["from_level"], d["to_level"])
+                 for d in hub.of_kind("fleet_scale", "degrade")][3:]
+        assert exits == [(3, 2), (2, 1), (1, 0)]
+        assert scaler.degrade_level == 0
+        assert router.shed_backfill is False
+        assert router.cap_new_tokens_no_slo is None
+        assert eng.kv_budget_tokens == 120  # restored exactly
+        assert hub.registry.gauge("fleet_degrade_level").value == 0
+
+    def test_backfill_shed_before_interactive(self):
+        router, clock, scaler, hub = self._capped()
+        submit_burst(router, 10, max_new=25)
+        tick(router, clock, 40)
+        assert scaler.degrade_level == 3
+        # no-SLO (backfill) traffic is dropped at admission...
+        adm = router.submit([1, 2, 3], max_new_tokens=8)
+        assert not adm and adm.reason == "degraded_backfill"
+        sheds = hub.of_kind("fleet_scale") + hub.of_kind(
+            "router_event", "shed")
+        assert any(p.get("reason") == "degraded_backfill" for p in sheds)
+        # ...while deadline-carrying interactive traffic still gets a
+        # real admission verdict from the engine
+        adm2 = router.submit([1, 2, 3], max_new_tokens=8,
+                             deadline_ms=500.0)
+        assert adm2.reason != "degraded_backfill"
+
+    def test_new_token_cap_applies_to_no_slo_only(self):
+        hub = HubStub()
+        router, clock = make_fleet(1, slots=2, telemetry=hub)
+        router.cap_new_tokens_no_slo = 4
+        rid = router.submit([1, 2], max_new_tokens=20).rid
+        rid2 = router.submit([1, 2], max_new_tokens=20,
+                             deadline_ms=1e6).rid
+        while router.has_work():
+            router.step()
+            clock.advance(0.01)
+        reaped = router.reap()
+        assert len(reaped[rid].tokens) == 4    # capped
+        assert len(reaped[rid2].tokens) == 20  # SLO tenant untouched
+
+    def test_replica_added_mid_degrade_gets_tightened_budget(self):
+        hub = HubStub()
+        router, clock = make_fleet(1, slots=1, kv_budget=100,
+                                   telemetry=hub)
+        scaler = FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=1, cooldown_s=0.0,
+            degrade_kv_frac=0.5), clock=clock)
+        submit_burst(router, 8, max_new=25)
+        tick(router, clock, 4)
+        assert scaler.degrade_level >= 1
+        router.add()  # operator scale-out while degraded
+        tick(router, clock, 1)
+        budgets = {rid: eng.kv_budget_tokens
+                   for rid, eng in router.steppable_engines()}
+        assert budgets["r1"] == 50  # tightened on the next policy tick
+
+
+class TestHysteresis:
+    def test_sawtooth_one_decision_per_cooldown_window(self):
+        hub = HubStub()
+        router, clock = make_fleet(1, slots=1, telemetry=hub)
+        decision_times = []
+        orig_emit = hub.emit
+
+        def emit(kind, payload, **kw):
+            if kind == "fleet_scale" and payload.get("event") in (
+                    "scale_up", "scale_down", "scale_down_skipped",
+                    "degrade"):
+                decision_times.append(clock.t)
+            orig_emit(kind, payload, **kw)
+
+        hub.emit = emit
+        cooldown = 1.0
+        FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=4, cooldown_s=cooldown,
+            down_stable_ticks=2), clock=clock)
+        # sawtooth: a burst every 4 ticks, drained between teeth — the
+        # naive policy would flap up/down on every tooth
+        for i in range(200):
+            if i % 4 == 0:
+                submit_burst(router, 6, max_new=6)
+            tick(router, clock, 1, dt=0.05)
+        assert decision_times, "policy never acted on the sawtooth"
+        gaps = [b - a for a, b in zip(decision_times, decision_times[1:])]
+        assert all(g >= cooldown - 1e-9 for g in gaps), (
+            "scale decisions thrashed inside a cooldown window: "
+            f"{gaps}")
+
+    def test_scale_down_needs_sustained_calm(self):
+        hub = HubStub()
+        # tight budgets so each burst's committed tokens register as
+        # load (occupancy > down_occupancy) and reset the calm streak
+        router, clock = make_fleet(2, kv_budget=60, telemetry=hub)
+        FleetAutoscaler(router, AutoscalerConfig(
+            min_replicas=1, max_replicas=2, cooldown_s=0.0,
+            down_stable_ticks=10), clock=clock)
+        # calm ticks interrupted by a burst before the streak matures:
+        # never scale in
+        for _ in range(3):
+            tick(router, clock, 6)
+            submit_burst(router, 6, max_new=6, prompt=3)
+            tick(router, clock, 6)
+        assert not hub.of_kind("fleet_scale", "scale_down")
+        # then sustained uninterrupted calm: now it may
+        tick(router, clock, 14)
+        assert hub.of_kind("fleet_scale", "scale_down")
+
+    def test_stats_shape(self):
+        router, clock = make_fleet(2, telemetry=HubStub())
+        scaler = FleetAutoscaler(router, AutoscalerConfig(
+            cooldown_s=0.2, down_stable_ticks=2), clock=clock)
+        tick(router, clock, 30)
+        stats = scaler.stats()
+        assert set(stats) == {"scale_ups", "scale_downs",
+                              "scale_down_skips", "degrade_level",
+                              "mean_replicas"}
+        assert 1.0 <= stats["mean_replicas"] <= 2.0
+
+
+class TestConfigValidation:
+    def test_rejects_bad_bounds(self):
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(cooldown_s=-1.0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(degrade_kv_frac=0.0)
+        with pytest.raises(ValueError):
+            AutoscalerConfig(max_degrade_level=4)

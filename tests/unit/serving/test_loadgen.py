@@ -376,3 +376,86 @@ class TestChaosScorecard:
             loadgen.main(["--chaos", str(plan_path), "--ab-pipeline"])
         with pytest.raises(SystemExit):
             loadgen.main(["--chaos-degrade", "1:1"])  # needs --chaos
+
+
+class TestScenarioCli:
+    def test_rate_curve_standalone(self, setup, capsys):
+        """--rate-curve drives a time-varying schedule without a scenario
+        file; the seeded curve is replayable (same flag, same arrivals)."""
+        from deepspeed_tpu.serving.loadgen import gen_curve_arrivals
+
+        rc = loadgen.main([
+            "--requests", "8", "--rate", "200", "--rate-curve",
+            "step:0.01:500", "--slots", "2", "--cache-len", "64",
+            "--prompt-range", "3:6", "--new-range", "3:5", "--json"])
+        assert rc == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["requests"] == 8
+        a = gen_curve_arrivals(8, 200.0, "step:0.01:500", seed=0)
+        assert a == gen_curve_arrivals(8, 200.0, "step:0.01:500", seed=0)
+
+    def test_scenario_autoscaled_fleet_run(self, setup, tmp_path, capsys):
+        """--scenario + --autoscale end to end: chaos fires from the
+        scenario's embedded schedule, the autoscaler journals
+        fleet_scale events, and ds_trace_report --serve renders the
+        scenario section from the trace alone."""
+        from deepspeed_tpu.serving.scenarios import ChaosAction, Scenario
+
+        sc = Scenario(name="mini_kill", seed=3, requests=10, rate=300.0,
+                      curve="burst_train:0.02:5",
+                      chaos=[ChaosAction(tick=3, action="kill"),
+                             ChaosAction(tick=6, action="restore")])
+        path = str(tmp_path / "mini.jsonl")
+        sc.dump(path)
+        trace = str(tmp_path / "scenario.jsonl")
+        rc = loadgen.main([
+            "--scenario", path, "--replicas", "2", "--autoscale", "1:3",
+            "--autoscale-cooldown", "0.05", "--slots", "2",
+            "--cache-len", "64", "--trace-out", trace, "--json"])
+        assert rc == 0
+        # stdout is the indented JSON summary followed by the trace-path
+        # note — raw_decode stops at the end of the JSON object
+        summary, _ = json.JSONDecoder().raw_decode(
+            capsys.readouterr().out)
+        assert summary["scenario"] == "mini_kill"
+        assert set(summary["autoscaler"]) == {
+            "scale_ups", "scale_downs", "scale_down_skips",
+            "degrade_level", "mean_replicas"}
+        assert summary["fleet"]["conservation_ok"] is True
+        assert summary["fleet"]["replica_deaths"] == 1
+
+        kinds = [json.loads(line).get("kind")
+                 for line in open(trace) if line.strip()]
+        assert "fleet_scale" in kinds
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "ds_trace_report.py"),
+             trace, "--serve", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        table = json.loads(out.stdout)["serve"]
+        assert table["scenario"]["scenario"] == "mini_kill"
+        assert table["scenario"]["events"] >= 2
+
+    def test_cli_flag_exclusions(self, setup, tmp_path):
+        from deepspeed_tpu.serving.scenarios import ChaosAction, Scenario
+
+        with pytest.raises(SystemExit):
+            loadgen.main(["--rate-curve", "diurnal:8:20",
+                          "--process", "burst"])
+        with pytest.raises(SystemExit):
+            loadgen.main(["--autoscale", "1:4"])  # needs --replicas
+        sc = Scenario(name="x", requests=4,
+                      chaos=[ChaosAction(tick=2, action="kill")])
+        path = str(tmp_path / "x.jsonl")
+        sc.dump(path)
+        with pytest.raises(SystemExit):
+            loadgen.main(["--scenario", path])  # chaos needs --replicas
+        with pytest.raises(SystemExit):
+            loadgen.main(["--scenario", path, "--replicas", "2",
+                          "--rate-curve", "diurnal:8:20"])
+        with pytest.raises(SystemExit):
+            loadgen.main(["--scenario", path, "--replicas", "2",
+                          "--kill-replica", "3"])
+        with pytest.raises(SystemExit):
+            loadgen.main(["--replicas", "1,2", "--autoscale", "1:4"])

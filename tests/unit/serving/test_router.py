@@ -519,3 +519,138 @@ class TestReplicaTelemetry:
         reqs = [p for k, p in hub.events if k == "inference_request"]
         assert reqs and reqs[0]["replica"] == "r0"
         assert adm
+
+
+class TestScaleInCandidate:
+    """Residue-aware drain selection (the autoscaler's scale-in safety
+    rule): never the last replica, never a non-healthy one, and never a
+    replica holding the only copy of a recovering request's RecoveryLog
+    residue."""
+
+    def test_last_replica_never_offered(self):
+        router, _ = make_fleet(1)
+        assert router.scale_in_candidate() is None
+
+    def test_prefers_emptiest_healthy_replica(self):
+        router, clock = make_fleet(2)
+        # load r0 (lowest slot gets the first placement) so r1 is empty
+        adm = router.submit(np.arange(1, 4), max_new_tokens=20)
+        router.step()
+        assert adm
+        assert router.scale_in_candidate() == "r1"
+        # both idle: ties break toward the lowest slot
+        run_fleet(router, clock)
+        router.reap()
+        assert router.scale_in_candidate() == "r0"
+
+    def test_non_healthy_states_excluded(self):
+        router, _ = make_fleet(2)
+        router.drain("r0")
+        # r1 is the only HEALTHY replica left — and the last placeable
+        # one, so there is no safe candidate at all
+        assert router.scale_in_candidate() is None
+
+    def test_refuses_sole_residue_holder(self):
+        router, clock = make_fleet(3)
+        # r0 carries a mid-stream request AND an open breaker: its
+        # RecoveryLog residue has no other copy — draining it would
+        # strand the recovery state. r1 carries clean residue (fine to
+        # rank, but busier than empty r2).
+        a0 = router.submit(np.arange(1, 4), max_new_tokens=30)
+        router.step()
+        a1 = router.submit(np.arange(1, 4), max_new_tokens=10)
+        router.step()
+        assert a0 and a1
+        engines = dict(router.steppable_engines())
+        assert engines["r0"].statusz()["residue_tokens"] > 0
+        engines["r0"]._breaker_open = True
+        assert router.scale_in_candidate() == "r2"
+        # with every replica in that state, scale-in is refused outright
+        for eng in engines.values():
+            eng._breaker_open = True
+        router.submit(np.arange(1, 4), max_new_tokens=10)  # r2 residue
+        router.step()
+        assert router.scale_in_candidate() is None
+
+    def test_drain_of_candidate_loses_nothing(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        adm = router.submit(np.arange(1, 5), max_new_tokens=6)
+        router.step()
+        cand = router.scale_in_candidate()
+        assert cand is not None
+        router.drain(cand)
+        run_fleet(router, clock)
+        reaped = router.reap()
+        assert reaped[adm.rid].state == FINISHED
+        assert router.statusz()["lost"] == 0
+
+
+class TestRebalanceQueued:
+    """Queue rebalancing after scale-out (the autoscaler's burst-rescue
+    hook): placement happens at submit time, so a backlog queued on a
+    small fleet is trapped there — ``rebalance_queued()`` spreads the
+    queued (never-started) tail onto lighter replicas, loses nothing,
+    and leaves running streams pinned where their KV lives."""
+
+    def test_spreads_trapped_queue_onto_new_replica(self):
+        hub = HubStub()
+        router, clock = make_fleet(1, slots=2, telemetry=hub)
+        adms = [router.submit(np.arange(1, 5), max_new_tokens=8)
+                for _ in range(8)]
+        assert all(adms)  # 2 run, 6 queue — all on the only replica
+        router.add()
+        moved = router.rebalance_queued()
+        assert moved >= 3
+        depths = sorted(eng.statusz()["queue_depth"]
+                        for _, eng in router.steppable_engines())
+        assert depths[-1] - depths[0] <= 1
+        # each move journaled; none of it counts as a death migration
+        assert len(hub.of_kind("router_event", "rebalanced")) == moved
+        assert hub.of_kind("router_event", "rebalance") == [
+            {"event": "rebalance", "migrated": moved}]
+        assert router.statusz()["migrated"] == 0
+        assert hub.registry.counter(
+            "fleet_rebalanced_total").value == moved
+        # conservation: every admitted request still finishes, none lost
+        run_fleet(router, clock)
+        reaped = router.reap()
+        assert sorted(reaped) == sorted(a.rid for a in adms)
+        assert all(r.state == FINISHED for r in reaped.values())
+        assert all(len(r.tokens) == 8 for r in reaped.values())
+        assert router.statusz()["lost"] == 0
+
+    def test_balanced_fleet_is_a_noop(self):
+        hub = HubStub()
+        router, _ = make_fleet(2, telemetry=hub)
+        assert router.rebalance_queued() == 0
+        assert hub.of_kind("router_event", "rebalance") == []
+
+    def test_single_replica_is_a_noop(self):
+        router, _ = make_fleet(1, slots=1)
+        for _ in range(4):
+            router.submit(np.arange(1, 4), max_new_tokens=6)
+        assert router.rebalance_queued() == 0
+
+    def test_failed_placement_keeps_request_at_source(self):
+        router, clock = make_fleet(1, slots=1)
+        adms = [router.submit(np.arange(1, 4), max_new_tokens=6)
+                for _ in range(5)]
+        assert all(adms)
+        router.add()
+        engines = dict(router.steppable_engines())
+        engines["r1"]._breaker_open = True  # refuses re-admission
+        assert router.rebalance_queued() == 0
+        engines["r1"]._breaker_open = False
+        run_fleet(router, clock)
+        reaped = router.reap()
+        assert sorted(reaped) == sorted(a.rid for a in adms)
+        assert all(r.state == FINISHED for r in reaped.values())
+        assert router.statusz()["lost"] == 0
+
+    def test_max_moves_caps_the_transfer(self):
+        router, _ = make_fleet(1, slots=1)
+        for _ in range(9):
+            router.submit(np.arange(1, 4), max_new_tokens=6)
+        router.add()
+        assert router.rebalance_queued(max_moves=2) == 2

@@ -1,0 +1,285 @@
+"""Scenario engine (serving/scenarios.py) + rate-curve arrivals —
+jax-free (FakeEngine), part of the fast pre-tier-1 CI stage
+(tools/ci_jaxfree_tests.py).
+
+The load-bearing contract: a scenario is ONE seeded artifact — compile
+it twice, or dump/load it and compile again, and you get the identical
+workload + arrival schedule; arm it on two routers and the chaos fires
+on the same ticks. The arrival pins below are the replay identity of
+the checked-in matrix: they may only change with an explicit fixture
+refresh."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine  # noqa: E402
+
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.loadgen import gen_curve_arrivals, parse_rate_curve
+from deepspeed_tpu.serving.router import FleetRouter
+from deepspeed_tpu.serving.scenarios import (
+    ChaosAction,
+    Scenario,
+    TenantMix,
+    builtin_matrix,
+    scenario_scorecard,
+    write_matrix,
+)
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class HubStub:
+    def __init__(self):
+        self.enabled = True
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+    def close(self):
+        pass
+
+    def of_kind(self, kind, event=None):
+        return [p for k, p in self.events
+                if k == kind and (event is None or p.get("event") == event)]
+
+
+def make_fleet(n=2, clock=None, slots=2, telemetry=None):
+    clock = clock or FakeClock()
+
+    def factory(replica_id):
+        return ServingEngine(FakeEngine(vocab_size=997, cache_len=64,
+                                        slots=slots), clock=clock)
+
+    return FleetRouter(factory, replicas=n, clock=clock,
+                       telemetry=telemetry), clock
+
+
+class TestRateCurves:
+    def test_parse_shapes(self):
+        assert parse_rate_curve("diurnal:10:8") == {
+            "kind": "diurnal", "period_s": 10.0, "peak": 8.0}
+        assert parse_rate_curve("step:5:12") == {
+            "kind": "step", "t_s": 5.0, "rate": 12.0}
+        assert parse_rate_curve("burst_train:1.5:3") == {
+            "kind": "burst_train", "gap_s": 1.5, "size": 3}
+
+    def test_parse_rejects_bad_specs(self):
+        for spec in ("diurnal:10", "sawtooth:1:2", "diurnal:0:8",
+                     "step:-1:5", "step:1:0", "burst_train:0:4",
+                     "burst_train:1:0", "diurnal", ""):
+            with pytest.raises(ValueError):
+                parse_rate_curve(spec)
+
+    def test_seeded_sequences_pinned(self):
+        # the replay identity: these exact floats are what any holder of
+        # the same (seed, curve) gets — a behavior change here silently
+        # invalidates every committed scenario artifact
+        assert gen_curve_arrivals(6, 2.0, "diurnal:10:8", seed=7) == [
+            0.194926973, 0.275359112, 0.760711125, 0.792705001,
+            1.097623601, 1.26092909]
+        assert gen_curve_arrivals(6, 2.0, "step:1.0:10", seed=7) == [
+            0.195657422, 0.277416651, 0.80366446, 0.841261356,
+            1.045013917, 1.090535495]
+
+    def test_step_uniform_exact(self):
+        # deterministic process: 2/s until t=1 (0.5, 1.0), then 10/s
+        assert gen_curve_arrivals(5, 2.0, "step:1.0:10",
+                                  process="uniform") == [
+            0.5, 1.0, 1.1, 1.2, 1.3]
+
+    def test_burst_train_groups(self):
+        assert gen_curve_arrivals(7, 2.0, "burst_train:1.5:3") == [
+            0.0, 0.0, 0.0, 1.5, 1.5, 1.5, 3.0]
+
+    def test_diurnal_rate_varies_with_phase(self):
+        # more arrivals land in the peak half-period than in the trough
+        a = gen_curve_arrivals(400, 2.0, "diurnal:10:20", seed=1)
+        assert a == sorted(a)
+        in_peak = sum(1 for t in a if 2.5 <= (t % 10.0) < 7.5)
+        assert in_peak > 0.6 * len([t for t in a if t < 10.0 * 3])
+
+    def test_rejects_bad_args(self):
+        with pytest.raises(ValueError):
+            gen_curve_arrivals(0, 2.0, "diurnal:10:8")
+        with pytest.raises(ValueError):
+            gen_curve_arrivals(4, 0.0, "diurnal:10:8")
+        with pytest.raises(ValueError):
+            gen_curve_arrivals(4, 9.0, "diurnal:10:8")  # peak < base
+        with pytest.raises(ValueError):
+            gen_curve_arrivals(4, 2.0, "diurnal:10:8", process="burst")
+
+
+class TestScenarioSpec:
+    def _scenario(self):
+        return Scenario(
+            name="t", seed=5, requests=40, rate=4.0, curve="diurnal:6:12",
+            mixes=[TenantMix(tenant="interactive", weight=0.7,
+                             prompt_range=(4, 8), new_range=(4, 8),
+                             priority=1, deadline_ms=800.0),
+                   TenantMix(tenant="backfill", weight=0.3,
+                             prompt_range=(8, 16), new_range=(8, 12)),
+                   TenantMix(tenant="rag", weight=0.5,
+                             prompt_range=(12, 20), new_range=(4, 6),
+                             deadline_ms=2000.0, shared_prefix=8)],
+            chaos=[ChaosAction(tick=9, action="kill"),
+                   ChaosAction(tick=15, action="restore")])
+
+    def test_compile_deterministic(self):
+        sc = self._scenario()
+        assert sc.compile() == sc.compile()
+        w, a = sc.compile()
+        assert len(w) == len(a) == 40
+        assert a == sorted(a)
+
+    def test_mix_shapes(self):
+        w, _ = self._scenario().compile()
+        tenants = {i["tenant"] for i in w}
+        assert tenants <= {"interactive", "backfill", "rag"}
+        for item in w:
+            if item["tenant"] == "interactive":
+                assert item["deadline_ms"] == 800.0
+                assert item["priority"] == 1
+                assert 4 <= item["prompt_tokens"] <= 8
+            elif item["tenant"] == "backfill":
+                assert "deadline_ms" not in item  # no-SLO backfill
+        rag = [i for i in w if i["tenant"] == "rag"]
+        assert rag, "weighted draw starved the rag tenant"
+        # shared-prefix tenants: explicit prompts, one common prefix
+        prefixes = {tuple(i["prompt"][:8]) for i in rag}
+        assert len(prefixes) == 1
+        assert all(tok < 128 for i in rag for tok in i["prompt"])
+
+    def test_dump_load_roundtrip(self, tmp_path):
+        sc = self._scenario()
+        path = str(tmp_path / "t.jsonl")
+        sc.dump(path)
+        back = Scenario.load(path)
+        assert back.compile() == sc.compile()
+        assert [(c.tick, c.action) for c in back.chaos] == [
+            (9, "kill"), (15, "restore")]
+        assert back.name == "t" and back.curve == "diurnal:6:12"
+
+    def test_load_rejects_garbage(self, tmp_path):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        with pytest.raises(ValueError, match="no scenario header"):
+            Scenario.load(str(empty))
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"record": "mystery"}\n')
+        with pytest.raises(ValueError, match="unknown record"):
+            Scenario.load(str(bad))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ChaosAction(tick=0, action="kill")
+        with pytest.raises(ValueError):
+            ChaosAction(tick=3, action="explode")
+        with pytest.raises(ValueError):
+            TenantMix(weight=0.0)
+        with pytest.raises(ValueError):
+            TenantMix(prompt_range=(8, 4))
+        with pytest.raises(ValueError):
+            Scenario(name="", requests=4)
+        with pytest.raises(ValueError):
+            Scenario(name="x", requests=0)
+
+    def test_without_chaos_same_load(self):
+        sc = self._scenario()
+        quiet = sc.without_chaos()
+        assert quiet.chaos == []
+        assert quiet.compile() == sc.compile()
+
+
+class TestArm:
+    def test_chaos_fires_on_ticks_and_marks_journal(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        sc = Scenario(name="boom", seed=1, requests=4,
+                      chaos=[ChaosAction(tick=2, action="kill"),
+                             ChaosAction(tick=4, action="restore")])
+        assert sc.arm(router) == 2
+        marks = hub.of_kind("fleet_scale", "scenario")
+        assert marks == [{"event": "scenario", "scenario": "boom",
+                          "requests": 4, "seed": 1}]
+        for _ in range(5):
+            router.step()
+            clock.advance(0.01)
+        st = router.statusz()
+        assert st["replica_deaths"] == 1
+        assert st["replicas"]["r0"]["state"] == "dead"
+        assert st["placeable"] == 2  # r1 + the tick-4 restore (r2)
+        assert "r2" in st["replicas"]
+
+    def test_rolling_restart_action(self):
+        hub = HubStub()
+        router, clock = make_fleet(2, telemetry=hub)
+        sc = Scenario(name="roll", requests=4,
+                      chaos=[ChaosAction(tick=1,
+                                         action="rolling_restart")])
+        sc.arm(router)
+        for _ in range(12):
+            router.step()
+            clock.advance(0.01)
+        assert hub.of_kind("router_event", "rolling_restart_done")
+        assert router.statusz()["placeable"] == 2
+
+
+class TestMatrix:
+    def test_builtin_matrix_shape(self):
+        matrix = builtin_matrix()
+        assert len(matrix) >= 6
+        names = [sc.name for sc in matrix]
+        assert len(set(names)) == len(names)
+        kinds = {parse_rate_curve(sc.curve)["kind"]
+                 for sc in matrix if sc.curve}
+        assert kinds >= {"diurnal", "step", "burst_train"}
+        assert any(sc.chaos for sc in matrix)
+        assert any(any(m.deadline_ms is None for m in sc.mixes)
+                   for sc in matrix), "no batch-backfill tenant anywhere"
+        assert any(m.shared_prefix > 0 for sc in matrix
+                   for m in sc.mixes), "no shared-prefix tenant"
+        for sc in matrix:
+            w, a = sc.compile()
+            assert len(w) == len(a) == sc.requests
+
+    def test_checked_in_artifacts_match_builtins(self, tmp_path):
+        # scenarios/*.jsonl IS builtin_matrix() dumped: regenerating
+        # into a scratch dir must reproduce the committed bytes
+        # (ci_scenario_smoke.py enforces the same at CI speed)
+        committed = os.path.join(REPO, "scenarios")
+        for path in write_matrix(str(tmp_path)):
+            name = os.path.basename(path)
+            with open(path) as fh, \
+                    open(os.path.join(committed, name)) as gh:
+                assert fh.read() == gh.read(), f"{name} drifted"
+
+    def test_scorecard_shape(self):
+        sc = builtin_matrix()[0]
+        card = scenario_scorecard(sc, {
+            "goodput_tok_s": 50.0, "throughput_tok_s": 60.0,
+            "shed_rate": 0.1, "deadline_met_frac": 0.9,
+            "fleet": {"lost": 0, "replica_deaths": 1,
+                      "conservation_ok": True}})
+        assert card["scenario"] == sc.name
+        assert card["lost"] == 0 and card["conservation_ok"] is True
+        assert card["goodput_tok_s"] == 50.0
+        assert card["chaos_actions"] == len(sc.chaos)

@@ -119,6 +119,32 @@ def test_serve_table():
     assert "UNRECOVERABLE" not in text
 
 
+def test_serve_table_scenario_section():
+    """The scenario section from the fleet_scale journal: the autoscaler
+    config marker + scenario marker, one scale up/down each, one skipped
+    scale-in, and a symmetric degrade round-trip — plus the replica
+    count over time and the formatted SLO verdict line."""
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.serve_table(events)
+    sc = table["scenario"]
+    assert sc["events"] == 7
+    assert sc["scenario"] == "diurnal_interactive"
+    assert sc["scale_ups"] == 1 and sc["scale_downs"] == 1
+    assert sc["scale_down_skipped"] == 1
+    assert sc["degrade_transitions"] == 2
+    assert sc["max_degrade_level"] == 1
+    assert sc["final_degrade_level"] == 0
+    # replicas over time: attach(2) -> scale_up(3) -> scale_down(2)
+    assert sc["replicas_timeline"] == [[0, 2], [14, 3], [34, 2]]
+    assert sc["replicas_min"] == 2 and sc["replicas_max"] == 3
+    text = ds_trace_report.format_serve_table(table)
+    assert "scenario          diurnal_interactive" in text
+    assert "scale ups 1" in text and "downs 1" in text
+    assert "replicas 2→3" in text
+    assert "degrade<= L1 (final L0)" in text
+    assert "SLO: deadline met 50.00%" in text
+
+
 def test_serve_table_empty_without_serving_events():
     events = [{"kind": "inference_request", "path": "fused", "ts": 1.0}]
     assert ds_trace_report.serve_table(events) == {}

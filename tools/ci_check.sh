@@ -3,9 +3,13 @@
 #
 #   1. ds-lint  --changed --format sarif   (source contracts, diff-scoped)
 #   2. ds-audit --format sarif             (compiled-program contracts)
-#   3. jax-free serving tests              (router/policies/faults/recovery,
-#                                           sub-second, proves no jax import)
-#   4. tier-1 tests                        (the ROADMAP.md command)
+#   3. jax-free serving tests              (router/policies/faults/recovery/
+#                                           scenarios/autoscaler, sub-second,
+#                                           proves no jax import)
+#   4. scenario-matrix smoke               (scenarios/*.jsonl load, compile
+#                                           deterministically, byte-match
+#                                           builtin_matrix())
+#   5. tier-1 tests                        (the ROADMAP.md command)
 #
 # Usage:  tools/ci_check.sh [BASE_REF] [SARIF_DIR]
 #   BASE_REF   git ref to diff against for ds-lint --changed (default HEAD,
@@ -23,7 +27,7 @@ BASE_REF="${1:-HEAD}"
 SARIF_DIR="${2:-${REPO}/ci_artifacts}"
 mkdir -p "${SARIF_DIR}"
 
-echo "ci_check: [1/4] ds-lint --changed ${BASE_REF} --format sarif"
+echo "ci_check: [1/5] ds-lint --changed ${BASE_REF} --format sarif"
 python "${REPO}/tools/ds_lint.py" --changed "${BASE_REF}" --format sarif \
     > "${SARIF_DIR}/ds_lint.sarif"
 rc=$?
@@ -32,7 +36,7 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [2/4] ds-audit --format sarif"
+echo "ci_check: [2/5] ds-audit --format sarif"
 python "${REPO}/tools/ds_audit.py" --format sarif \
     > "${SARIF_DIR}/ds_audit.sarif"
 rc=$?
@@ -41,7 +45,7 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [3/4] jax-free serving tests (tools/ci_jaxfree_tests.py)"
+echo "ci_check: [3/5] jax-free serving tests (tools/ci_jaxfree_tests.py)"
 python "${REPO}/tools/ci_jaxfree_tests.py"
 rc=$?
 if [ $rc -ne 0 ]; then
@@ -49,7 +53,15 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [4/4] tier-1 tests (ROADMAP.md command)"
+echo "ci_check: [4/5] scenario-matrix smoke (tools/ci_scenario_smoke.py)"
+python "${REPO}/tools/ci_scenario_smoke.py"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "ci_check: scenario smoke FAILED (exit $rc)" >&2
+    exit $rc
+fi
+
+echo "ci_check: [5/5] tier-1 tests (ROADMAP.md command)"
 cd "${REPO}" || exit 2
 rm -f /tmp/_t1.log
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -39,6 +39,8 @@ JAXFREE_TESTS = [
     "tests/unit/serving/test_policies.py",
     "tests/unit/serving/test_faults.py",
     "tests/unit/serving/test_shed_hints.py",
+    "tests/unit/serving/test_scenarios.py",
+    "tests/unit/serving/test_autoscaler.py",
     "tests/unit/runtime/test_train_faults.py",
     "tests/unit/runtime/test_resilience_policy.py",
     "tests/unit/checkpoint/test_checkpoint_integrity.py",

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Scenario-matrix smoke for tools/ci_check.sh: prove the checked-in
+``scenarios/*.jsonl`` artifacts are live, loadable, and exactly what
+``builtin_matrix()`` produces — in a jax-free interpreter, in well under
+a second.
+
+Why this exists (docs/serving.md "Autoscaling & scenarios"): the matrix
+is the replay identity of every SLO scorecard in the repo. If someone
+edits ``serving/scenarios.py`` (a seed, a mix weight, the arrival
+transform) without regenerating the committed files, every downstream
+number silently describes a scenario that no longer exists. This driver
+catches the drift at CI speed:
+
+- each committed file must ``Scenario.load`` and ``compile()`` to
+  exactly ``requests`` workload items + sorted arrivals,
+- compile must be deterministic (two calls, identical output),
+- regenerating the matrix into a scratch dir must reproduce the
+  committed bytes, file for file, with no extras on either side,
+- and ``jax`` must never enter ``sys.modules`` (the scenario engine is
+  host-side bookkeeping; same promise as tools/ci_jaxfree_tests.py).
+
+Usage: python tools/ci_scenario_smoke.py   (exit 0 ok, 1 on any drift,
+3 if jax leaked).
+"""
+
+import glob
+import os
+import sys
+import tempfile
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stub_pkg(name: str, path: str):
+    """Register ``name`` as a namespace-style package rooted at ``path``
+    WITHOUT executing its real __init__.py (which imports jax)."""
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [path]
+    sys.modules[name] = pkg
+
+
+def main() -> int:
+    _stub_pkg("deepspeed_tpu", os.path.join(REPO, "deepspeed_tpu"))
+    _stub_pkg("deepspeed_tpu.utils",
+              os.path.join(REPO, "deepspeed_tpu", "utils"))
+    _stub_pkg("deepspeed_tpu.telemetry",
+              os.path.join(REPO, "deepspeed_tpu", "telemetry"))
+    _stub_pkg("deepspeed_tpu.serving",
+              os.path.join(REPO, "deepspeed_tpu", "serving"))
+    sys.path.insert(0, REPO)
+
+    from deepspeed_tpu.serving.scenarios import Scenario, write_matrix
+
+    committed = sorted(glob.glob(os.path.join(REPO, "scenarios",
+                                              "*.jsonl")))
+    if len(committed) < 6:
+        print(f"ci_scenario_smoke: FAIL — expected >= 6 committed "
+              f"scenarios, found {len(committed)}", file=sys.stderr)
+        return 1
+
+    failures = []
+    for path in committed:
+        name = os.path.basename(path)
+        try:
+            sc = Scenario.load(path)
+            w, a = sc.compile()
+        except Exception as exc:  # noqa: BLE001 — report, don't crash CI
+            failures.append(f"{name}: load/compile raised {exc!r}")
+            continue
+        if len(w) != sc.requests or len(a) != sc.requests:
+            failures.append(f"{name}: compiled {len(w)} items / "
+                            f"{len(a)} arrivals, spec says {sc.requests}")
+        if a != sorted(a):
+            failures.append(f"{name}: arrivals not sorted")
+        if sc.compile() != (w, a):
+            failures.append(f"{name}: compile() not deterministic")
+
+    with tempfile.TemporaryDirectory() as scratch:
+        regenerated = {os.path.basename(p): p
+                       for p in write_matrix(scratch)}
+        committed_names = {os.path.basename(p) for p in committed}
+        if set(regenerated) != committed_names:
+            failures.append(
+                f"matrix membership drifted: builtin_matrix() emits "
+                f"{sorted(regenerated)}, scenarios/ holds "
+                f"{sorted(committed_names)}")
+        for name, path in regenerated.items():
+            if name not in committed_names:
+                continue
+            with open(path) as fh, \
+                    open(os.path.join(REPO, "scenarios", name)) as gh:
+                if fh.read() != gh.read():
+                    failures.append(
+                        f"{name}: committed bytes differ from "
+                        f"builtin_matrix() — regenerate with "
+                        f"`python -m deepspeed_tpu.serving.scenarios "
+                        f"scenarios`")
+
+    if "jax" in sys.modules:
+        print("ci_scenario_smoke: FAIL — jax entered sys.modules in the "
+              "scenario engine (it promises to be host-side "
+              "bookkeeping)", file=sys.stderr)
+        return 3
+    if failures:
+        for f in failures:
+            print(f"ci_scenario_smoke: FAIL — {f}", file=sys.stderr)
+        return 1
+    print(f"ci_scenario_smoke: ok — {len(committed)} scenarios load, "
+          f"compile deterministically, match builtin_matrix(); jax "
+          f"never imported")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

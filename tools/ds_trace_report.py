@@ -173,7 +173,9 @@ def serve_table(events):
     lifecycle = [e for e in events if e.get("kind") == "serving_event"]
     ticks = [e for e in events if e.get("kind") == "serving_tick"]
     faults = [e for e in events if e.get("kind") == "serving_fault"]
-    if not finished and not lifecycle and not ticks and not faults:
+    scales = [e for e in events if e.get("kind") == "fleet_scale"]
+    if (not finished and not lifecycle and not ticks and not faults
+            and not scales):
         return {}
     by_event = {}
     for e in lifecycle:
@@ -290,6 +292,7 @@ def serve_table(events):
                 "migrated_in": 0, "migrated_out": 0})
 
         deaths = lost = migrated = spillovers = no_replica_sheds = 0
+        degraded_sheds = 0
         for e in routers:
             ev = e.get("event")
             if ev == "route":
@@ -304,7 +307,13 @@ def serve_table(events):
                 deaths += 1
                 lost += int(e.get("lost", 0))
             elif ev == "shed":
-                no_replica_sheds += 1
+                # admission-plane sheds split by cause: fleet-empty
+                # ("no_replicas") vs the degradation ladder dropping
+                # batch backfill ("degraded_backfill")
+                if e.get("reason") == "degraded_backfill":
+                    degraded_sheds += 1
+                else:
+                    no_replica_sheds += 1
         for e in lifecycle:
             if (e.get("event") in ("shed", "expired")
                     and e.get("replica") is not None):
@@ -325,6 +334,41 @@ def serve_table(events):
             "migrated": migrated, "spillovers": spillovers,
             "no_replica_sheds": no_replica_sheds,
         }
+        if degraded_sheds:
+            out["fleet"]["degraded_sheds"] = degraded_sheds
+    # scenario section: fleet_scale is the autoscaler's journal (plus
+    # the scenario marker the scenario engine emits when armed) — the
+    # per-scenario SLO verdict is the scorecard above, this section adds
+    # WHAT the control loop did about the load: every scale/degrade
+    # transition and the replica count over time
+    if scales:
+        sc = {"events": len(scales)}
+        name = next((e.get("scenario") for e in scales
+                     if e.get("event") == "scenario"), None)
+        if name is not None:
+            sc["scenario"] = name
+        sc["scale_ups"] = sum(1 for e in scales
+                              if e.get("event") == "scale_up")
+        sc["scale_downs"] = sum(1 for e in scales
+                                if e.get("event") == "scale_down")
+        sc["scale_down_skipped"] = sum(
+            1 for e in scales if e.get("event") == "scale_down_skipped")
+        degrades = [e for e in scales if e.get("event") == "degrade"]
+        sc["degrade_transitions"] = len(degrades)
+        levels = [int(e.get("to_level", 0)) for e in degrades]
+        if degrades:
+            sc["max_degrade_level"] = max(levels)
+            sc["final_degrade_level"] = levels[-1]
+        timeline = [[int(e.get("tick", 0)), int(e["replicas"])]
+                    for e in scales
+                    if e.get("event") in ("autoscaler", "scale_up",
+                                          "scale_down")
+                    and isinstance(e.get("replicas"), int)]
+        if timeline:
+            sc["replicas_timeline"] = timeline
+            sc["replicas_min"] = min(r for _, r in timeline)
+            sc["replicas_max"] = max(r for _, r in timeline)
+        out["scenario"] = sc
     return out
 
 
@@ -398,7 +442,9 @@ def format_serve_table(table):
                      f"   lost {fleet['lost']}"
                      f"   spillovers {fleet['spillovers']}"
                      + (f"   no-replica sheds {fleet['no_replica_sheds']}"
-                        if fleet.get("no_replica_sheds") else ""))
+                        if fleet.get("no_replica_sheds") else "")
+                     + (f"   degraded sheds {fleet['degraded_sheds']}"
+                        if fleet.get("degraded_sheds") else ""))
         lines.append("  replica    admitted  finished  shed   mig in/out"
                      "   goodput tok/s")
         for rid, r in fleet["replicas"].items():
@@ -406,6 +452,29 @@ def format_serve_table(table):
             lines.append(f"  {rid:<10} {r['admitted']:<9} {r['finished']:<9} "
                          f"{r['shed']:<6} {mig:<12} "
                          f"{_fmt(r.get('goodput_tok_s', '-'))}")
+    sc = table.get("scenario")
+    if sc:
+        head = "scenario          "
+        if sc.get("scenario"):
+            head += f"{sc['scenario']}   "
+        head += (f"scale ups {sc['scale_ups']}   downs {sc['scale_downs']}"
+                 f"   skipped {sc['scale_down_skipped']}"
+                 f"   degrade transitions {sc['degrade_transitions']}")
+        lines.append(head)
+        tail = []
+        if "replicas_min" in sc:
+            tail.append(f"replicas {sc['replicas_min']}"
+                        f"→{sc['replicas_max']}")
+        if "max_degrade_level" in sc:
+            tail.append(f"degrade<= L{sc['max_degrade_level']} "
+                        f"(final L{sc['final_degrade_level']})")
+        verdict = []
+        if "deadline_met_frac" in table:
+            verdict.append(f"deadline met {table['deadline_met_frac'] * 100:.2f}%")
+        verdict.append(f"shed {table['shed_rate'] * 100:.2f}%")
+        if "goodput_tok_s" in table:
+            verdict.append(f"goodput {_fmt(table['goodput_tok_s'])} tok/s")
+        lines.append(f"                  {'   '.join(tail + ['SLO: ' + ', '.join(verdict)])}")
     return "\n".join(lines) + "\n"
 
 
