@@ -220,6 +220,29 @@ EVENT_SCHEMAS = {
             "committed": "bool",
         },
     },
+    "numeric_health": {
+        # numerical-health sentinel lifecycle (runtime/resilience.py
+        # TrainSupervisor + runtime/numerics.py NumericSentinel),
+        # discriminated by "event": anomaly | quarantine | rewind |
+        # sdc_probe
+        "required": {"event": "str", "step": "int"},
+        "optional": {
+            "verdict": "str",       # suspect | corrupt
+            "reasons": "list",      # anomaly-kind slugs
+            "loss": "number",
+            "grad_norm": "number",
+            "grad_ratio": "number",
+            "zscore": "number",
+            "epoch": "int",
+            "batch": "int",
+            "resume_step": "int",
+            "replayed_steps": "int",
+            "rewind_ms": "number",
+            "digest": "int",
+            "match": "bool",
+            "detail": "str",
+        },
+    },
     "memory_snapshot": {
         "required": {
             "reason": "str",

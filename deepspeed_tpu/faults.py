@@ -44,16 +44,31 @@ The exception taxonomies the recovery ladders decide by:
   comes from the last committed tag on disk, optionally at a degraded
   chip count).
 
-Deliberately jax-free (stdlib only): plans are authored, validated and
-round-tripped without paying a jax import, same as the scheduler and
-supervisor policies — tools/ci_jaxfree_tests.py enforces it.
+The train domain additionally carries **numeric fault kinds**
+(:data:`TRAIN_NUMERIC_KINDS`: ``grad_bitflip`` / ``nan_loss`` /
+``data_poison``) that model *silent* corruption — the math going wrong
+without anything raising. They fire through the same hook points and
+the same replayable plans, but instead of raising, the injector hands
+the fired record back to the hook site, which applies the mutation
+(flip one mantissa/exponent bit in a grad leaf, NaN the loss, scale a
+batch into garbage). Nothing in the control flow fails: only the
+``NumericSentinel`` (runtime/numerics.py) can catch these, which is the
+point. ``synth`` draws from the exception kinds only
+(:attr:`TrainFault.SYNTH_KINDS`) — numeric kinds are opted into
+explicitly via ``kinds=`` so legacy chaos soaks stay corruption-free.
+
+Deliberately jax-free (stdlib + numpy, like the supervisor policies):
+plans are authored, validated and round-tripped without paying a jax
+import — tools/ci_jaxfree_tests.py enforces it.
 ``serving/faults.py`` re-exports the serving domain unchanged.
 """
 
 import json
 import random
 from dataclasses import dataclass, field
-from typing import ClassVar, Dict, List, Optional, Tuple
+from typing import ClassVar, Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
 
 # ---------------------------------------------------------------------------
 # exception taxonomy — serving
@@ -151,6 +166,11 @@ class PlannedFault:
     KINDS: ClassVar[Dict[str, str]] = {}
     POINTS: ClassVar[Tuple[str, ...]] = ()
     TICK_KEY: ClassVar[str] = "tick"
+    # domain-specific payload fields round-tripped through JSONL when
+    # they differ from their dataclass default
+    EXTRA_FIELDS: ClassVar[Tuple[str, ...]] = ()
+    # kinds ``synth`` draws from by default ("" sentinel = all of KINDS)
+    SYNTH_KINDS: ClassVar[Tuple[str, ...]] = ()
 
     def __post_init__(self):
         cls = type(self)
@@ -168,12 +188,17 @@ class PlannedFault:
             raise ValueError("fault count must be >= 1")
 
     def to_dict(self) -> dict:
-        out = {type(self).TICK_KEY: self.tick, "kind": self.kind,
+        cls = type(self)
+        out = {cls.TICK_KEY: self.tick, "kind": self.kind,
                "point": self.point}
         if self.count != 1:
             out["count"] = self.count
         if self.degrade:
             out["degrade"] = True
+        for name in cls.EXTRA_FIELDS:
+            value = getattr(self, name)
+            if value != cls.__dataclass_fields__[name].default:
+                out[name] = value
         return out
 
 
@@ -200,7 +225,8 @@ class PlannedFaultSchedule:
         ``kinds`` (default: the domain's full taxonomy). Fully determined
         by ``seed`` — the chaos-soak analogue of ``synth_workload``."""
         rng = random.Random(seed)
-        kinds = list(kinds or cls.fault_cls.KINDS)
+        kinds = list(kinds or cls.fault_cls.SYNTH_KINDS
+                     or cls.fault_cls.KINDS)
         ticks = sorted(rng.randrange(first_tick, first_tick + tick_span)
                        for _ in range(n_faults))
         faults = [cls.fault_cls(tick=t, kind=rng.choice(kinds))
@@ -228,11 +254,15 @@ class PlannedFaultSchedule:
                     continue
                 rec = json.loads(line)
                 tick = rec.get(key, rec.get("tick"))
+                extras = {name: rec[name]
+                          for name in cls.fault_cls.EXTRA_FIELDS
+                          if name in rec}
                 faults.append(cls.fault_cls(
                     tick=int(tick), kind=rec["kind"],
                     point=rec.get("point", ""),
                     count=int(rec.get("count", 1)),
-                    degrade=bool(rec.get("degrade", False))))
+                    degrade=bool(rec.get("degrade", False)),
+                    **extras))
         if not faults:
             raise ValueError(f"no fault records in {path}")
         return cls(faults)
@@ -256,6 +286,9 @@ class PlannedFaultInjector:
     info_renames: ClassVar[Dict[str, str]] = {}
     EXCEPTIONS: ClassVar[Dict[str, type]] = {}
     PREEMPT_EXCEPTION: ClassVar[type] = EnginePreempted
+    # kinds that corrupt values instead of raising: the fired record is
+    # RETURNED to the hook site, which applies the mutation itself
+    MUTATION_KINDS: ClassVar[FrozenSet[str]] = frozenset()
 
     def __init__(self, plan: PlannedFaultSchedule):
         self.plan = plan
@@ -292,6 +325,12 @@ class PlannedFaultInjector:
         self.fired.append(record)
         msg = (f"injected {fault.kind} at {cls.tick_label} {self.tick} "
                f"(plan {type(fault).TICK_KEY} {fault.tick}, point {point})")
+        if fault.kind in cls.MUTATION_KINDS:
+            # numeric kinds corrupt VALUES rather than control flow: hand
+            # the fired record back so the hook site applies the mutation
+            # (engine._apply_numeric_fault) and the step keeps running —
+            # only the NumericSentinel can catch what happens next
+            return record
         exc = cls.EXCEPTIONS.get(fault.kind)
         if exc is not None:
             raise exc(msg, record)
@@ -351,20 +390,49 @@ TRAIN_FAULT_KINDS: Dict[str, str] = {
     "fetch_hang": "step_fetch",          # at the loss/grad-norm fetch
     "torn_write": "checkpoint_write",    # between array commit and marker
     "preempt": "preempt",                # process loss, between steps
+    # numeric (silent-corruption) kinds — mutations, not exceptions
+    "grad_bitflip": "micro_dispatch",    # flip one bit in a grad-acc leaf
+    "nan_loss": "micro_dispatch",        # NaN the micro batch / loss
+    "data_poison": "micro_dispatch",     # scale the micro batch to garbage
 }
 TRAIN_HOOK_POINTS = ("micro_dispatch", "step_fetch", "checkpoint_write",
                      "preempt")
+# the silent-corruption subset: injected as value mutations on the happy
+# path (the injector returns the fired record instead of raising)
+TRAIN_NUMERIC_KINDS: FrozenSet[str] = frozenset(
+    {"grad_bitflip", "nan_loss", "data_poison"})
+
+#: default scale for ``data_poison`` when the plan leaves ``factor`` unset
+DEFAULT_POISON_FACTOR = 1000.0
 
 
 @dataclass
 class TrainFault(PlannedFault):
     """One planned train fault, keyed on the global optimizer step (the
     fault becomes due once the engine's ``global_steps``-derived step
-    index reaches ``tick``; JSONL spells the field ``step``)."""
+    index reaches ``tick``; JSONL spells the field ``step``).
+
+    The numeric kinds carry optional targeting fields; their zero values
+    mean "derive deterministically from the plan step" (see
+    :func:`plan_bitflip`) so a bare ``{"step": 7, "kind": "grad_bitflip"}``
+    record replays identically everywhere."""
+
+    leaf: str = ""       # grad_bitflip: dotted grad-leaf path ("" = seeded)
+    bit: int = -1        # grad_bitflip: fp32 bit index 0..31 (-1 = seeded)
+    factor: float = 0.0  # data_poison: scale (0.0 = DEFAULT_POISON_FACTOR)
 
     KINDS: ClassVar[Dict[str, str]] = TRAIN_FAULT_KINDS
     POINTS: ClassVar[Tuple[str, ...]] = TRAIN_HOOK_POINTS
     TICK_KEY: ClassVar[str] = "step"
+    EXTRA_FIELDS: ClassVar[Tuple[str, ...]] = ("leaf", "bit", "factor")
+    SYNTH_KINDS: ClassVar[Tuple[str, ...]] = (
+        "dispatch_error", "fetch_hang", "torn_write", "preempt")
+
+    def __post_init__(self):
+        super().__post_init__()
+        if not -1 <= self.bit <= 31:
+            raise ValueError("grad_bitflip bit must be in [-1, 31] "
+                             f"(got {self.bit})")
 
     @property
     def step(self) -> int:
@@ -391,3 +459,66 @@ class TrainFaultInjector(PlannedFaultInjector):
                   "fetch_hang": StepFetchHang,
                   "torn_write": TornCheckpointWrite}
     PREEMPT_EXCEPTION = TrainPreempted
+    MUTATION_KINDS = TRAIN_NUMERIC_KINDS
+
+
+# ---------------------------------------------------------------------------
+# numeric corruption helpers (pure numpy — host-side, seeded by plan step)
+# ---------------------------------------------------------------------------
+
+
+def plan_bitflip(step: int, sizes: Dict[str, int], leaf: str = "",
+                 bit: int = -1) -> Tuple[str, int, int]:
+    """Resolve a ``grad_bitflip`` record's target deterministically.
+
+    ``sizes`` maps grad-leaf path -> element count. Unset plan fields
+    derive from the plan step — leaf by round-robin over the sorted
+    paths, bit from the exponent/high-mantissa byte (23..30, where a
+    flip is large enough to surface), element by a Knuth-hash stride —
+    so a bare record replays onto the same (leaf, element, bit) triple
+    on every run. Returns ``(leaf_path, element_index, bit_index)``."""
+    if not sizes:
+        raise ValueError("plan_bitflip: no grad leaves to target")
+    names = sorted(sizes)
+    name = leaf if leaf else names[step % len(names)]
+    if name not in sizes:
+        raise KeyError(f"plan_bitflip: unknown grad leaf {name!r} "
+                       f"(choose from {names})")
+    b = bit if bit >= 0 else 23 + (step % 8)
+    elem = (step * 2654435761) % max(int(sizes[name]), 1)
+    return name, elem, b
+
+
+def flip_float_bit(arr, elem: int, bit: int):
+    """A copy of float32 ``arr`` with bit ``bit`` (0=LSB mantissa …
+    30=MSB exponent, 31=sign) of flat element ``elem`` flipped — the
+    classic SDC: one wrong bit in an accumulator, nothing raises."""
+    a = np.ascontiguousarray(arr, dtype=np.float32)
+    flat = a.reshape(-1).copy()
+    words = flat.view(np.uint32)
+    words[elem % flat.size] ^= np.uint32(1) << np.uint32(bit % 32)
+    return flat.reshape(a.shape)
+
+
+def poison_array(arr, factor: float = DEFAULT_POISON_FACTOR):
+    """Deterministically corrupt one batch leaf: float leaves scale by
+    ``factor`` (garbage magnitudes, still finite — the loss spikes but
+    no inf check trips); integer leaves (token ids / targets) are
+    scrambled in-range by an affine permutation so embedding lookups
+    stay legal but the content is wrong."""
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.floating):
+        return a * np.asarray(factor, dtype=a.dtype)
+    if np.issubdtype(a.dtype, np.integer) and a.size:
+        hi = max(int(a.max()) + 1, 1)
+        return ((a.astype(np.int64) * 31 + 7) % hi).astype(a.dtype)
+    return a
+
+
+def nan_poison_array(arr):
+    """Float leaves become all-NaN (the loss and every grad touching the
+    leaf follow); non-float leaves pass through unchanged."""
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, np.floating):
+        return np.full_like(a, np.nan)
+    return a

@@ -40,6 +40,10 @@ class TpuDataLoader:
         # and how many to skip on the next pass (load_state_dict)
         self._batches_yielded = 0
         self._resume_batch = 0
+        # numerical-health quarantine: (epoch, batch-index) slots excluded
+        # from iteration — skipped but still *counted*, so cursors taken
+        # before and after a quarantine name the same positions
+        self._quarantined = set()
         try:
             self._len = len(dataset)
         except TypeError:
@@ -53,14 +57,25 @@ class TpuDataLoader:
     def set_epoch(self, epoch: int):
         self.epoch = epoch
 
+    def quarantine(self, epoch: int, batch: int):
+        """Exclude one (epoch, batch-index) slot from iteration. The slot
+        is skipped but batch numbering is unchanged, so an existing
+        cursor still names the same stream position and a rewound replay
+        sees the identical sequence *minus* the quarantined batch — the
+        numerical-health supervisor's skip rung (docs/training.md)."""
+        self._quarantined.add((int(epoch), int(batch)))
+
     def state_dict(self) -> dict:
         """Resume cursor: how far into the deterministic (seed, epoch)
         stream this loader has advanced. Restoring it on a fresh loader
         replays the exact same batch sequence from that point — the
         checkpoint client_state carries it so resumed training sees the
         batches the crashed run would have seen (bitwise)."""
-        return {"epoch": self.epoch, "batch": self._batches_yielded,
-                "seed": self.seed}
+        out = {"epoch": self.epoch, "batch": self._batches_yielded,
+               "seed": self.seed}
+        if self._quarantined:
+            out["quarantined"] = sorted(list(q) for q in self._quarantined)
+        return out
 
     def load_state_dict(self, state: dict):
         if self._len is None:
@@ -74,6 +89,11 @@ class TpuDataLoader:
                 "differ, so the cursor does not name the same batches")
         self.epoch = int(state.get("epoch", 0))
         self._resume_batch = int(state.get("batch", 0))
+        # the cursor is authoritative for the skip-list too (the
+        # supervisor re-applies its own journal after a rewind, since a
+        # snapshot cursor can predate later quarantines)
+        self._quarantined = {(int(e), int(b))
+                             for e, b in state.get("quarantined", [])}
 
     def __iter__(self):
         if self._len is None:
@@ -95,6 +115,10 @@ class TpuDataLoader:
                 0, n - (self.batch_size - 1 if self.drop_last else 0),
                 self.batch_size)):
             if b < skip:
+                continue
+            if (self.epoch, b) in self._quarantined:
+                # skipped, not renumbered: position advances past the slot
+                self._batches_yielded = b + 1
                 continue
             idx = order[start : start + self.batch_size]
             if pcount > 1 and shard and self.batch_size % pcount == 0:
@@ -140,3 +164,6 @@ class RepeatingLoader:
         self.loader.load_state_dict(state)
         # drop the live iterator: the next __next__ must honor the cursor
         self.data_iter = iter(self.loader)
+
+    def quarantine(self, epoch: int, batch: int):
+        self.loader.quarantine(epoch, batch)

@@ -532,6 +532,13 @@ class TpuEngine:
         self.fault_hook = None          # callable(point, info) or None
         self.fetch_timeout_s = None     # step-fetch watchdog seconds; None = off
         self.poisoned = False
+        # numeric (silent-corruption) fault surface + sentinel support:
+        # a grad_bitflip directive waits here until the accumulation
+        # boundary; the jits are built lazily on the fault/probe paths
+        self._pending_bitflip = None    # fired numeric-fault record or None
+        self._force_nan_loss = False    # nan_loss fallback for int-only batches
+        self._discard_acc_fn = None     # donated zeroing for quarantine
+        self._probe_zero_fn = None      # non-donated zeros for the SDC probe
 
         # --- activation checkpointing (reference: engine.py:872
         # _configure_checkpointing); models read the policy via
@@ -645,14 +652,19 @@ class TpuEngine:
             self.gradient_accumulation_steps if not cfg.prescale_gradients else 1.0
         )
         if self.coordinator is not None:
-            grads = self.coordinator.consume_grads(denom)
             part = self.coordinator.partition
-            sq = sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())
-            gnorm = float(np.sqrt(part.reduce_sum(sq)))  # partitioned: global norm
             overflow = False
             if self.fp16_enabled:
-                bad = any(not np.all(np.isfinite(g)) for g in grads.values())
-                overflow = part.reduce_sum(1.0 if bad else 0.0) > 0.0
+                # one device-scalar fetch: the coordinator AND-folded a
+                # jitted finiteness reduction over each grad chunk as it
+                # streamed through backward (the _grad_stats pattern),
+                # replacing the old per-step host np.isfinite pass over
+                # every gradient byte
+                overflow = part.reduce_sum(
+                    0.0 if self.coordinator.grads_finite() else 1.0) > 0.0
+            grads = self.coordinator.consume_grads(denom)
+            sq = sum(float((g.astype(np.float64) ** 2).sum()) for g in grads.values())
+            gnorm = float(np.sqrt(part.reduce_sum(sq)))  # partitioned: global norm
             scale_harvested = True  # coordinator grads arrive pre-divided
         else:
             # device-side stats run while the async D2H copies (kicked off in
@@ -1099,17 +1111,90 @@ class TpuEngine:
             # fires BEFORE the RNG splits or grad_acc is donated: an
             # injected micro_dispatch fault here leaves the engine exactly
             # as it was, so the supervisor's retry of the same batch is
-            # bitwise the micro-step that would have run
-            self.fault_hook("micro_dispatch",
-                            {"step": self.global_steps + 1,
-                             "micro": self.micro_steps})
+            # bitwise the micro-step that would have run. Numeric kinds
+            # (faults.TRAIN_NUMERIC_KINDS) come back as a directive record
+            # instead of raising — the values get corrupted and the step
+            # keeps running: silent by design, the sentinel's problem
+            directive = self.fault_hook("micro_dispatch",
+                                        {"step": self.global_steps + 1,
+                                         "micro": self.micro_steps})
+            if directive is not None:
+                batch = self._apply_numeric_fault(directive, batch)
         try:
-            return self._forward_body(batch, rng)
+            loss = self._forward_body(batch, rng)
         except BaseException:
             # anything past the dispatch barrier may have consumed RNG or
             # donated grad_acc — poison so recovery rebuilds, never retries
             self.poisoned = True
             raise
+        if self._force_nan_loss:
+            # nan_loss on a batch with no float leaves (token-id inputs):
+            # the reported loss is corrupted instead of the data
+            self._force_nan_loss = False
+            loss = np.float32(np.nan)
+            self._pending_loss = loss
+        return loss
+
+    def _apply_numeric_fault(self, record: dict, batch):
+        """Apply a numeric-fault directive the injector handed back
+        (faults.py TRAIN_NUMERIC_KINDS). ``data_poison`` / ``nan_loss``
+        corrupt the host batch before sharding; ``grad_bitflip`` is
+        deferred to the accumulation boundary (step()), where the
+        accumulator holds the whole step's gradient."""
+        from deepspeed_tpu import faults as _faults
+
+        kind = record.get("kind")
+        if kind == "data_poison":
+            factor = (float(record.get("factor") or 0.0)
+                      or _faults.DEFAULT_POISON_FACTOR)
+            return jax.tree.map(
+                lambda a: _faults.poison_array(a, factor), batch)
+        if kind == "nan_loss":
+            leaves = jax.tree.leaves(batch)
+            if any(np.issubdtype(np.asarray(l).dtype, np.floating)
+                   for l in leaves):
+                return jax.tree.map(_faults.nan_poison_array, batch)
+            self._force_nan_loss = True
+            return batch
+        if kind == "grad_bitflip":
+            self._pending_bitflip = record
+            return batch
+        return batch
+
+    def _apply_grad_bitflip(self, record: dict):
+        """Flip one bit of one accumulated-gradient element (an injected
+        SDC). The (leaf, element, bit) target resolves deterministically
+        from the plan record (faults.plan_bitflip), and the record is
+        annotated with the resolved target for the injector's fired log.
+        One-leaf host round-trip — fault path only, never the hot path."""
+        from deepspeed_tpu import faults as _faults
+
+        step = int(record.get("step", self.global_steps + 1))
+        leaf = str(record.get("leaf", "") or "")
+        bit = int(record.get("bit", -1))
+        if self.coordinator is not None:
+            grads = self.coordinator.host_grads
+            if not grads:
+                return
+            sizes = {k: int(np.asarray(v).size) for k, v in grads.items()}
+            name, elem, bit = _faults.plan_bitflip(step, sizes, leaf, bit)
+            grads[name] = _faults.flip_float_bit(grads[name], elem, bit)
+        else:
+            named = {
+                _leaf_key(p): l
+                for p, l in jax.tree_util.tree_leaves_with_path(self.grad_acc)
+            }
+            sizes = {k: int(l.size) for k, l in named.items()}
+            name, elem, bit = _faults.plan_bitflip(step, sizes, leaf, bit)
+            target = named[name]
+            host = np.asarray(jax.device_get(target), dtype=np.float32)
+            corrupted = jax.device_put(
+                _faults.flip_float_bit(host, elem, bit), target.sharding)
+            self.grad_acc = jax.tree_util.tree_map_with_path(
+                lambda p, l: corrupted if _leaf_key(p) == name else l,
+                self.grad_acc)
+        record["leaf"], record["bit"] = name, bit
+        record.setdefault("elem", elem)
 
     def _forward_body(self, batch, rng=None):
         self.timers(EngineTimers.FORWARD).start()
@@ -1203,6 +1288,11 @@ class TpuEngine:
         if not self.is_gradient_accumulation_boundary():
             self.tput_timer.stop(global_step=False)
             return
+        if self._pending_bitflip is not None:
+            # the deferred grad_bitflip lands now, after every micro-step
+            # accumulated and before the apply program consumes grad_acc
+            record, self._pending_bitflip = self._pending_bitflip, None
+            self._apply_grad_bitflip(record)
         try:
             self._step_body()
         except BaseException:
@@ -1397,6 +1487,74 @@ class TpuEngine:
         if self._last_metrics is None:
             return None
         return float(self._last_metrics.grad_norm)
+
+    # ------------------------------------------------------------------
+    # numerical health (docs/training.md "Numerical health"): the three
+    # engine seams the NumericSentinel/TrainSupervisor pair drives
+    # ------------------------------------------------------------------
+    def step_health_scalars(self) -> Optional[dict]:
+        """The per-step host scalars the sentinel consumes — the same
+        StepMetrics values the step already materialized (fetched in
+        _guarded_fetch / the fp16 overflow sync / telemetry), so reading
+        them here adds no device sync the step wasn't already paying."""
+        m = self._last_metrics
+        if m is None:
+            return None
+        return {
+            "grad_norm": float(m.grad_norm),
+            "overflow": bool(m.overflow),
+            "loss_scale": float(m.loss_scale),
+        }
+
+    def discard_accumulated_grads(self):
+        """Zero the accumulated gradients WITHOUT applying them — the
+        supervisor's quarantine rung. Params, optimizer state, loss
+        scale and step counters are untouched, so the next step proceeds
+        exactly as if the flagged batch had been excluded from the
+        stream (the loader's skip-list makes that exclusion durable)."""
+        self._pending_bitflip = None
+        self._force_nan_loss = False
+        self._wire_grads = None
+        if self.coordinator is not None:
+            self.coordinator.discard_grads()
+            return
+        if self.grad_acc is None:
+            return
+        if self._discard_acc_fn is None:
+            self._discard_acc_fn = jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=self.grad_shardings,
+                donate_argnums=0,
+            )
+        self.grad_acc = self._discard_acc_fn(self.grad_acc)
+
+    def sdc_probe(self, batch, rng_seed: int = 0) -> Optional[int]:
+        """One sentinel micro-step, out of band: run the compiled micro
+        program on ``batch`` with a FIXED rng key into a throwaway zero
+        accumulator — the engine's RNG stream, grad_acc and counters are
+        untouched — and return a CRC-32 of the resulting grad bytes.
+        Back-to-back probes on the same batch are bitwise identical on a
+        healthy mesh (same program, same inputs), so a digest mismatch
+        is nondeterministic hardware corruption. Returns None where no
+        standalone micro program exists (param-offload coordinator)."""
+        if self._micro_fn is None or self.grad_acc is None:
+            return None
+        from deepspeed_tpu.runtime.numerics import crc_digest
+
+        if self._probe_zero_fn is None:
+            # non-donating on purpose: the template (grad_acc) survives
+            self._probe_zero_fn = jax.jit(
+                lambda t: jax.tree.map(jnp.zeros_like, t),
+                out_shardings=self.grad_shardings,
+            )
+        zeros = self._probe_zero_fn(self.grad_acc)
+        sharded = self._shard_batch(batch)
+        rng = jax.random.PRNGKey(rng_seed)
+        theta = jnp.float32(self.pld.get_theta() if self.pld is not None else 1.0)
+        _, acc = self._micro_fn(
+            self.params, zeros, sharded, rng, self.scale_state.scale, theta)
+        return crc_digest(
+            np.asarray(jax.device_get(l)) for l in jax.tree.leaves(acc))
 
     def zero_optimization(self) -> bool:
         return self.zero_stage > 0

@@ -25,6 +25,30 @@ on the shared fault taxonomy in :mod:`deepspeed_tpu.faults`:
 - nothing restorable and no budget left is terminal:
   :class:`TrainingFailed`.
 
+With a :class:`~deepspeed_tpu.runtime.numerics.NumericSentinel` armed
+(``numeric_sentinel`` in the recovery config), two cheaper rungs sit
+*before* rebuild for the failures that never raise (docs/training.md
+"Numerical health"):
+
+- **quarantine** — a non-ok pre-apply loss verdict means the flagged
+  batch's grads were accumulated but never applied: discard them
+  (``engine.discard_accumulated_grads``), journal the batch's data
+  cursor, add it to the loader's skip-list, and retry the step with the
+  next batch. The generalization of the loss scaler's skip: params
+  match a run trained with that batch excluded, bitwise (for models
+  whose per-micro RNG does not reach the loss — see the docs caveat).
+- **rewind-and-replay** — a ``corrupt`` post-apply verdict (grad-norm
+  explosion, NaN beyond fp16, SDC probe mismatch) means wrong state was
+  already committed: restore the newest in-memory snapshot onto the
+  LIVE engine (no factory, no recompile — the engine is not poisoned,
+  its numbers are merely wrong) and replay forward with quarantined
+  batches excluded, reusing the bitwise-resume machinery above.
+
+Exhausting either budget (``max_quarantines`` / ``max_rewinds``), or
+needing a rewind with no snapshot taken, raises
+:class:`~deepspeed_tpu.runtime.numerics.NumericCorruption` into the
+ordinary ladder.
+
 What makes resume *bitwise* at the same world size (the parity gate in
 tests/unit/runtime/test_resilience.py): a snapshot is ONE atomic unit —
 params / optimizer state / LR scheduler / step counters / the raw RNG
@@ -52,6 +76,11 @@ from deepspeed_tpu.faults import (
     TrainPreempted,
 )
 from deepspeed_tpu.runtime.checkpoint_engine import integrity as ckpt_integrity
+from deepspeed_tpu.runtime.numerics import (
+    NumericCorruption,
+    NumericSentinel,
+    Verdict,
+)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -91,6 +120,12 @@ class TrainRecoveryConfig:
       at most once, in order.
     - ``verify_integrity``: recompute per-leaf checksums against the
       manifest on every disk restore.
+    - ``numeric_sentinel``: :class:`~deepspeed_tpu.runtime.numerics
+      .SentinelConfig` knobs (or an instance); None disarms the
+      numerical-health layer entirely.
+    - ``max_quarantines`` / ``max_rewinds``: budgets for the sentinel's
+      two rungs; exhaustion escalates into the ordinary ladder as
+      :class:`~deepspeed_tpu.runtime.numerics.NumericCorruption`.
     """
 
     fetch_timeout_s: Optional[float] = None
@@ -101,10 +136,17 @@ class TrainRecoveryConfig:
     snapshot_dir: Optional[str] = None
     degrade_world_sizes: Sequence[int] = ()
     verify_integrity: bool = True
+    numeric_sentinel: Optional[Any] = None
+    max_quarantines: int = 8
+    max_rewinds: int = 4
 
     def __post_init__(self):
         if self.max_step_retries < 0:
             raise ValueError("max_step_retries must be >= 0")
+        if self.max_quarantines < 0:
+            raise ValueError("max_quarantines must be >= 0")
+        if self.max_rewinds < 0:
+            raise ValueError("max_rewinds must be >= 0")
         if self.backoff_s < 0:
             raise ValueError("backoff_s must be >= 0")
         if self.max_rebuilds < 1:
@@ -164,6 +206,16 @@ def _slice_rows(tree, lo: int, hi: int):
     return tree[lo:hi]
 
 
+def _copy_tree(tree):
+    """Host deep-copy of a (dict/tuple/list of) array batch — the SDC
+    probe's pinned batch must not alias live buffers."""
+    if isinstance(tree, dict):
+        return {k: _copy_tree(v) for k, v in tree.items()}
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(_copy_tree(v) for v in tree)
+    return np.array(tree)
+
+
 def slice_micro_batches(batch, gas: int) -> List[Any]:
     """Split one GLOBAL batch into ``gas`` row-contiguous micro-batches.
     The supervisor pulls global batches (loader batch_size ==
@@ -215,6 +267,15 @@ class TrainSupervisor:
         self._degrade_idx = 0          # entries of degrade_world_sizes used
         self._world_size: Optional[int] = None  # None = factory default
         self._recovery_ms: List[float] = []
+        # numerical-health layer (disarmed unless the config asks for it)
+        self.sentinel = (NumericSentinel(self.cfg.numeric_sentinel)
+                         if self.cfg.numeric_sentinel is not None else None)
+        self._quarantine_journal: List[dict] = []
+        self._quarantine_count = 0
+        self._rewind_count = 0
+        self._sdc_probes = 0
+        self._sdc_mismatches = 0
+        self._pinned_batch = None      # first micro-batch seen, host copy
         self._clock = time.perf_counter
         self._sleep = time.sleep
 
@@ -274,17 +335,51 @@ class TrainSupervisor:
             eng.fault_hook("preempt", {"step": step_no})
         gas = eng.gradient_accumulation_steps
         batch = self._next_global_batch()
+        # the cursor AFTER next() names the batch just consumed as
+        # (epoch, batch - 1) — correct across an epoch rollover, where
+        # epoch already advanced and batch restarted at 1
+        cursor = (self.loader.state_dict()
+                  if hasattr(self.loader, "state_dict") else None)
         micros = slice_micro_batches(batch, gas)
+        if (self.sentinel is not None and self.sentinel.cfg.sdc_probe_every
+                and self._pinned_batch is None):
+            self._pinned_batch = _copy_tree(micros[0])
         micro_losses = []
         for m, mb in enumerate(micros):
             micro_losses.append(self._run_micro(mb, step_no, m))
-        eng.step()
         # fetched per-micro (float() syncs) and reduced in float32 the
         # same way on every run — the bitwise-compared loss stream
-        self._step_losses[step_no] = float(
+        loss_val = float(
             np.mean(np.asarray(micro_losses, dtype=np.float32),
                     dtype=np.float32))
+        if self.sentinel is not None:
+            # PRE-apply window: the batch's grads are accumulated but not
+            # applied — a non-ok loss verdict can still quarantine it
+            verdict = self.sentinel.check_loss(step_no, loss_val)
+            if not verdict.ok:
+                self._quarantine(step_no, verdict, cursor, loss_val)
+                return  # step_no not advanced; the loop retries with the next batch
+        eng.step()
+        self._step_losses[step_no] = loss_val
+        if self.sentinel is not None:
+            scal = eng.step_health_scalars() or {}
+            v2 = self.sentinel.check_step(
+                step_no, scal.get("grad_norm", 0.0),
+                scal.get("overflow", False), scal.get("loss_scale", 1.0))
+            if not v2.ok:
+                self._numeric_event("anomaly", step=step_no,
+                                    verdict=v2.verdict, reasons=v2.reasons,
+                                    loss=loss_val,
+                                    grad_norm=scal.get("grad_norm", 0.0),
+                                    grad_ratio=round(v2.grad_ratio, 6))
+                self._count_anomalies(v2)
+            if v2.corrupt:
+                # wrong state is already committed — un-commit it BEFORE
+                # the snapshot cadence could capture the corrupted params
+                self._rewind_and_replay(step_no, v2)
+                return
         self._maybe_snapshot(step_no)
+        self._maybe_sdc_probe(step_no)
 
     def _run_micro(self, micro_batch, step_no: int, micro: int):
         """One forward/backward with the clean-retry budget. Only a
@@ -313,6 +408,121 @@ class TrainSupervisor:
                     self._tele.registry.counter("step_retry_total").inc()
 
     # ------------------------------------------------------------------
+    # numerical-health rungs (quarantine < rewind < the ordinary ladder)
+    # ------------------------------------------------------------------
+    def _quarantine(self, step_no: int, verdict: Verdict,
+                    cursor: Optional[dict], loss_val: float):
+        """Skip rung: the flagged batch's grads were never applied.
+        Discard the accumulation, journal + skip-list the batch, and let
+        the main loop retry the step with the next batch."""
+        if self._quarantine_count >= self.cfg.max_quarantines:
+            raise NumericCorruption(
+                f"max_quarantines={self.cfg.max_quarantines} exhausted at "
+                f"step {step_no} ({'/'.join(verdict.reasons)})", verdict)
+        self._quarantine_count += 1
+        if cursor is not None:
+            epoch, bidx = int(cursor["epoch"]), int(cursor["batch"]) - 1
+        else:
+            epoch, bidx = -1, -1  # loader has no cursor: journal-only
+        self._quarantine_journal.append({
+            "step": step_no, "epoch": epoch, "batch": bidx,
+            "verdict": verdict.verdict, "reasons": list(verdict.reasons)})
+        if bidx >= 0 and hasattr(self.loader, "quarantine"):
+            self.loader.quarantine(epoch, bidx)
+        self.engine.discard_accumulated_grads()
+        self._count_anomalies(verdict)
+        self._numeric_event("quarantine", step=step_no, epoch=epoch,
+                            batch=bidx, verdict=verdict.verdict,
+                            reasons=list(verdict.reasons), loss=loss_val,
+                            zscore=round(verdict.zscore, 6))
+        if self._tele is not None and self._tele.enabled:
+            self._tele.registry.counter("batch_quarantine_total").inc()
+        logger.warning(
+            f"quarantined batch (epoch {epoch}, batch {bidx}) at step "
+            f"{step_no}: {verdict.verdict} ({'/'.join(verdict.reasons)}, "
+            f"loss={loss_val:.6g}, z={verdict.zscore:.1f})")
+
+    def _rewind_and_replay(self, step_no: int, verdict: Verdict):
+        """Rewind rung: corrupted state was committed, but the engine
+        itself is healthy — restore the newest in-memory snapshot onto
+        the LIVE engine (no factory, no recompile) and replay forward
+        with quarantined batches excluded."""
+        if not self._snapshots:
+            raise NumericCorruption(
+                f"corrupt verdict at step {step_no} "
+                f"({'/'.join(verdict.reasons)}) with no snapshot to rewind "
+                "to", verdict)
+        if self._rewind_count >= self.cfg.max_rewinds:
+            raise NumericCorruption(
+                f"max_rewinds={self.cfg.max_rewinds} exhausted at step "
+                f"{step_no} ({'/'.join(verdict.reasons)})", verdict)
+        t0 = self._clock()
+        self._rewind_count += 1
+        snap = self._snapshots[-1]
+        eng = self.engine
+        eng.restore_from_host_state(
+            snap.host_tree, snap.meta,
+            verify_integrity=snap.manifest if self.cfg.verify_integrity
+            else None)
+        eng.set_rng_state(snap.rng_key)
+        self._rewind_loader(snap.cursor)
+        self.sentinel.note_rewind()
+        rewind_ms = (self._clock() - t0) * 1000.0
+        self._numeric_event("rewind", step=step_no,
+                            resume_step=snap.step,
+                            replayed_steps=max(0, step_no - snap.step),
+                            verdict=verdict.verdict,
+                            reasons=list(verdict.reasons),
+                            rewind_ms=round(rewind_ms, 3))
+        if self._tele is not None and self._tele.enabled:
+            self._tele.registry.counter("rewind_total").inc()
+        logger.warning(
+            f"rewind-and-replay after {verdict.verdict} at step {step_no} "
+            f"({'/'.join(verdict.reasons)}): restored step {snap.step} "
+            f"snapshot in {rewind_ms:.1f} ms, replaying "
+            f"{max(0, step_no - snap.step)} steps")
+
+    def _maybe_sdc_probe(self, step_no: int):
+        """Every ``sdc_probe_every`` steps, replay the pinned sentinel
+        micro-step twice and CRC-compare the grad bytes — a mismatch is
+        nondeterministic hardware corruption (always ``corrupt``)."""
+        if (self.sentinel is None or not self.sentinel.cfg.sdc_probe_every
+                or step_no % self.sentinel.cfg.sdc_probe_every
+                or self._pinned_batch is None
+                or not hasattr(self.engine, "sdc_probe")):
+            return
+        d1 = self.engine.sdc_probe(self._pinned_batch)
+        if d1 is None:  # engine path without a probe-capable micro fn
+            return
+        d2 = self.engine.sdc_probe(self._pinned_batch)
+        self._sdc_probes += 1
+        match = d1 == d2
+        self._numeric_event("sdc_probe", step=step_no, digest=int(d1),
+                            match=bool(match))
+        if match:
+            return
+        self._sdc_mismatches += 1
+        v = self.sentinel.flag_sdc_mismatch(step_no)
+        self._count_anomalies(v)
+        logger.warning(
+            f"SDC probe mismatch at step {step_no}: digests {d1:#010x} != "
+            f"{d2:#010x} — treating committed state as corrupt")
+        self._rewind_and_replay(step_no, v)
+
+    def _count_anomalies(self, verdict: Verdict):
+        if self._tele is None or not self._tele.enabled:
+            return
+        for reason in verdict.reasons:
+            self._tele.registry.counter(
+                "numeric_anomaly_total", {"kind": reason}).inc()
+
+    def _numeric_event(self, event: str, **fields):
+        if self._tele is not None and self._tele.enabled:
+            payload = {"event": event}
+            payload.update(fields)
+            self._tele.emit("numeric_health", payload)
+
+    # ------------------------------------------------------------------
     # data
     # ------------------------------------------------------------------
     def _next_global_batch(self):
@@ -329,6 +539,13 @@ class TrainSupervisor:
     def _rewind_loader(self, cursor: Optional[dict]):
         if hasattr(self.loader, "load_state_dict"):
             self.loader.load_state_dict(cursor or {"epoch": 0, "batch": 0})
+            # a snapshot cursor can predate later quarantines, and
+            # load_state_dict REPLACES the skip-list — re-apply the
+            # supervisor's journal so the replay excludes them too
+            if hasattr(self.loader, "quarantine"):
+                for rec in self._quarantine_journal:
+                    if rec["batch"] >= 0:
+                        self.loader.quarantine(rec["epoch"], rec["batch"])
         self._data_iter = None
 
     # ------------------------------------------------------------------
@@ -589,7 +806,13 @@ class TrainSupervisor:
             "snapshots": self._snapshots_taken,
             "degrade_level": self._degrade_idx,
             "world_size": self._world_size,
+            "quarantines": self._quarantine_count,
+            "rewinds": self._rewind_count,
+            "sdc_probes": self._sdc_probes,
+            "sdc_mismatches": self._sdc_mismatches,
         }
+        if self.sentinel is not None:
+            out["numeric_anomalies"] = dict(self.sentinel.anomalies)
         if self._recovery_ms:
             from deepspeed_tpu.telemetry.registry import percentile
 

@@ -289,6 +289,13 @@ class ParamOffloadCoordinator:
         # host-side fp32 grad accumulators, zeroed lazily
         self.host_grads: Dict[str, np.ndarray] = {}
         self.stats = {"h2d_bytes": 0, "max_live_group_bytes": 0, "steps": 0}
+        # on-device finiteness accumulator (engine._grad_stats pattern):
+        # each grad chunk folds one jitted all-finite scalar in as it
+        # streams through backward; grads_finite() fetches ONE scalar per
+        # optimizer step instead of the old host np.isfinite pass over
+        # every gradient byte
+        self._finite_dev = None
+        self._finite_fn = None
 
         self._compile()
         log_dist(
@@ -495,6 +502,7 @@ class ParamOffloadCoordinator:
             del sl
 
         loss_scaled, (douter, dx) = self._head_vag(outer_dev, ckpts[-1], batch, scale_arr)
+        self._note_grads(douter)
 
         aux_cot = jnp.float32(scale * cfg.moe_aux_loss_coef) if cfg.moe_num_experts > 0 else jnp.float32(0.0)
         pending = None  # (lo, hi, dlayers) — harvested one group late for D2H overlap
@@ -502,6 +510,7 @@ class ParamOffloadCoordinator:
             lo, hi = self.group_bounds[g]
             sl = self._put_group(g, prefetch_next=g - 1 if g > 0 else None)
             dx, dlayers = self._group_bwd(sl, ckpts[g], dx, aux_cot, self._group_windows[g])
+            self._note_grads(dlayers)
             jax.tree.map(lambda a: a.copy_to_host_async(), dlayers)
             if pending is not None:
                 self._accumulate("layers.", pending[2], pending[0], pending[1])
@@ -511,6 +520,7 @@ class ParamOffloadCoordinator:
             self._accumulate("layers.", pending[2], pending[0], pending[1])
 
         dout_embed = self._embed_bwd(outer_dev, tokens, dx)
+        self._note_grads(dout_embed)
         self._accumulate("", douter)
         self._accumulate("", dout_embed)
 
@@ -534,6 +544,34 @@ class ParamOffloadCoordinator:
         aux_total = sum(float(a) for a in auxs) if cfg.moe_num_experts > 0 else 0.0
         return float(loss) + cfg.moe_aux_loss_coef * aux_total
 
+    def _note_grads(self, tree):
+        """Fold one jitted all-finite reduction over a device grad chunk
+        into the step's finiteness accumulator — stays on device, reads
+        nothing. Per-chunk (pre-sum) finiteness is checked rather than
+        the summed accumulator's: inf/NaN propagate through the host
+        add, so a bad chunk is caught at least as early."""
+        if self._finite_fn is None:
+            def all_finite(t):
+                leaves = jax.tree.leaves(t)
+                return jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(l)) for l in leaves]))
+            self._finite_fn = jax.jit(all_finite)
+        f = self._finite_fn(tree)
+        self._finite_dev = (f if self._finite_dev is None
+                            else jnp.logical_and(self._finite_dev, f))
+
+    def grads_finite(self) -> bool:
+        """One scalar fetch: True when every grad chunk this step was
+        finite (vacuously True with no grads). Resets the accumulator."""
+        flag, self._finite_dev = self._finite_dev, None
+        return True if flag is None else bool(flag)
+
+    def discard_grads(self):
+        """Drop the accumulated host grads without applying them — the
+        supervisor's quarantine rung on the param-offload path."""
+        self.host_grads = {}
+        self._finite_dev = None
+
     # -- optimizer-step plumbing ------------------------------------------
     def consume_grads(self, denom: float) -> Dict[str, np.ndarray]:
         """Hand the accumulated fp32 grads (divided by ``denom``) to the host
@@ -543,6 +581,7 @@ class ParamOffloadCoordinator:
             g = self.host_grads.get(key)
             grads[key] = (g / denom) if g is not None else np.zeros_like(master)
         self.host_grads = {}
+        self._finite_dev = None
         return grads
 
     def refresh_working(self, masters: Dict[str, np.ndarray]):

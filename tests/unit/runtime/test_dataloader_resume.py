@@ -76,6 +76,82 @@ class TestCursorRoundtrip:
         np.testing.assert_array_equal(next(iter(rl))["x"], first)
 
 
+class TestQuarantine:
+    """The numerical-health skip-list (docs/training.md "Numerical
+    health"): a quarantined (epoch, batch) slot is skipped but still
+    COUNTED, so cursors keep naming the same stream positions and a
+    rewound replay sees the identical sequence minus the bad batch."""
+
+    def test_skip_preserves_numbering(self):
+        a = TpuDataLoader(_dataset(), batch_size=8, seed=7, shuffle=True)
+        clean = _collect(a, 4)  # 4 batches in epoch 0
+
+        b = TpuDataLoader(_dataset(), batch_size=8, seed=7, shuffle=True)
+        b.quarantine(0, 1)
+        got = _collect(b, 3)
+        for g, want in zip(got, [clean[0], clean[2], clean[3]]):
+            np.testing.assert_array_equal(g["x"], want["x"])
+        # the cursor advanced PAST the skipped slot, not around it
+        assert b.state_dict()["batch"] == 4
+
+    def test_cursor_position_counts_skipped_slot(self):
+        dl = TpuDataLoader(_dataset(), batch_size=8, seed=7, shuffle=True)
+        dl.quarantine(0, 0)
+        it = iter(dl)
+        next(it)  # yields batch 1 (batch 0 skipped)
+        assert dl.state_dict()["batch"] == 2
+
+    def test_state_dict_roundtrip_includes_skip_list(self):
+        dl = TpuDataLoader(_dataset(), batch_size=8, seed=5)
+        # back-compat: no quarantines = the pre-quarantine cursor shape
+        assert dl.state_dict() == {"epoch": 0, "batch": 0, "seed": 5}
+        dl.quarantine(0, 2)
+        dl.quarantine(1, 0)
+        cursor = dl.state_dict()
+        assert cursor["quarantined"] == [[0, 2], [1, 0]]
+
+        fresh = TpuDataLoader(_dataset(), batch_size=8, seed=5)
+        fresh.load_state_dict(cursor)
+        assert fresh._quarantined == {(0, 2), (1, 0)}
+        # the cursor is authoritative: loading a clean cursor CLEARS it
+        fresh.load_state_dict({"epoch": 0, "batch": 0, "seed": 5})
+        assert fresh._quarantined == set()
+
+    def test_quarantine_composes_with_resume_across_epochs(self):
+        # reference: clean 3-epoch stream minus epoch 1's batch 2
+        a = RepeatingLoader(TpuDataLoader(
+            _dataset(), batch_size=8, seed=3, shuffle=True))
+        a.quarantine(1, 2)
+        stream_a = [b["x"] for b in _collect(a, 11)]  # 4 + 3 + 4
+
+        # same loader resumed mid-epoch-1 from a cursor carrying the
+        # skip-list: the tail must match bitwise
+        b = RepeatingLoader(TpuDataLoader(
+            _dataset(), batch_size=8, seed=3, shuffle=True))
+        b.quarantine(1, 2)
+        for _ in range(5):  # epoch 0 (4) + first batch of epoch 1
+            next(iter(b))
+        cursor = b.state_dict()
+
+        c = RepeatingLoader(TpuDataLoader(
+            _dataset(), batch_size=8, seed=3, shuffle=True))
+        c.load_state_dict(cursor)
+        for i in range(5, 11):
+            np.testing.assert_array_equal(next(iter(c))["x"], stream_a[i])
+
+    def test_quarantined_epoch_only_applies_to_that_epoch(self):
+        rl = RepeatingLoader(TpuDataLoader(
+            _dataset(), batch_size=8, seed=9, shuffle=True))
+        rl.quarantine(0, 1)
+        got = [next(iter(rl))["x"] for _ in range(7)]  # 3 + 4
+
+        clean = RepeatingLoader(TpuDataLoader(
+            _dataset(), batch_size=8, seed=9, shuffle=True))
+        ref = [next(iter(clean))["x"] for _ in range(8)]
+        for g, want in zip(got, [ref[0], ref[2], ref[3]] + ref[4:]):
+            np.testing.assert_array_equal(g, want)
+
+
 class TestCursorValidation:
     def test_seed_mismatch_rejected(self):
         dl = TpuDataLoader(_dataset(), batch_size=8, seed=1)

@@ -1,0 +1,91 @@
+"""Curvature estimation (runtime/eigenvalue.py): power-iteration top
+Hessian eigenvalue against analytically-known quadratics — the
+quantization-boundary scheduler's input must be trustworthy numbers,
+not just "a float came back"."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+def _quadratic(diag):
+    """loss(p) = 1/2 pᵀ diag(d) p — Hessian IS diag(d), top |eig| known."""
+    d = jnp.asarray(diag, dtype=jnp.float32)
+
+    def loss_fn(params):
+        w = params["w"]
+        return 0.5 * jnp.sum(d * w * w)
+
+    return loss_fn
+
+
+class TestComputeEigenvalue:
+    def test_diagonal_quadratic_top_eigenvalue(self):
+        loss_fn = _quadratic([5.0, 2.0, 1.0, 0.5])
+        params = {"w": jnp.ones(4, dtype=jnp.float32)}
+        eig, vec = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+            loss_fn, params)
+        assert eig == pytest.approx(5.0, rel=1e-2)
+        # the eigenvector concentrates on the dominant coordinate
+        v = np.abs(np.asarray(vec["w"]))
+        assert v[0] == pytest.approx(1.0, abs=5e-2)
+        assert np.all(v[1:] < 0.1)
+
+    def test_hv_matches_lambda_v(self):
+        # the returned pair satisfies H v ≈ λ v (the defining property)
+        loss_fn = _quadratic([4.0, 3.0, 1.0])
+        params = {"w": jnp.array([0.3, -0.2, 0.9], dtype=jnp.float32)}
+        eig, vec = Eigenvalue(max_iter=300, tol=1e-5).compute_eigenvalue(
+            loss_fn, params)
+        hv = jax.jvp(jax.grad(loss_fn), (params,), (vec,))[1]
+        # tolerance scales with the dominant component: the residual
+        # directions stop improving once the eigenvalue meets tol
+        np.testing.assert_allclose(np.asarray(hv["w"]),
+                                   eig * np.asarray(vec["w"]),
+                                   rtol=0.05, atol=0.02)
+
+    def test_negative_dominant_curvature(self):
+        # power iteration converges to the LARGEST |eig| — sign included
+        loss_fn = _quadratic([-6.0, 2.0])
+        params = {"w": jnp.ones(2, dtype=jnp.float32)}
+        eig, _ = Eigenvalue(max_iter=300, tol=1e-4).compute_eigenvalue(
+            loss_fn, params)
+        assert eig == pytest.approx(-6.0, rel=5e-2)
+
+    def test_multi_leaf_tree_and_rng(self):
+        def loss_fn(params):
+            return (0.5 * jnp.sum(3.0 * params["a"] ** 2)
+                    + 0.5 * jnp.sum(7.0 * params["b"] ** 2))
+
+        params = {"a": jnp.ones((2, 2), dtype=jnp.float32),
+                  "b": jnp.ones(3, dtype=jnp.float32)}
+        ev = Eigenvalue(max_iter=300, tol=1e-4)
+        eig, vec = ev.compute_eigenvalue(loss_fn, params,
+                                         rng=jax.random.PRNGKey(11))
+        assert eig == pytest.approx(7.0, rel=1e-2)
+        assert set(vec) == {"a", "b"} and vec["a"].shape == (2, 2)
+
+    def test_iter_cache_reuses_compiled_fn(self):
+        loss_fn = _quadratic([2.0, 1.0])
+        params = {"w": jnp.ones(2, dtype=jnp.float32)}
+        ev = Eigenvalue(max_iter=100)
+        e1, _ = ev.compute_eigenvalue(loss_fn, params)
+        assert len(ev._iter_cache) == 1
+        cached = next(iter(ev._iter_cache.values()))
+        e2, _ = ev.compute_eigenvalue(
+            loss_fn, {"w": jnp.array([0.5, 0.25], dtype=jnp.float32)})
+        assert next(iter(ev._iter_cache.values())) is cached
+        assert len(ev._iter_cache) == 1
+        assert e1 == pytest.approx(e2, rel=1e-2)  # same Hessian everywhere
+
+        # a different param structure compiles (and caches) a second fn
+        def loss2(params):
+            return 0.5 * jnp.sum(params["w"] ** 2) + 0.5 * jnp.sum(params["u"] ** 2)
+
+        ev.compute_eigenvalue(loss2, {"w": jnp.ones(2, dtype=jnp.float32),
+                                      "u": jnp.ones(2, dtype=jnp.float32)})
+        assert len(ev._iter_cache) == 2

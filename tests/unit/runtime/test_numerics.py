@@ -1,0 +1,295 @@
+"""NumericSentinel policy unit tests — deliberately jax-free (the
+sentinel is pure numpy/stdlib and rides tools/ci_jaxfree_tests.py).
+
+The load-bearing property is the acceptance gate's zero-false-positive
+half: a clean converging 300-step stream with realistic jitter must
+never flag, while the corruption shapes the PR is about (spike, NaN,
+explosion, stall, SDC mismatch) flag within their windows.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.numerics import (
+    CORRUPT,
+    OK,
+    SUSPECT,
+    NumericCorruption,
+    NumericSentinel,
+    SentinelConfig,
+    Verdict,
+    crc_digest,
+)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class TestSentinelConfig:
+    def test_defaults_valid(self):
+        cfg = SentinelConfig()
+        assert cfg.loss_window == 32 and cfg.sdc_probe_every == 0
+
+    @pytest.mark.parametrize("kwargs", [
+        {"loss_window": 3},
+        {"min_history": 0},
+        {"min_history": 33},                       # > loss_window
+        {"loss_z_suspect": 0.0},
+        {"loss_z_suspect": 30.0},                  # > loss_z_corrupt
+        {"rel_floor": -0.1},
+        {"grad_ewma_alpha": 0.0},
+        {"grad_ewma_alpha": 1.5},
+        {"grad_band_suspect": 1.0},
+        {"grad_band_suspect": 200.0},              # > grad_band_corrupt
+        {"zero_grad_eps": -1e-9},
+        {"zero_grad_patience": 0},
+        {"sdc_probe_every": -1},
+    ])
+    def test_validation(self, kwargs):
+        with pytest.raises(ValueError):
+            SentinelConfig(**kwargs)
+
+    def test_parse(self):
+        assert SentinelConfig.parse(None) == SentinelConfig()
+        cfg = SentinelConfig(loss_window=16)
+        assert SentinelConfig.parse(cfg) is cfg
+        assert SentinelConfig.parse({"loss_window": 16}).loss_window == 16
+        with pytest.raises(TypeError):
+            SentinelConfig.parse("loose")
+        with pytest.raises(TypeError):
+            SentinelConfig.parse({"bogus_knob": 1})
+
+
+# ---------------------------------------------------------------------------
+# loss detector
+# ---------------------------------------------------------------------------
+
+class TestCheckLoss:
+    def _warm(self, sent, n=16, base=1.0):
+        for i in range(n):
+            v = sent.check_loss(i + 1, base + 0.01 * math.sin(i))
+            assert v.ok
+
+    def test_cold_start_never_flags(self):
+        sent = NumericSentinel()
+        # even absurd values pass before min_history accepted losses
+        for i in range(sent.cfg.min_history - 1):
+            assert sent.check_loss(i + 1, 10.0 ** i).ok
+
+    def test_spike_flags_suspect_then_corrupt(self):
+        sent = NumericSentinel()
+        self._warm(sent)
+        med = 1.0
+        suspect = sent.check_loss(100, med + 0.3)   # ~30x the rel floor
+        assert suspect.verdict == SUSPECT
+        assert suspect.reasons == ["loss_spike"] and suspect.zscore >= 8.0
+        corrupt = sent.check_loss(101, med + 1e6)
+        assert corrupt.verdict == CORRUPT and corrupt.corrupt
+
+    def test_non_finite_loss_is_corrupt(self):
+        sent = NumericSentinel()
+        for bad in (float("nan"), float("inf"), -float("inf")):
+            v = sent.check_loss(1, bad)
+            assert v.corrupt and v.reasons == ["non_finite_loss"]
+
+    def test_anomalies_never_update_baseline(self):
+        sent = NumericSentinel()
+        self._warm(sent)
+        before = list(sent._losses)
+        assert not sent.check_loss(50, 1e9).ok
+        assert sent._losses == before  # the spike did not poison the window
+
+    def test_downward_drift_never_flags(self):
+        # one-sided on purpose: convergence is a DOWNWARD move
+        sent = NumericSentinel()
+        for i in range(100):
+            assert sent.check_loss(i + 1, 10.0 / (i + 1)).ok
+
+    def test_window_trims(self):
+        sent = NumericSentinel(SentinelConfig(loss_window=8, min_history=4))
+        for i in range(50):
+            sent.check_loss(i + 1, 1.0)
+        assert len(sent._losses) == 8
+
+
+# ---------------------------------------------------------------------------
+# step detector
+# ---------------------------------------------------------------------------
+
+class TestCheckStep:
+    def _warm(self, sent, n=16, gn=1.0):
+        for i in range(n):
+            assert sent.check_step(i + 1, gn, False).ok
+
+    def test_explosion_bands(self):
+        sent = NumericSentinel()
+        self._warm(sent)
+        suspect = sent.check_step(100, 20.0, False)
+        assert suspect.verdict == SUSPECT
+        assert suspect.reasons == ["grad_norm_explosion"]
+        assert suspect.grad_ratio == pytest.approx(20.0, rel=1e-6)
+        corrupt = sent.check_step(101, 500.0, False)
+        assert corrupt.corrupt
+
+    def test_overflow_steps_are_ok_and_frozen(self):
+        sent = NumericSentinel()
+        self._warm(sent)
+        ewma = sent._grad_ewma
+        v = sent.check_step(100, float("inf"), True)  # scaler handled it
+        assert v.ok
+        assert sent._grad_ewma == ewma
+
+    def test_non_finite_grad_norm_without_overflow_is_corrupt(self):
+        sent = NumericSentinel()
+        v = sent.check_step(1, float("nan"), False)
+        assert v.corrupt and v.reasons == ["non_finite_grad_norm"]
+
+    def test_zero_grad_stall(self):
+        sent = NumericSentinel(SentinelConfig(zero_grad_patience=3))
+        self._warm(sent)
+        assert sent.check_step(100, 0.0, False).ok
+        assert sent.check_step(101, 0.0, False).ok
+        v = sent.check_step(102, 0.0, False)
+        assert v.verdict == SUSPECT and v.reasons == ["zero_grad_stall"]
+        # recovery resets the streak
+        sent.note_rewind()
+        assert sent.check_step(103, 0.0, False).ok
+
+    def test_anomaly_does_not_update_ewma(self):
+        sent = NumericSentinel()
+        self._warm(sent)
+        ewma = sent._grad_ewma
+        assert not sent.check_step(100, 1e6, False).ok
+        assert sent._grad_ewma == ewma
+
+    def test_sdc_mismatch_always_corrupt(self):
+        sent = NumericSentinel()
+        v = sent.flag_sdc_mismatch(7)
+        assert v.corrupt and v.reasons == ["sdc_mismatch"] and v.step == 7
+        assert sent.anomalies == {"sdc_mismatch": 1}
+
+
+# ---------------------------------------------------------------------------
+# the zero-false-positive gate (sentinel half)
+# ---------------------------------------------------------------------------
+
+def test_clean_300_step_stream_zero_false_positives():
+    """A realistic clean run: loss decays with multiplicative jitter,
+    grad norm decays with jitter, occasional fp16 overflow skips. 300
+    steps, default thresholds, not one anomaly."""
+    rng = np.random.RandomState(0)
+    sent = NumericSentinel()
+    for i in range(300):
+        loss = 2.0 * math.exp(-i / 120.0) + 0.3 + 0.05 * rng.randn()
+        gn = 1.5 * math.exp(-i / 200.0) * (1.0 + 0.2 * rng.randn())
+        overflow = i in (50, 180)  # the scaler's ordinary skips
+        assert sent.check_loss(i + 1, loss).ok, f"loss FP at step {i + 1}"
+        assert sent.check_step(i + 1, abs(gn), overflow).ok, \
+            f"grad FP at step {i + 1}"
+    assert sent.anomalies == {}
+    assert sent.stats()["observations"] == 300
+
+
+def test_detection_latency_within_window():
+    """A poisoned batch (1000x loss) flags on the very step it appears."""
+    sent = NumericSentinel()
+    for i in range(20):
+        assert sent.check_loss(i + 1, 1.0).ok
+    assert sent.check_loss(21, 1000.0).corrupt
+
+
+# ---------------------------------------------------------------------------
+# verdict / exception plumbing
+# ---------------------------------------------------------------------------
+
+def test_verdict_escalation_keeps_strongest():
+    sent = NumericSentinel(SentinelConfig(zero_grad_patience=1))
+    # non-finite (corrupt) beats the stall (suspect) fired the same step
+    v = Verdict()
+    sent._flag(v, SUSPECT, "zero_grad_stall")
+    sent._flag(v, CORRUPT, "non_finite_grad_norm")
+    assert v.verdict == CORRUPT
+    assert v.reasons == ["zero_grad_stall", "non_finite_grad_norm"]
+    sent._flag(v, SUSPECT, "loss_spike")
+    assert v.verdict == CORRUPT  # never de-escalates
+
+
+def test_numeric_corruption_carries_verdict():
+    v = Verdict(verdict=CORRUPT, reasons=["loss_spike"], step=9)
+    exc = NumericCorruption("budget exhausted", v)
+    assert isinstance(exc, RuntimeError) and exc.verdict is v
+    assert NumericCorruption("no verdict").verdict is None
+
+
+# ---------------------------------------------------------------------------
+# crc_digest (the SDC probe's fingerprint)
+# ---------------------------------------------------------------------------
+
+class TestCrcDigest:
+    def test_deterministic_and_order_sensitive(self):
+        a = np.arange(16, dtype=np.float32)
+        b = np.ones((4, 4), dtype=np.float32)
+        assert crc_digest([a, b]) == crc_digest([a.copy(), b.copy()])
+        assert crc_digest([a, b]) != crc_digest([b, a])
+
+    def test_single_bit_flip_changes_digest(self):
+        a = np.arange(64, dtype=np.float32)
+        flipped = a.copy()
+        flipped_view = flipped.view(np.uint32)
+        flipped_view[17] ^= np.uint32(1 << 23)
+        assert crc_digest([a]) != crc_digest([flipped])
+
+    def test_non_contiguous_input(self):
+        a = np.arange(64, dtype=np.float32).reshape(8, 8)
+        assert crc_digest([a[:, ::2]]) == crc_digest(
+            [np.ascontiguousarray(a[:, ::2])])
+
+    def test_empty_and_range(self):
+        assert crc_digest([]) == 0
+        d = crc_digest([np.zeros(3, dtype=np.float64)])
+        assert 0 <= d <= 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# replay watermark: rewind-and-replay re-executes already-vetted steps
+# ---------------------------------------------------------------------------
+
+class TestReplayWatermark:
+    """After a rewind (or a ladder rebuild) the supervisor re-runs steps
+    the sentinel already accepted; re-observing the identical loss would
+    double-count the sample and collapse the MAD to zero, manufacturing
+    false spikes on the very next fresh step."""
+
+    def test_replayed_loss_skips_baseline_and_banding(self):
+        s = NumericSentinel({"min_history": 2})
+        for i in range(1, 6):
+            assert s.check_loss(i, 1.0 + 0.01 * i).ok
+        n = len(s._losses)
+        # an absurd value at an already-seen step is not judged...
+        v = s.check_loss(3, 1e9)
+        assert v.ok and v.zscore == 0.0
+        assert len(s._losses) == n  # ...and never enters the window
+        # but the non-finite guard stays armed even on replays
+        assert s.check_loss(3, float("nan")).corrupt
+
+    def test_quarantine_retry_same_step_gets_full_check(self):
+        s = NumericSentinel({"min_history": 2})
+        for i in range(1, 4):
+            assert s.check_loss(i, 1.0).ok
+        # a flagged step never advances the watermark: the supervisor
+        # retries the SAME step number with the next batch
+        assert not s.check_loss(4, 1e6).ok
+        assert not s.check_loss(4, 1e6).ok
+        assert s.check_loss(4, 1.0).ok  # the clean retry is accepted
+
+    def test_replayed_grad_step_skips_banding(self):
+        s = NumericSentinel({"min_history": 2})
+        for i in range(1, 6):
+            assert s.check_step(i, 1.0, False).ok
+        v = s.check_step(3, 1e12, False)
+        assert v.ok and v.grad_ratio == 0.0
+        assert s.check_step(3, float("inf"), False).corrupt
+        assert not s.check_step(6, 1e12, False).ok  # fresh steps still judged

@@ -325,6 +325,185 @@ class TestCheckpointIntegritySeams:
         assert path is not None
 
 
+class TestNumericSentinel:
+    """PR-14 parity gate: silent numeric corruption — a poisoned batch,
+    a flipped grad bit — is detected by the sentinel and recovered
+    through the quarantine / rewind-and-replay rungs, with the recovered
+    per-step loss stream BITWISE equal to a clean run with the
+    quarantined batches excluded. And the other half: a clean run with
+    the sentinel armed flags nothing and perturbs nothing."""
+
+    # min_history=2 arms the detectors right after warm-up so short runs
+    # can exercise them; every other knob stays at its default
+    SENTINEL = {"min_history": 2}
+
+    def _ref_with_quarantined(self, num_steps, quarantined=()):
+        loader = _loader()
+        for epoch, batch in quarantined:
+            loader.quarantine(epoch, batch)
+        sup = TrainSupervisor(make_factory(_config()), loader)
+        return sup.run(num_steps)
+
+    def test_data_poison_quarantined_bitwise(self):
+        """data_poison at step 3 (epoch 0, batch 2): the pre-apply loss
+        spike quarantines the batch before its grads were applied; the
+        stream equals a clean run trained with that batch excluded."""
+        ref_losses = self._ref_with_quarantined(6, [(0, 2)])
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=3, kind="data_poison")]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"numeric_sentinel": self.SENTINEL, "backoff_s": 0.0,
+                      "snapshot_every_n_steps": 0})
+        losses = sup.run(6)
+        assert inj.pending() == 0
+        assert inj.fired[0]["kind"] == "data_poison"
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["quarantines"] == 1 and stats["rewinds"] == 0
+        assert stats["rebuilds"] == 0         # never escalated
+        assert stats["numeric_anomalies"].get("loss_spike", 0) >= 1
+        # the loader's skip-list carries the journal
+        assert (0, 2) in sup.loader._quarantined
+
+    def test_grad_bitflip_rewound_bitwise(self, tmp_path):
+        """grad_bitflip (exponent bit 30) at step 5: the corrupted apply
+        commits wrong params, the post-apply grad-norm verdict goes
+        corrupt, and rewind-and-replay from the step-4 snapshot restores
+        the bitwise stream — no engine rebuild."""
+        ref_losses, _ = _run_fault_free(7)
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=5, kind="grad_bitflip", bit=30)]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"numeric_sentinel": self.SENTINEL, "backoff_s": 0.0,
+                      "snapshot_every_n_steps": 2})
+        losses = sup.run(7)
+        assert inj.pending() == 0
+        fired = inj.fired[0]
+        # the fired record names the exact leaf/bit the flip landed on
+        assert fired["kind"] == "grad_bitflip" and fired["bit"] == 30
+        assert fired["leaf"]
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["rewinds"] == 1 and stats["quarantines"] == 0
+        assert stats["rebuilds"] == 0
+
+    def test_combined_chaos_plan_bitwise(self, tmp_path):
+        """The acceptance plan: a poisoned batch AND a flipped bit in
+        one seeded run — quarantine + rewind compose, stream bitwise
+        equal to the clean run with the poisoned batch excluded."""
+        ref_losses = self._ref_with_quarantined(8, [(0, 2)])
+        plan = TrainFaultPlan([
+            TrainFault(tick=3, kind="data_poison"),
+            TrainFault(tick=6, kind="grad_bitflip", bit=30)])
+        plan_path = str(tmp_path / "plan.jsonl")
+        plan.dump(plan_path)  # …and it replays from JSONL
+        inj = TrainFaultInjector(TrainFaultPlan.load(plan_path))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"numeric_sentinel": self.SENTINEL, "backoff_s": 0.0,
+                      "snapshot_every_n_steps": 2})
+        losses = sup.run(8)
+        assert inj.pending() == 0
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["quarantines"] == 1 and stats["rewinds"] == 1
+        assert stats["rebuilds"] == 0
+
+    def test_clean_run_zero_false_positives_and_unperturbed(self):
+        """The other half of the gate: armed sentinel, clean run — zero
+        anomalies, and the loss stream is bitwise the unarmed stream
+        (watching must cost nothing)."""
+        ref_losses, _ = _run_fault_free(10)
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(),
+            recovery={"numeric_sentinel": {}, "snapshot_every_n_steps": 4})
+        losses = sup.run(10)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["quarantines"] == 0 and stats["rewinds"] == 0
+        assert stats["numeric_anomalies"] == {}
+
+    @pytest.mark.slow
+    def test_clean_300_step_run_zero_false_positives(self):
+        """The acceptance gate's full-length run: 300 clean steps under
+        the default thresholds, not one false positive."""
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(),
+            recovery={"numeric_sentinel": {}, "snapshot_every_n_steps": 50})
+        losses = sup.run(300)
+        assert len(losses) == 300 and np.all(np.isfinite(losses))
+        stats = sup.recovery_stats()
+        assert stats["quarantines"] == 0 and stats["rewinds"] == 0
+        assert stats["rebuilds"] == 0
+        assert stats["numeric_anomalies"] == {}
+
+    def test_sdc_probe_deterministic_and_free(self):
+        """The SDC probe replays the pinned micro-step twice per cadence:
+        on the (deterministic) virtual mesh the digests always match, no
+        rewind fires, and the training stream is untouched — the probe
+        writes only throwaway accumulators."""
+        ref_losses, _ = _run_fault_free(6)
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(),
+            recovery={"numeric_sentinel": {"sdc_probe_every": 2},
+                      "snapshot_every_n_steps": 0})
+        losses = sup.run(6)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["sdc_probes"] == 3       # steps 2, 4, 6
+        assert stats["sdc_mismatches"] == 0 and stats["rewinds"] == 0
+
+    def test_quarantine_budget_exhaustion_escalates_to_rebuild(self, tmp_path):
+        """max_quarantines=0: the first poisoned batch raises
+        NumericCorruption into the ordinary ladder — the engine rebuilds
+        from the step-2 snapshot and replays (the one-shot fault is
+        spent, so the replayed batch is clean: stream equals the plain
+        clean run)."""
+        ref_losses, _ = _run_fault_free(5)
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=3, kind="data_poison")]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"numeric_sentinel": self.SENTINEL, "backoff_s": 0.0,
+                      "max_quarantines": 0, "snapshot_every_n_steps": 2})
+        losses = sup.run(5)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["quarantines"] == 0 and stats["rebuilds"] == 1
+
+    def test_corrupt_without_snapshot_escalates_to_cold_rebuild(self):
+        """A corrupt post-apply verdict with no snapshot to rewind to
+        raises NumericCorruption; the ladder cold-restarts from step 0
+        and the (spent) fault never refires — still bitwise."""
+        ref_losses, _ = _run_fault_free(4)
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=2, kind="grad_bitflip", bit=30)]))
+        sup = TrainSupervisor(
+            make_factory(_config()), _loader(), fault_hook=inj,
+            recovery={"numeric_sentinel": self.SENTINEL, "backoff_s": 0.0,
+                      "snapshot_every_n_steps": 0})
+        losses = sup.run(4)
+        np.testing.assert_array_equal(
+            np.asarray(losses, dtype=np.float32),
+            np.asarray(ref_losses, dtype=np.float32))
+        stats = sup.recovery_stats()
+        assert stats["rewinds"] == 0 and stats["rebuilds"] == 1
+
+
 class TestElasticDegradedRestart:
     def test_degrading_preemption_resumes_at_world_1(self, tmp_path):
         """Satellite: world 2 -> 1. A degrade=True preemption recomputes

@@ -45,6 +45,25 @@ class TestTrainRecoveryConfig:
         with pytest.raises(TypeError, match="TrainRecoveryConfig or dict"):
             TrainRecoveryConfig.parse("fast")
 
+    def test_numeric_knobs(self):
+        cfg = TrainRecoveryConfig()
+        assert cfg.numeric_sentinel is None  # disarmed by default
+        assert cfg.max_quarantines == 8 and cfg.max_rewinds == 4
+        with pytest.raises(ValueError, match="max_quarantines"):
+            TrainRecoveryConfig(max_quarantines=-1)
+        with pytest.raises(ValueError, match="max_rewinds"):
+            TrainRecoveryConfig(max_rewinds=-1)
+        armed = TrainRecoveryConfig.parse(
+            {"numeric_sentinel": {"loss_window": 16}, "max_rewinds": 2})
+        assert armed.numeric_sentinel == {"loss_window": 16}
+        assert armed.max_rewinds == 2
+
+    def test_sentinel_disarmed_without_config(self):
+        # a zero-budget config is still valid: the FIRST anomaly then
+        # escalates straight into the ordinary ladder
+        cfg = TrainRecoveryConfig(max_quarantines=0, max_rewinds=0)
+        assert cfg.max_quarantines == 0 and cfg.max_rewinds == 0
+
 
 class TestMicroSlicing:
     def test_dict_batch_slices_row_contiguously(self):

@@ -6,10 +6,13 @@ No jax, no engine — runs in tools/ci_jaxfree_tests.py."""
 
 import dataclasses
 
+import numpy as np
 import pytest
 
 from deepspeed_tpu.faults import (
+    DEFAULT_POISON_FACTOR,
     TRAIN_FAULT_KINDS,
+    TRAIN_NUMERIC_KINDS,
     MicroDispatchError,
     StepFetchHang,
     InjectedFault,
@@ -18,6 +21,10 @@ from deepspeed_tpu.faults import (
     TrainFaultInjector,
     TrainFaultPlan,
     TrainPreempted,
+    flip_float_bit,
+    nan_poison_array,
+    plan_bitflip,
+    poison_array,
 )
 
 
@@ -127,6 +134,109 @@ class TestTrainFaultInjector:
                 inj("micro_dispatch", {"step": 1, "micro": 0})
         inj("micro_dispatch", {"step": 1, "micro": 0})  # drained
         assert len(inj.fired) == 3
+
+
+class TestNumericFaultKinds:
+    def test_numeric_kinds_registered_at_micro_dispatch(self):
+        for kind in ("grad_bitflip", "nan_loss", "data_poison"):
+            assert TRAIN_FAULT_KINDS[kind] == "micro_dispatch"
+            assert kind in TRAIN_NUMERIC_KINDS
+            assert kind in TrainFaultInjector.MUTATION_KINDS
+
+    def test_bit_range_validated(self):
+        TrainFault(tick=1, kind="grad_bitflip", bit=-1)   # = auto
+        TrainFault(tick=1, kind="grad_bitflip", bit=31)
+        with pytest.raises(ValueError, match="bit"):
+            TrainFault(tick=1, kind="grad_bitflip", bit=32)
+        with pytest.raises(ValueError, match="bit"):
+            TrainFault(tick=1, kind="grad_bitflip", bit=-2)
+
+    def test_extra_fields_roundtrip(self, tmp_path):
+        plan = TrainFaultPlan([
+            TrainFault(tick=3, kind="grad_bitflip", leaf="block.w", bit=30),
+            TrainFault(tick=5, kind="data_poison", factor=250.0),
+            TrainFault(tick=7, kind="nan_loss"),
+            TrainFault(tick=9, kind="dispatch_error")])
+        # defaults stay off the wire (back-compat with pre-numeric plans)
+        recs = [f.to_dict() for f in plan]
+        assert recs[0]["leaf"] == "block.w" and recs[0]["bit"] == 30
+        assert recs[1]["factor"] == 250.0
+        assert "leaf" not in recs[2] and "factor" not in recs[3]
+        path = tmp_path / "plan.jsonl"
+        plan.dump(str(path))
+        loaded = TrainFaultPlan.load(str(path))
+        assert [dataclasses.asdict(f) for f in loaded] == \
+            [dataclasses.asdict(f) for f in plan]
+
+    def test_injector_returns_record_instead_of_raising(self):
+        inj = TrainFaultInjector(TrainFaultPlan([
+            TrainFault(tick=2, kind="data_poison", factor=99.0)]))
+        assert inj("micro_dispatch", {"step": 1, "micro": 0}) is None
+        rec = inj("micro_dispatch", {"step": 2, "micro": 0})
+        assert rec is not None and rec["kind"] == "data_poison"
+        assert rec["factor"] == 99.0 and rec["fired_tick"] == 2
+        # mutation directives are logged like exceptions are
+        assert inj.fired[-1] is rec
+        assert inj("micro_dispatch", {"step": 3, "micro": 0}) is None
+
+    def test_synth_default_excludes_numeric_kinds(self):
+        # legacy chaos plans must not silently grow mutations
+        plan = TrainFaultPlan.synth(seed=3, n_faults=40, tick_span=500)
+        assert all(f.kind not in TRAIN_NUMERIC_KINDS for f in plan)
+        numeric = TrainFaultPlan.synth(seed=3, n_faults=10, tick_span=100,
+                                       kinds=("grad_bitflip", "data_poison"))
+        assert all(f.kind in TRAIN_NUMERIC_KINDS for f in numeric)
+
+
+class TestNumericFaultHelpers:
+    def test_plan_bitflip_deterministic(self):
+        sizes = {"b": 64, "a": 16, "c": 4}
+        assert plan_bitflip(5, sizes) == plan_bitflip(5, sizes)
+        name, elem, bit = plan_bitflip(5, sizes)
+        assert name in sizes and 0 <= elem < sizes[name]
+        assert 23 <= bit <= 30  # auto targets exponent/high mantissa
+        # leaf round-robins over SORTED names, so dict order is irrelevant
+        assert plan_bitflip(5, sizes)[0] == \
+            plan_bitflip(5, dict(reversed(list(sizes.items()))))[0]
+        assert plan_bitflip(6, sizes)[0] != plan_bitflip(5, sizes)[0]
+        # explicit targeting wins
+        assert plan_bitflip(5, sizes, leaf="c", bit=3) == \
+            ("c", plan_bitflip(5, sizes, leaf="c")[1], 3)
+        with pytest.raises(KeyError):
+            plan_bitflip(5, sizes, leaf="missing")
+        with pytest.raises(ValueError):
+            plan_bitflip(5, {})
+
+    def test_flip_float_bit_flips_exactly_one_bit(self):
+        arr = np.linspace(-2.0, 2.0, 32, dtype=np.float32)
+        out = flip_float_bit(arr, elem=7, bit=23)
+        assert out is not arr  # copy, the input batch is never mutated
+        changed = np.nonzero(out != arr)[0]
+        assert list(changed) == [7]
+        xor = out.view(np.uint32) ^ arr.view(np.uint32)
+        assert xor[7] == np.uint32(1 << 23)
+        # flipping again restores the original bitwise
+        np.testing.assert_array_equal(flip_float_bit(out, 7, 23), arr)
+
+    def test_poison_array_float_and_int(self):
+        f = np.ones(4, dtype=np.float32)
+        np.testing.assert_array_equal(poison_array(f),
+                                      np.full(4, DEFAULT_POISON_FACTOR,
+                                              dtype=np.float32))
+        tok = np.arange(10, dtype=np.int32)
+        out = poison_array(tok)
+        assert out.dtype == tok.dtype
+        assert not np.array_equal(out, tok)       # garbage, but in-vocab
+        assert out.min() >= 0 and out.max() <= tok.max()
+        b = np.array([True, False])
+        assert poison_array(b) is b               # non-numeric passthrough
+
+    def test_nan_poison_array(self):
+        f = np.ones((2, 3), dtype=np.float32)
+        out = nan_poison_array(f)
+        assert out.dtype == f.dtype and np.all(np.isnan(out))
+        i = np.arange(3, dtype=np.int32)
+        assert nan_poison_array(i) is i           # ints cannot hold NaN
 
 
 class TestSharedModuleContract:

@@ -302,6 +302,32 @@ def test_train_table_empty_without_train_faults():
     assert ds_trace_report.format_train_table({}) == ""
 
 
+def test_train_table_numeric_health_section():
+    """The numeric-health sub-table aggregates the fixture's sentinel
+    journal (one quarantine, one anomaly + rewind, one clean SDC probe)
+    — and a numeric-only trace still produces a train table."""
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    table = ds_trace_report.train_table(events)
+    nh = table["numeric"]
+    assert nh["events"] == 4
+    assert nh["anomalies"] == {"loss_spike": 1, "grad_norm_explosion": 1}
+    assert nh["quarantines"] == 1
+    assert nh["rewinds"] == 1 and nh["rewind_replayed_steps"] == 2
+    assert nh["sdc_probes"] == 1 and nh["sdc_mismatches"] == 0
+    # the fixture carries no train_fault events: recovery counts are zero
+    assert table["faults"] == 0 and table["rebuilds"] == 0
+    text = ds_trace_report.format_train_table(table)
+    assert "numeric health" in text
+    assert "quarantines 1" in text and "rewinds 1" in text
+    assert "replayed 2 steps" in text
+    assert "sdc probes 1 (mismatches 0)" in text
+    assert "loss_spike=1" in text and "grad_norm_explosion=1" in text
+    # a train_fault-only trace has no numeric sub-table
+    plain = ds_trace_report.train_table(_train_fault_events())
+    assert "numeric" not in plain
+    assert "numeric health" not in ds_trace_report.format_train_table(plain)
+
+
 def test_cli_train_flag(tmp_path):
     trace = tmp_path / "train.jsonl"
     trace.write_text("\n".join(json.dumps(e)
@@ -313,13 +339,23 @@ def test_cli_train_flag(tmp_path):
     assert proc.returncode == 0, proc.stderr
     table = json.loads(proc.stdout)["train"]
     assert table["rebuilds"] == 2 and table["snapshots"] == 2
-    # a trace with no train_fault events exits 1 (same contract as --serve)
+    # numeric_health events alone sustain --train (the fixture holds no
+    # train_fault events)
     proc = subprocess.run(
-        [sys.executable, CLI, FIXTURE, "--train"],
+        [sys.executable, CLI, FIXTURE, "--train", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["train"]["numeric"]["quarantines"] == 1
+    # a trace with neither kind exits 1 (same contract as --serve)
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text('{"schema": 1, "kind": "train_step", "step_ms": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, CLI, str(bare), "--train"],
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 1
-    assert "no train_fault events" in proc.stderr
+    assert "no train_fault or numeric_health events" in proc.stderr
 
 
 def test_cli_json_mode():

@@ -43,6 +43,7 @@ JAXFREE_TESTS = [
     "tests/unit/serving/test_autoscaler.py",
     "tests/unit/runtime/test_train_faults.py",
     "tests/unit/runtime/test_resilience_policy.py",
+    "tests/unit/runtime/test_numerics.py",
     "tests/unit/checkpoint/test_checkpoint_integrity.py",
 ]
 

@@ -487,10 +487,13 @@ def train_table(events):
     steps and recovery_ms percentiles, snapshot cadence with
     checkpoint_ms percentiles, torn checkpoint writes and refused tags
     (the integrity walk's evidence), degraded restarts with the final
-    world size, and terminal failures. Empty dict when the trace holds
-    no training fault activity."""
+    world size, and terminal failures. ``numeric_health`` events add a
+    numerical-health sub-table (anomalies by kind, quarantined batches,
+    rewinds with replayed steps, SDC probe outcomes). Empty dict when
+    the trace holds no training fault or numeric-health activity."""
     faults = [e for e in events if e.get("kind") == "train_fault"]
-    if not faults:
+    nh = [e for e in events if e.get("kind") == "numeric_health"]
+    if not faults and not nh:
         return {}
     by_event = {}
     for e in faults:
@@ -547,6 +550,29 @@ def train_table(events):
                      and not isinstance(e.get("checkpoint_ms"), bool))
     if step_ms > 0 and ckpt_total > 0:
         out["snapshot_overhead_frac"] = round(ckpt_total / step_ms, 4)
+    if nh:
+        nh_by = {}
+        for e in nh:
+            nh_by.setdefault(e.get("event", "?"), []).append(e)
+        anomalies = {}
+        for e in nh_by.get("anomaly", []) + nh_by.get("quarantine", []):
+            for reason in (e.get("reasons") or []):
+                anomalies[str(reason)] = anomalies.get(str(reason), 0) + 1
+        rewinds = nh_by.get("rewind", [])
+        probes = nh_by.get("sdc_probe", [])
+        numeric = {
+            "events": len(nh),
+            "anomalies": anomalies,
+            "quarantines": len(nh_by.get("quarantine", [])),
+            "rewinds": len(rewinds),
+            "rewind_replayed_steps": sum(
+                int(e.get("replayed_steps", 0)) for e in rewinds
+                if not isinstance(e.get("replayed_steps"), bool)),
+            "sdc_probes": len(probes),
+            "sdc_mismatches": sum(1 for e in probes
+                                  if e.get("match") is False),
+        }
+        out["numeric"] = numeric
     return out
 
 
@@ -588,6 +614,20 @@ def format_train_table(table):
     if table.get("terminal_failures"):
         lines.append(f"                  TERMINAL failure(s): "
                      f"{table['terminal_failures']}")
+    nh = table.get("numeric")
+    if nh:
+        line = (f"numeric health    quarantines {nh['quarantines']}"
+                f"   rewinds {nh['rewinds']}")
+        if nh.get("rewind_replayed_steps"):
+            line += f" (replayed {nh['rewind_replayed_steps']} steps)"
+        if nh.get("sdc_probes"):
+            line += (f"   sdc probes {nh['sdc_probes']}"
+                     f" (mismatches {nh['sdc_mismatches']})")
+        lines.append(line)
+        if nh.get("anomalies"):
+            kinds = "   ".join(f"{k}={v}" for k, v in
+                               sorted(nh["anomalies"].items()))
+            lines.append(f"                  anomalies {kinds}")
     return "\n".join(lines) + "\n"
 
 
@@ -840,7 +880,9 @@ def main(argv=None):
                     help="only the training recovery summary (faults/"
                          "retries/rebuilds by source, snapshot cadence & "
                          "checkpoint_ms, torn/refused checkpoints over "
-                         "TrainSupervisor train_fault events)")
+                         "TrainSupervisor train_fault events, plus the "
+                         "numerical-health sub-table over numeric_health "
+                         "events)")
     ap.add_argument("--memory", action="store_true",
                     help="only the per-component HBM table (peak + latest "
                          "bytes per chip over memory_snapshot events)")
@@ -922,7 +964,8 @@ def main(argv=None):
     if args.train:
         table = train_table(events)
         if not table:
-            print("no train_fault events in the trace", file=sys.stderr)
+            print("no train_fault or numeric_health events in the trace",
+                  file=sys.stderr)
             return 1
         if args.as_json:
             print(json.dumps({"train": table}, indent=2, sort_keys=True))
