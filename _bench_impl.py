@@ -549,6 +549,50 @@ def bench_decode():
     except Exception as e:
         extra_int8 = {"int8_error": f"{type(e).__name__}: {e}"[:200]}
 
+    # speculative-generate self-tune: probe the draft length (gamma) for
+    # the lossless draft-model path on the winning cache config — a
+    # truncated-depth draft of the same preset proposes gamma tokens per
+    # round, the target verifies them in one forward. Winner persisted
+    # per device kind like the cache-geometry winner; a probe failure
+    # records its error and never fails the bench.
+    spec_probes, spec_winner = {}, None
+    try:
+        if _SMOKE:
+            draft_model = _smoke_model(64, num_layers=1)
+        else:
+            draft_model = TransformerModel.from_preset(
+                "gpt2-350m", dtype="bfloat16", max_seq_len=1024,
+                num_layers=4)
+        cached_spec = None if (_SMOKE or os.environ.get(
+            "DSTPU_BENCH_NOCACHE") == "1") else _cached_spec_decode(device_kind)
+        spec_gammas = ([2] if _SMOKE else [2, 4, 8]) \
+            if cached_spec is None else [cached_spec]
+        for g in spec_gammas:
+            try:
+                cfg_s = {"dtype": "bfloat16", "kv_cache_dtype": kv_dtype,
+                         "kv_tight_read": tight,
+                         "speculative": {"enabled": True, "mode": "draft",
+                                         "num_draft_tokens": g}}
+                if bounded:
+                    cfg_s["max_out_tokens"] = cache_len
+                eng_s = deepspeed_tpu.init_inference(
+                    model, config=cfg_s, draft_model=draft_model)
+                dt_s = _decode_window(eng_s, jnp.asarray(tokens_np),
+                                      new_tokens)
+                tok_s_g = B * decoded / dt_s
+                spec_probes[f"draft@g{g}"] = {
+                    "tokens_per_sec": round(tok_s_g, 1),
+                    "speedup_vs_plain": round(tok_s_g / tok_s, 3)}
+                if spec_winner is None or tok_s_g > spec_winner[1]:
+                    spec_winner = (g, tok_s_g)
+            except Exception as e:
+                spec_probes[f"draft@g{g}"] = f"{type(e).__name__}: {e}"[:200]
+            _release_device_memory()
+        if spec_winner is not None and len(spec_gammas) > 1 and not _SMOKE:
+            _save_spec_decode(device_kind, spec_winner[0])
+    except Exception as e:
+        spec_probes["error"] = f"{type(e).__name__}: {e}"[:200]
+
     return {
         "metric": "gpt2_350m_decode_tokens_per_sec",
         "value": round(tok_s, 1),
@@ -567,6 +611,12 @@ def bench_decode():
             "cache_len": cache_len if bounded else model.cfg.max_seq_len,
             "kv_bytes_per_token": round(kv_per_tok, 1),
             "probes": probes,
+            "speculative": {
+                "probes": spec_probes,
+                **({"gamma": spec_winner[0], "mode": "draft",
+                    "tokens_per_sec": round(spec_winner[1], 1)}
+                   if spec_winner else {}),
+            },
             **extra_int8,
         },
     }
@@ -601,7 +651,10 @@ def bench_serving():
     t_phase0 = time.time()
     budget_s = int(os.environ.get("DSTPU_BENCH_PHASE_BUDGET", "240"))
     rs = np.random.RandomState(0)
-    queue = [(t, jnp.asarray(rs.randint(0, model.cfg.vocab_size, (n,)), jnp.int32), new)
+    # host-side prompts: _release_device_memory between speculative probes
+    # deletes every live device array, so a device-resident queue would
+    # arrive dead at the second probe; submit() canonicalizes via np.asarray
+    queue = [(t, rs.randint(0, model.cfg.vocab_size, (n,)).astype(np.int32), new)
              for t, n, new in arrivals]
 
     from deepspeed_tpu.inference.continuous import _bucket
@@ -727,6 +780,96 @@ def bench_serving():
     best_tensor = int(best_key.split("x")[1])
     if not _SMOKE and swept_all and len(sweep) > 1:
         _save_serving_width(device_kind, best_tensor)
+
+    # speculative pooled-tick self-tune (docs/inference.md "Speculative
+    # decoding"): replay the same arrival schedule through a speculative
+    # pool — ngram self-drafting at gamma 2/4/8, then the draft-model
+    # mode at the best ngram gamma — and persist the winning (gamma,
+    # mode) per device kind. The probe list is bounded (<=4), budget-
+    # checked like the mesh sweep, and a probe failure records its error
+    # without failing the bench.
+    def run_spec(gamma, mode, draft_kw):
+        cfg = {"dtype": model.cfg.dtype,
+               "speculative": {"enabled": True, "pool": True, "mode": mode,
+                               "num_draft_tokens": gamma}}
+        eng = ContinuousBatchingEngine(
+            model, config=cfg, max_slots=slots, cache_len=cache_len,
+            tokens_per_tick=1, **draft_kw)
+        # warm like build_engine: the spec tick family per read bucket
+        # plus one driven request per prompt bucket, so the timed replay
+        # measures ticks, not compiles
+        eng.precompile_tick_programs()
+        for b in sorted({_bucket(int(p.size), cache_len) for _, p, _ in queue}):
+            eng.submit(jnp.zeros((b,), jnp.int32), max_new_tokens=4)
+        while eng.has_work():
+            eng.step()
+        eng.finished()
+        t0 = time.time()
+        tick, done_tokens = 0, 0
+        pending = list(queue)
+        while pending or eng.has_work():
+            for item in [it for it in pending if it[0] <= tick]:
+                eng.submit(item[1], max_new_tokens=item[2])
+            pending = [it for it in pending if it[0] > tick]
+            emitted = eng.step()
+            done_tokens += sum(len(v) for v in emitted.values())
+            eng.finished()
+            tick += 1
+        dt = max(time.time() - t0, 1e-9)
+        st = eng.tick_stats()
+        return {"tokens_per_sec": round(done_tokens / dt, 1),
+                "acceptance": st.get("spec_acceptance")}
+
+    spec_probes, spec_winner, spec_all = {}, None, True
+    try:
+        if _SMOKE:
+            draft_model = _smoke_model(64, num_layers=1)
+        else:
+            from deepspeed_tpu.models.transformer import TransformerModel
+            draft_model = TransformerModel.from_preset(
+                "gpt2-125m", dtype="bfloat16", max_seq_len=1024,
+                num_layers=3)
+        def draft_kw():
+            # fresh params per probe: _release_device_memory between
+            # probes deletes every live device array, a pre-built tree
+            # would arrive dead at the second build
+            return dict(draft_model=draft_model,
+                        draft_params=draft_model.init(jax.random.PRNGKey(1)))
+
+        cached_spec = None if nocache else _cached_spec_serving(device_kind)
+        if cached_spec is not None:
+            plan = [cached_spec]
+        else:
+            gammas = [2] if _SMOKE else [2, 4, 8]
+            plan = [(g, "ngram") for g in gammas]  # draft appended below
+        while plan:
+            gamma, mode = plan.pop(0)
+            if time.time() - t_phase0 > budget_s - 60:
+                spec_all = False
+                _progress(f"speculative probe stopped before "
+                          f"{mode}@g{gamma} (phase budget)")
+                break
+            try:
+                side = run_spec(gamma, mode,
+                                draft_kw() if mode == "draft" else {})
+                spec_probes[f"{mode}@g{gamma}"] = side
+                if spec_winner is None or \
+                        side["tokens_per_sec"] > spec_winner[2]["tokens_per_sec"]:
+                    spec_winner = (gamma, mode, side)
+            except Exception as e:
+                spec_probes[f"{mode}@g{gamma}"] = \
+                    f"{type(e).__name__}: {e}"[:200]
+            _release_device_memory()
+            if not plan and mode == "ngram" and spec_winner is not None \
+                    and cached_spec is None:
+                # mode axis: one draft-model probe at the best ngram gamma
+                plan.append((spec_winner[0], "draft"))
+        if spec_winner is not None and spec_all and cached_spec is None \
+                and not _SMOKE:
+            _save_spec_serving(device_kind, spec_winner[0], spec_winner[1])
+    except Exception as e:
+        spec_probes["error"] = f"{type(e).__name__}: {e}"[:200]
+
     extra = {
         "requests": len(arrivals),
         "slots": slots,
@@ -735,6 +878,17 @@ def bench_serving():
         "mesh": {"data": 1, "tensor": best_tensor},
         "mesh_sweep": sweep,
         "mesh_sweep_complete": swept_all,
+        "speculative": {
+            "probes": spec_probes,
+            "complete": spec_all,
+            **({"gamma": spec_winner[0], "mode": spec_winner[1],
+                "tokens_per_sec": spec_winner[2]["tokens_per_sec"],
+                "acceptance": spec_winner[2]["acceptance"],
+                "speedup_vs_plain": round(
+                    spec_winner[2]["tokens_per_sec"]
+                    / max(best["tokens_per_sec"], 1e-9), 3)}
+               if spec_winner else {}),
+        },
         **best,
     }
     return {
@@ -1010,6 +1164,35 @@ def _cached_serving_width(device_kind):
 def _save_serving_width(device_kind, tensor):
     _winner_cache_put(f"serving_mesh/{_winner_key(device_kind)}",
                       {"tensor": int(tensor)})
+
+
+def _cached_spec_serving(device_kind):
+    """(gamma, mode) winner of the bench_serving speculative probe —
+    draft length and ngram-vs-draft mode for pooled speculative ticks
+    (docs/inference.md "Speculative decoding"); digest-invalidated like
+    every other winner (decoding.py/continuous.py are in the digest)."""
+    entry = _winner_cache_get(f"spec/{_winner_key(device_kind)}")
+    if entry is not None:
+        return int(entry["gamma"]), str(entry["mode"])
+    return None
+
+
+def _save_spec_serving(device_kind, gamma, mode):
+    _winner_cache_put(f"spec/{_winner_key(device_kind)}",
+                      {"gamma": int(gamma), "mode": str(mode)})
+
+
+def _cached_spec_decode(device_kind):
+    """Gamma winner of the bench_decode speculative-generate probe (the
+    single-request draft-model path; ngram self-drafting has no
+    engine.generate path, so the mode axis lives in the serving probe)."""
+    entry = _winner_cache_get(f"spec_decode/{_winner_key(device_kind)}")
+    return int(entry["gamma"]) if entry is not None else None
+
+
+def _save_spec_decode(device_kind, gamma):
+    _winner_cache_put(f"spec_decode/{_winner_key(device_kind)}",
+                      {"gamma": int(gamma), "mode": "draft"})
 
 
 def bench_gpt2_train():

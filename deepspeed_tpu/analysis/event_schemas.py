@@ -85,6 +85,9 @@ EVENT_SCHEMAS = {
             "recoveries": "int",
             "recovered_finish": "bool",
             "replica": "str",
+            "spec_gamma": "int",
+            "spec_drafted": "int",
+            "spec_accepted": "int",
         },
     },
     "serving_event": {
@@ -170,7 +173,12 @@ EVENT_SCHEMAS = {
             "wasted": "int",
             "fused_prefill": "bool",
         },
-        "optional": {"replica": "str"},
+        "optional": {
+            "replica": "str",
+            "spec_gamma": "int",
+            "spec_drafted": "int",
+            "spec_accepted": "int",
+        },
     },
     "serving_fault": {
         # discriminated by "event": fault | retried | retry_failed |

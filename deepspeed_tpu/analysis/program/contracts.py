@@ -79,6 +79,30 @@ _PLAIN_FORWARD = {
 
 _LOCAL_ONLY = {1: {}, 2: {}, 4: {}, 8: {}}
 
+# Speculative pool ticks at tp=2 (decoding.compile_spec_pool_tick_fn).
+# Ngram greedy verifies the gamma+1 window in ONE forward with the same
+# inventory as the plain greedy tick (the accept scan is elementwise on
+# replicated rows — no extra traffic). Sampled acceptance draws per-draft
+# uniforms + a residual categorical, adding cross-shard reduces and the
+# two key-fold permutes per sampling site. The draft variant runs a
+# second (draft-model) forward scan: its layer collectives appear once
+# more (+3 all-reduce, +1 all-gather per sampler head) plus the draft's
+# own greedy/categorical head. Calibrated on the virtual mesh like the
+# other tables; depth-invariant (layer scans).
+_SPEC_TICK_NGRAM = {
+    1: {},
+    2: {"greedy": {"all-reduce": 3, "all-gather": 2},
+        "sampled": {"all-reduce": 8, "all-gather": 2,
+                    "collective-permute": 2}},
+}
+
+_SPEC_TICK_DRAFT = {
+    1: {},
+    2: {"greedy": {"all-reduce": 7, "all-gather": 4},
+        "sampled": {"all-reduce": 16, "all-gather": 4,
+                    "collective-permute": 4}},
+}
+
 # train tables are calibrated in tests/unit/analysis/test_program_gate.py
 # against the shipped tiny config; autodiff + optimizer sharding make
 # them richer than the forward-only tables (grad transposes re-gather,
@@ -102,6 +126,9 @@ COLLECTIVE_PROFILES = {
     # programs that must never communicate at any width (row updates,
     # cache splices, pure scatter/gather on replicated state)
     "local_only": _LOCAL_ONLY,
+    # speculative pool ticks (draft + verify + accept in one program)
+    "spec_tick_ngram": _SPEC_TICK_NGRAM,
+    "spec_tick_draft": _SPEC_TICK_DRAFT,
     "train_micro": _TRAIN_MICRO,
     "train_apply": _TRAIN_APPLY,
 }
@@ -147,6 +174,33 @@ PROGRAM_CONTRACTS = {
     "pool_row_update": {
         # compile_row_update_fn donate_argnums=(0, 1)
         "donated": ("last_tok", "done"),
+        "collectives": "local_only",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+    },
+    "pool_spec_tick_ngram": {
+        # compile_spec_pool_tick_fn (ngram) donate_argnums=(1, 2, 3, 4, 5)
+        "donated": ("cache", "last_tok", "done", "pos", "gen"),
+        "collectives": "spec_tick_ngram",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    "pool_spec_tick_draft": {
+        # compile_spec_pool_tick_fn (draft) donate_argnums=(2..7)
+        "donated": ("cache", "draft_cache", "last_tok", "done", "pos",
+                    "gen"),
+        "collectives": "spec_tick_draft",
+        "param_collectives": "forbid",
+        "host_transfers": "forbid",
+        "dtype": _DTYPE_DEFAULT,
+        "hbm": "telemetry_limit",
+    },
+    "pool_spec_row_update": {
+        # compile_spec_row_update_fn donate_argnums=(0, 1, 2, 3)
+        "donated": ("last_tok", "done", "pos", "gen"),
         "collectives": "local_only",
         "param_collectives": "forbid",
         "host_transfers": "forbid",

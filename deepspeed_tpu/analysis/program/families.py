@@ -16,7 +16,9 @@ must remain importable by the stdlib-only standalone loader.
 
 SERVING_FAMILIES = (
     "pool_tick[plain]", "pool_tick[burst]", "pool_tick[fused]",
-    "pool_segment", "pool_row_update", "decode_prefill", "decode_step",
+    "pool_segment", "pool_row_update", "pool_spec_tick_ngram",
+    "pool_spec_tick_draft", "pool_spec_row_update",
+    "decode_prefill", "decode_step",
 )
 TRAIN_FAMILIES = ("train_micro", "train_apply")
 ALL_FAMILIES = SERVING_FAMILIES + TRAIN_FAMILIES
@@ -73,6 +75,8 @@ def build_serving_artifacts(tp: int = 1, *, donate: bool = True,
         compile_pool_tick_fn,
         compile_row_update_fn,
         compile_segment_fn,
+        compile_spec_pool_tick_fn,
+        compile_spec_row_update_fn,
     )
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.models import transformer as tf
@@ -140,6 +144,51 @@ def build_serving_artifacts(tp: int = 1, *, donate: bool = True,
         fn = compile_row_update_fn(mesh, cfg, slots, donate=donate)
         out.append(extract_artifact(
             "pool_row_update", "", fn, (row, row, scalar, scalar, scalar),
+            meta=meta))
+    gamma = 3  # any gamma > 1: the accept scan's collectives are width-free
+    spec_rows = (row,) * 7  # last_tok, done, pos, gen, quota, rids, run_mask
+    if "pool_spec_tick_ngram" in wanted:
+        drafts_s = jax.ShapeDtypeStruct((slots, gamma), jnp.int32)
+        for temp in (0.0, 0.7):  # both compiled accept heads (see pool_tick)
+            fn = compile_spec_pool_tick_fn(
+                mesh, cfg, shardings, slots, cache_len, gamma, temp,
+                0, 1.0, eos_token_id=1, read_len=None, donate=donate)[0]
+            out.append(extract_artifact(
+                "pool_spec_tick_ngram", "", fn,
+                (params_s,) + (cache_s,) + spec_rows + (drafts_s, key_s),
+                meta=dict(meta, sampled=temp > 0.0)))
+    if "pool_spec_tick_draft" in wanted:
+        # the draft rides the SAME mesh with its own (smaller) param tree
+        # and pool-geometry cache; meta param_shapes is the UNION so the
+        # param-collective rule recognizes draft-shaped operands too
+        from .capture import param_leaf_shapes
+
+        dcfg_t = tiny_config(layers=layers, hidden=16, heads=2,
+                             dtype=model_dtype)
+        dmodel = tf.TransformerModel(dcfg_t)
+        deng = InferenceEngine(dmodel, config=config, mesh=mesh)
+        dcfg = deng._ring_off_cfg
+        dcache_s = jax.eval_shape(lambda: tf.init_cache(dcfg, slots,
+                                                        cache_len))
+        dparams_s = jax.tree.map(sds, deng.params)
+        dmeta = dict(meta, param_shapes=(meta["param_shapes"]
+                                         + param_leaf_shapes(deng.params)))
+        for temp in (0.0, 0.7):
+            fn = compile_spec_pool_tick_fn(
+                mesh, cfg, shardings, slots, cache_len, gamma, temp,
+                0, 1.0, eos_token_id=1, read_len=None, donate=donate,
+                draft_cfg=dcfg,
+                draft_param_shardings=deng.param_shardings)[0]
+            out.append(extract_artifact(
+                "pool_spec_tick_draft", "", fn,
+                (params_s, dparams_s, cache_s, dcache_s) + spec_rows
+                + (key_s,),
+                meta=dict(dmeta, sampled=temp > 0.0)))
+    if "pool_spec_row_update" in wanted:
+        fn = compile_spec_row_update_fn(mesh, cfg, slots, donate=donate)
+        out.append(extract_artifact(
+            "pool_spec_row_update", "", fn,
+            (row, row, row, row, scalar, scalar, scalar, scalar, scalar),
             meta=meta))
     if "decode_prefill" in wanted or "decode_step" in wanted:
         batch = 2

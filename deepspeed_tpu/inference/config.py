@@ -54,12 +54,22 @@ class MoEInferenceConfig:
 
 @dataclass
 class SpeculativeConfig:
-    """Draft-model speculative decoding (lossless: emitted tokens follow the
-    target model's sampling distribution; greedy mode matches plain greedy
-    decode token-for-token)."""
+    """Speculative decoding (lossless: emitted tokens follow the target
+    model's sampling distribution; greedy mode matches plain greedy decode
+    token-for-token). ``mode`` picks the proposal source: ``"draft"`` — a
+    second (smaller) model resident on the same mesh; ``"ngram"`` —
+    jax-free self-drafting from the request's own token history
+    (inference/ngram.py), no second model needed. ``pool`` additionally
+    enables the speculative CONTINUOUS-BATCHING tick (docs/inference.md
+    "Speculative decoding"): every pooled serving tick proposes
+    ``num_draft_tokens`` per active row and verifies them in one target
+    forward; requires single-token ticks."""
 
     enabled: bool = False
     num_draft_tokens: int = 4  # gamma: draft proposals verified per round
+    mode: str = "draft"        # "draft" | "ngram"
+    pool: bool = False         # speculate inside the pooled serving tick
+    ngram_max_order: int = 3   # longest context suffix the ngram matcher tries
 
 
 @dataclass

@@ -83,12 +83,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference import ngram
 from deepspeed_tpu.inference.decoding import (
     cached_fn,
     compile_pool_tick_fn,
     compile_ragged_prefill_fn,
     compile_row_update_fn,
     compile_segment_fn,
+    compile_spec_pool_tick_fn,
+    compile_spec_row_update_fn,
     read_bucket,
 )
 
@@ -128,6 +131,10 @@ class _Request:
     # KV-cache bytes this request's row streamed across its decode ticks
     # (host accounting at the read length each retired tick dispatched)
     kv_bytes_read: int = 0
+    # speculative accounting (spec ticks only): drafts proposed for this
+    # request vs drafts its verify rounds accepted
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class _TickRecord:
@@ -135,14 +142,16 @@ class _TickRecord:
     the packed result future plus everything needed to attribute it when
     the tick is retired."""
 
-    __slots__ = ("packed", "live", "k", "row_bytes", "fused")
+    __slots__ = ("packed", "live", "k", "row_bytes", "fused", "spec")
 
-    def __init__(self, packed, live, k, row_bytes, fused):
+    def __init__(self, packed, live, k, row_bytes, fused, spec=0):
         self.packed = packed          # device future: (B, k+2) int32
         self.live = live              # slot -> _Request live at dispatch
         self.k = k                    # burst length (1 for plain/fused)
         self.row_bytes = row_bytes    # KV bytes one row streams per step
         self.fused = fused            # carried a prefill chunk
+        self.spec = spec              # speculative round: gamma (0 = plain;
+        # packed is (B, gamma+4) and row_bytes is the WHOLE round's bytes)
 
 
 class _Pool:
@@ -187,6 +196,34 @@ class _Pool:
                                                 donate=engine.donate_cache)
         self.set_row_fn = wrap_deferred(get_tele, self.set_row_fn,
                                         "row_update", (n_slots,))
+        # speculative tick state (engine.spec_gamma > 0): pos/gen join the
+        # device-THREADED arrays — a spec row advances by its own accepted
+        # count, which only the device knows at dispatch time — and
+        # draft-model mode keeps a second KV cache with the SAME bucket
+        # geometry plus its own segment program for draft prefill
+        self.draft_cache = None
+        if engine.spec_gamma:
+            self.pos_dev = jax.device_put(
+                jnp.full(n_slots, length, jnp.int32), row_sh)
+            self.gen_dev = jax.device_put(jnp.zeros(n_slots, jnp.int32),
+                                          row_sh)
+            self.spec_set_row_fn = compile_spec_row_update_fn(
+                engine.mesh, engine.cfg, n_slots,
+                donate=engine.donate_cache)
+            self.spec_set_row_fn = wrap_deferred(
+                get_tele, self.spec_set_row_fn, "spec_row_update",
+                (n_slots,))
+            if engine.spec_mode == "draft":
+                deng = engine._draft_eng
+                self.draft_segment_fn, self.draft_cache_sh, _ = \
+                    compile_segment_fn(engine.mesh, engine.draft_cfg,
+                                       deng.param_shardings, n_slots, length)
+                self.draft_segment_fn = wrap_deferred(
+                    get_tele, self.draft_segment_fn, "pool_segment",
+                    (n_slots, length, "draft"))
+                self.draft_cache = jax.device_put(
+                    tf.init_cache(engine.draft_cfg, n_slots, length),
+                    self.draft_cache_sh)
         # ds-audit capture of the pool's companion programs (the tick
         # variants notify from _tick_fn as they are built)
         from deepspeed_tpu.analysis.program import capture
@@ -209,6 +246,27 @@ class _Pool:
                                    seg_args, meta=engine._audit_meta)
             capture.notify_program("pool_row_update", "", self.set_row_fn,
                                    row_args, meta=engine._audit_meta)
+            if engine.spec_gamma:
+                def spec_row_args(n=n_slots):
+                    row = jax.ShapeDtypeStruct((n,), jnp.int32)
+                    return (row, row, row, row, 0, 0, 0, 0, 0)
+
+                capture.notify_program("pool_spec_row_update", "",
+                                       self.spec_set_row_fn, spec_row_args,
+                                       meta=engine._audit_meta)
+                if engine.spec_mode == "draft":
+                    def dseg_args(n=n_slots, pool=self, eng=engine):
+                        def sds(a):
+                            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+                        return (jax.tree.map(sds, eng._draft_eng.params),
+                                jax.ShapeDtypeStruct((n, 8), jnp.int32),
+                                jax.tree.map(sds, pool.draft_cache),
+                                jax.ShapeDtypeStruct((n,), jnp.int32))
+
+                    capture.notify_program("pool_segment", "draft",
+                                           self.draft_segment_fn, dseg_args,
+                                           meta=engine._draft_audit_meta)
         # host DISPATCH mirrors: the position/emission count each row will
         # have reached once every dispatched tick retires. Exact for live
         # rows (a live row advances by exactly k per burst until done);
@@ -245,7 +303,8 @@ class ContinuousBatchingEngine:
                  fused_prefill: bool = True,
                  prefill_chunk: Optional[int] = None,
                  donate_cache: bool = True,
-                 fetch_timeout_s: Optional[float] = None):
+                 fetch_timeout_s: Optional[float] = None,
+                 draft_model=None, draft_params=None):
         from deepspeed_tpu.inference.engine import InferenceEngine
 
         self._eng = InferenceEngine(model, config=config, params=params,
@@ -282,6 +341,59 @@ class ContinuousBatchingEngine:
         # are identical across pipeline depths / fusion / slot placement
         self._base_key = jax.random.PRNGKey(seed)
 
+        # speculative pooled ticks (config speculative.enabled + .pool):
+        # every tick proposes spec_gamma tokens per active row and ONE
+        # target forward verifies them (decoding.compile_spec_pool_tick_fn)
+        spec = self._eng.config.speculative
+        self.spec_gamma = 0
+        self.spec_mode = None
+        self._draft_eng = None
+        self.draft_cfg = None
+        if spec.enabled and spec.pool:
+            if spec.mode not in ("draft", "ngram"):
+                raise ValueError(
+                    f"speculative.mode must be 'draft' or 'ngram', "
+                    f"got {spec.mode!r}")
+            if tokens_per_tick != 1:
+                raise ValueError(
+                    "speculative pool ticks require tokens_per_tick=1 "
+                    "(the gamma-wide verify round IS the burst)")
+            if spec.num_draft_tokens < 1:
+                raise ValueError(
+                    f"speculative.num_draft_tokens must be >= 1, "
+                    f"got {spec.num_draft_tokens}")
+            if spec.mode == "draft":
+                if draft_model is None:
+                    raise ValueError(
+                        "speculative.mode='draft' needs draft_model= (a "
+                        "smaller same-vocabulary model), or set "
+                        "speculative.mode='ngram' for draft-free "
+                        "self-drafting")
+                # the draft shares the cache format (int8 KV must cover
+                # both trees) and the mesh — its params are partitioned by
+                # the same regex rules / annotations as the target's
+                self._draft_eng = InferenceEngine(
+                    draft_model,
+                    config={"dtype": self._eng.config.dtype,
+                            "kv_cache_dtype": self._eng.config.kv_cache_dtype,
+                            "kv_tight_read": self._eng.config.kv_tight_read,
+                            "kv_read_floor": self._eng.config.kv_read_floor,
+                            "mesh": self._eng.config.mesh},
+                    params=draft_params, mesh=self.mesh, seed=seed)
+                self.draft_cfg = self._draft_eng._ring_off_cfg
+                if self.draft_cfg.vocab_size != self.cfg.vocab_size:
+                    raise ValueError(
+                        f"draft must share the vocabulary: draft vocab "
+                        f"{self.draft_cfg.vocab_size} != target vocab "
+                        f"{self.cfg.vocab_size}")
+            self.spec_gamma = spec.num_draft_tokens
+            self.spec_mode = spec.mode
+        elif draft_model is not None:
+            raise ValueError(
+                "draft_model= given but speculative pool ticks are off: "
+                "set speculative={'enabled': True, 'pool': True} "
+                "(mode='draft')")
+
         if cache_buckets is None:
             cache_len = min(cache_len or self.cfg.max_seq_len, self.cfg.max_seq_len)
             cache_buckets = [(max_slots if max_slots is not None else 4, cache_len)]
@@ -313,7 +425,8 @@ class ContinuousBatchingEngine:
         self._tick_stats = {"ticks": 0, "steps": 0, "dispatch_ms": 0.0,
                             "block_ms": 0.0, "tokens": 0, "wasted_tokens": 0,
                             "capacity_tokens": 0, "fused_prefill_ticks": 0,
-                            "max_inflight": 0}
+                            "max_inflight": 0, "spec_drafted": 0,
+                            "spec_accepted": 0}
         # cancelled rids, remembered so status()/result() answer precisely
         # instead of "unknown" — BOUNDED (oldest evicted past 4096): a
         # long-running server cancels routinely and must not leak an int
@@ -398,8 +511,19 @@ class ContinuousBatchingEngine:
                   for pre in self._prefixes.values())
         tick = sum(hbm.tree_device_bytes((p.last_tok_dev, p.done_dev))
                    for p in self._pools)
-        return {"params": hbm.tree_device_bytes(self._eng.params),
-                "kv_cache": kv, "tick_state": tick}
+        out = {"params": hbm.tree_device_bytes(self._eng.params),
+               "kv_cache": kv, "tick_state": tick}
+        if self.spec_gamma:
+            out["tick_state"] += sum(
+                hbm.tree_device_bytes((p.pos_dev, p.gen_dev))
+                for p in self._pools)
+            if self._draft_eng is not None:
+                out["draft_params"] = hbm.tree_device_bytes(
+                    self._draft_eng.params)
+                out["kv_cache"] += sum(
+                    hbm.tree_device_bytes(p.draft_cache)
+                    for p in self._pools)
+        return out
 
     def memory_snapshot(self, reason: str):
         """Export the current HBM attribution (``hbm_bytes{component}``
@@ -428,6 +552,24 @@ class ContinuousBatchingEngine:
             args += [cvec, cvec, 0, row, row]
         return tuple(args)
 
+    def _spec_tick_arg_structs(self, pool: "_Pool"):
+        """:meth:`_tick_arg_structs` for the speculative tick variants."""
+        def sds(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        params_s = jax.tree.map(sds, self._eng.params)
+        cache_s = jax.tree.map(sds, pool.cache)
+        row = jax.ShapeDtypeStruct((pool.n_slots,), jnp.int32)
+        key_s = sds(self._base_key)
+        if self.spec_mode == "draft":
+            return (params_s, jax.tree.map(sds, self._draft_eng.params),
+                    cache_s, jax.tree.map(sds, pool.draft_cache),
+                    row, row, row, row, row, row, row, key_s)
+        drafts = jax.ShapeDtypeStruct((pool.n_slots, self.spec_gamma),
+                                      jnp.int32)
+        return (params_s, cache_s, row, row, row, row, row, row, row,
+                drafts, key_s)
+
     def _audit_meta(self) -> dict:
         """ProgramArtifact meta for ds-audit captures from this engine
         (analysis/program/capture.py) — the inner engine's meta with the
@@ -436,6 +578,26 @@ class ContinuousBatchingEngine:
         (the tick collective profile splits greedy vs sampled)."""
         return dict(self._eng._audit_meta(), donate=self.donate_cache,
                     sampled=self.temperature > 0.0)
+
+    def _draft_audit_meta(self) -> dict:
+        """Audit meta for programs over the DRAFT param tree (the draft
+        segment prefill): the param-collective match set must be the
+        draft's leaf shapes, not the target's."""
+        from deepspeed_tpu.analysis.program.capture import param_leaf_shapes
+
+        return dict(self._audit_meta(),
+                    param_shapes=param_leaf_shapes(self._draft_eng.params))
+
+    def _spec_audit_meta(self) -> dict:
+        """Audit meta for the speculative tick: draft mode carries BOTH
+        param trees, so the param-collective match set is their union."""
+        meta = self._audit_meta()
+        if self._draft_eng is not None:
+            from deepspeed_tpu.analysis.program.capture import param_leaf_shapes
+
+            meta["param_shapes"] = tuple(meta["param_shapes"]) + \
+                param_leaf_shapes(self._draft_eng.params)
+        return meta
 
     def analyze_program_memory(self) -> Dict[str, dict]:
         """Per-tick-program-family ``compiled.memory_analysis()`` view
@@ -449,7 +611,9 @@ class ContinuousBatchingEngine:
         out: Dict[str, dict] = {}
         for pi, pool in enumerate(self._pools):
             for (chunk, read_len), fn in pool.tick_fns.items():
-                args = self._tick_arg_structs(pool, chunk)
+                args = (self._spec_tick_arg_structs(pool)
+                        if chunk == "spec"
+                        else self._tick_arg_structs(pool, chunk))
                 try:
                     mem = hbm.program_memory(fn.lower(*args).compile())
                 except Exception:  # noqa: BLE001 — strictly best-effort AOT
@@ -685,6 +849,10 @@ class ContinuousBatchingEngine:
         host = s["dispatch_ms"] + s["block_ms"]
         s["overlap_frac"] = (round(1.0 - s["block_ms"] / host, 4)
                              if host > 0 else None)
+        s["spec_gamma"] = self.spec_gamma
+        s["spec_mode"] = self.spec_mode
+        s["spec_acceptance"] = (round(s["spec_accepted"] / s["spec_drafted"], 4)
+                                if s["spec_drafted"] else None)
         return s
 
     def _place(self, req: _Request) -> Optional[tuple]:
@@ -745,7 +913,8 @@ class ContinuousBatchingEngine:
 
         recs: Dict[int, _TickRecord] = {}
         for pi, pool in enumerate(self._pools):
-            rec = self._dispatch_tick(pool)
+            rec = (self._dispatch_spec_tick(pool) if self.spec_gamma
+                   else self._dispatch_tick(pool))
             if rec is not None:
                 recs[pi] = rec
         # the dispatch span is INTENTIONALLY unsynced: it measures host
@@ -770,6 +939,7 @@ class ContinuousBatchingEngine:
         # dispatched, the remaining in-flight ticks are the drain tail
         block_ms = 0.0
         tokens0, wasted0 = stats["tokens"], stats["wasted_tokens"]
+        drafted0, accepted0 = stats["spec_drafted"], stats["spec_accepted"]
         while self._inflight and (len(self._inflight) > self.pipeline_depth
                                   or not recs):
             block_ms += self._retire(self._inflight.popleft(), emitted)
@@ -793,14 +963,19 @@ class ContinuousBatchingEngine:
                 reg.histogram("tick_block_ms").observe(block_ms)
                 if n_wasted:
                     reg.counter("burst_wasted_tokens").inc(n_wasted)
-                tele.emit("serving_tick", {
+                event = {
                     "dispatch_ms": round(dispatch_ms, 4),
                     "block_ms": round(block_ms, 4),
                     "inflight": len(self._inflight),
                     "emitted": n_tokens,
                     "wasted": n_wasted,
                     "fused_prefill": any(r.fused for r in recs.values()),
-                })
+                }
+                if self.spec_gamma:
+                    event["spec_gamma"] = self.spec_gamma
+                    event["spec_drafted"] = stats["spec_drafted"] - drafted0
+                    event["spec_accepted"] = stats["spec_accepted"] - accepted0
+                tele.emit("serving_tick", event)
         return emitted
 
     def cache_utilization(self) -> float:
@@ -958,6 +1133,135 @@ class ContinuousBatchingEngine:
             pool.disp_gen[slot] += adv
         return rec
 
+    def _spec_round_bytes(self, pool: _Pool, read_len: Optional[int]) -> int:
+        """KV bytes ONE row streams per speculative round: the target
+        verify reads its window once (the (gamma+1)-wide queries share a
+        single cache read), plus gamma+1 draft steps each streaming the
+        draft-cache window (0 extra for ngram — drafting is host-side)."""
+        total = self._row_read_bytes(pool, read_len)
+        if self.spec_mode == "draft":
+            from deepspeed_tpu.models.transformer import kv_read_bytes_per_row
+            from deepspeed_tpu.parallel.partition import kv_shard_width
+
+            total += (self.spec_gamma + 1) * kv_read_bytes_per_row(
+                self.draft_cfg,
+                read_len if read_len is not None else pool.length,
+                tp=kv_shard_width(self.mesh, self.draft_cfg))
+        return total
+
+    def _spec_tick_fn(self, pool: _Pool, read_len: Optional[int]):
+        """The pool's compiled SPECULATIVE tick at tight-read length
+        ``read_len`` — keyed ``("spec", read_len)`` in the same
+        pool-resident table as the plain variants (same no-eviction
+        rationale)."""
+        key = ("spec", read_len)
+        if key not in pool.tick_fns:
+            kw = {}
+            if self.spec_mode == "draft":
+                kw = dict(
+                    draft_cfg=self.draft_cfg,
+                    draft_param_shardings=self._draft_eng.param_shardings)
+            fn = compile_spec_pool_tick_fn(
+                self.mesh, self.cfg, self._eng.param_shardings, pool.n_slots,
+                pool.length, self.spec_gamma, self.temperature, self.top_k,
+                self.top_p, eos_token_id=self.eos_token_id,
+                read_len=read_len, donate=self.donate_cache, **kw)[0]
+            tele = self._eng.telemetry
+            if tele.enabled:
+                fn = tele.compile_recorder().wrap(
+                    fn, "pool_spec_tick",
+                    (pool.length, pool.n_slots, self.spec_gamma,
+                     self.spec_mode, read_len))
+            pool.tick_fns[key] = fn
+            from deepspeed_tpu.analysis.program import capture
+
+            if capture.active():
+                capture.notify_program(
+                    f"pool_spec_tick_{self.spec_mode}", "", fn,
+                    lambda: self._spec_tick_arg_structs(pool),
+                    meta=self._spec_audit_meta)
+        return pool.tick_fns[key]
+
+    def _dispatch_spec_tick(self, pool: _Pool) -> Optional[_TickRecord]:
+        """Speculative counterpart of :meth:`_dispatch_tick`: one
+        gamma-verify round per pool per step, enqueue-only like the plain
+        path. Fused admission rides a SEPARATE segment dispatch on the
+        same step (prompt chunks never enter the spec tick program; the
+        admitting row joins the decode round the step its last chunk
+        dispatches), so decode rows keep speculating through a long
+        prompt's prefill."""
+        g, n = self.spec_gamma, pool.n_slots
+        fused = False
+        if self.fused_prefill and pool.prefill_q:
+            admit = pool.prefill_q[0]
+            ctoks, cpos0, nreal, _ = admit.chunks.pop(0)
+            W = _bucket(nreal, pool.chunk_cap, _CHUNK_FLOOR)
+            seg_toks = np.zeros((n, W), np.int32)
+            seg_toks[admit.slot, :nreal] = ctoks
+            seg_pos = np.full(n, pool.length, np.int32)
+            seg_pos[admit.slot] = cpos0
+            _, pool.cache = pool.segment_fn(
+                self._eng.params, jnp.asarray(seg_toks), pool.cache,
+                jnp.asarray(seg_pos))
+            fused = True
+            if not admit.chunks:
+                pool.prefill_q.popleft()
+                admit.chunks = None  # joins the decode round below
+        run_mask = np.zeros(n, np.int32)
+        quota = np.zeros(n, np.int32)
+        rids = np.zeros(n, np.int32)
+        live: Dict[int, _Request] = {}
+        extent = 0
+        for slot, req in pool.active.items():
+            if req.chunks:
+                continue  # mid-prefill: device run_mask parks the row
+            if pool.disp_gen[slot] >= req.quota:
+                continue  # quota covered by in-flight rounds (lower bound
+                # — the device's threaded done flag is authoritative)
+            live[slot] = req
+            run_mask[slot] = 1
+            quota[slot] = req.quota
+            rids[slot] = req.rid
+            extent = max(extent, int(pool.disp_pos[slot]) + g + 1)
+        if not live:
+            return None
+        read_len = self._read_len(pool, min(extent, pool.length))
+        fn = self._spec_tick_fn(pool, read_len)
+        if self.spec_mode == "draft":
+            (packed, pool.cache, pool.draft_cache, pool.last_tok_dev,
+             pool.done_dev, pool.pos_dev, pool.gen_dev) = fn(
+                self._eng.params, self._draft_eng.params, pool.cache,
+                pool.draft_cache, pool.last_tok_dev, pool.done_dev,
+                pool.pos_dev, pool.gen_dev, jnp.asarray(quota),
+                jnp.asarray(rids), jnp.asarray(run_mask), self._base_key)
+        else:
+            drafts = np.zeros((n, g), np.int32)
+            order = self._eng.config.speculative.ngram_max_order
+            for slot, req in live.items():
+                # under dispatch-ahead the host context LAGS the device by
+                # up to pipeline_depth rounds — that only lowers the
+                # acceptance rate, never correctness (point-mass q)
+                ctx = (np.concatenate([req.prompt,
+                                       np.asarray(req.generated, np.int32)])
+                       if req.generated else req.prompt)
+                drafts[slot] = ngram.propose(ctx, g, order)
+            (packed, pool.cache, pool.last_tok_dev, pool.done_dev,
+             pool.pos_dev, pool.gen_dev) = fn(
+                self._eng.params, pool.cache, pool.last_tok_dev,
+                pool.done_dev, pool.pos_dev, pool.gen_dev,
+                jnp.asarray(quota), jnp.asarray(rids),
+                jnp.asarray(run_mask), jnp.asarray(drafts), self._base_key)
+        # dispatch mirrors: pos becomes an UPPER bound (the device advances
+        # by accepted+1 <= gamma+1, used only for read-geometry selection)
+        # and gen a LOWER bound (every active round emits >= 1); _retire
+        # reconciles both from the packed counts
+        for slot in live:
+            pool.disp_pos[slot] += g + 1
+            pool.disp_gen[slot] += 1
+        return _TickRecord(packed, live, g + 1,
+                           self._spec_round_bytes(pool, read_len), fused,
+                           spec=g)
+
     def _retire(self, recs: Dict[int, _TickRecord],
                 emitted: Dict[int, List[int]]) -> float:
         """Retire one in-flight tick: ONE coalesced packed-buffer fetch per
@@ -985,6 +1289,7 @@ class ContinuousBatchingEngine:
                     f"unhealthy, tick pipeline abandoned")
             block_ms += dt * 1000.0
             k = rec.k
+            g = rec.spec
             for slot, req in rec.live.items():
                 if pool.active.get(slot) is not req:
                     # cancelled / already finished while this tick was in
@@ -995,10 +1300,26 @@ class ContinuousBatchingEngine:
                 n = int(arr[slot, k])
                 stats["tokens"] += n
                 stats["wasted_tokens"] += k - n
-                # the row STREAMED k read windows whether or not it accepted
-                # all k tokens (burst tails past done are wasted work, not
-                # free work) — kv_bytes_read reports physical HBM traffic
-                req.kv_bytes_read += k * rec.row_bytes
+                if g:
+                    accepted = int(arr[slot, g + 3])
+                    stats["spec_drafted"] += g
+                    stats["spec_accepted"] += accepted
+                    req.spec_drafted += g
+                    req.spec_accepted += accepted
+                    # reconcile the dispatch mirrors: the round really
+                    # advanced pos by accepted+1 (the mirror assumed
+                    # gamma+1) and emitted n (the mirror assumed 1)
+                    pool.disp_pos[slot] -= g - accepted
+                    pool.disp_gen[slot] += n - 1
+                    # rec.row_bytes is the WHOLE round's streamed bytes
+                    # (one gamma+1-wide target window + the draft steps)
+                    req.kv_bytes_read += rec.row_bytes
+                else:
+                    # the row STREAMED k read windows whether or not it
+                    # accepted all k tokens (burst tails past done are
+                    # wasted work, not free work) — kv_bytes_read reports
+                    # physical HBM traffic
+                    req.kv_bytes_read += k * rec.row_bytes
                 if n:
                     toks = [int(t) for t in arr[slot, :n]]
                     req.generated.extend(toks)
@@ -1101,6 +1422,9 @@ class ContinuousBatchingEngine:
             pool.cache = insert_fn(pool.cache, pre["cache"], slot)
             start = pre["tokens"].size
             toks = req.prompt[start:]
+        if self.spec_gamma:
+            self._admit_spec(req, pool, pi, slot, toks, start)
+            return
         if self.fused_prefill:
             req.chunks = self._chunk_schedule(pool, toks, start)
             pool.prefill_q.append(req)
@@ -1109,40 +1433,91 @@ class ContinuousBatchingEngine:
             self._set_row(pool, slot, int(toks[-1]), 0)
             return
         m = int(toks.size)
-        if m > 1:
-            if req.prefix is not None:
-                # prefill the suffix MINUS its last token through the shared
-                # segment program: other rows' positions park at the pool
-                # length so their KV writes drop; pad columns land at future
-                # positions of THIS row, each overwritten by a real decode
-                # write before it is ever attended (slot-reuse argument)
-                sb = _bucket(m - 1, pool.length)
-                seg_toks = np.zeros((pool.n_slots, sb), np.int32)
-                seg_toks[slot, :m - 1] = toks[:m - 1]
-                seg_pos = np.full(pool.n_slots, pool.length, np.int32)
-                seg_pos[slot] = start
-                _, pool.cache = pool.segment_fn(
-                    self._eng.params, jnp.asarray(seg_toks), pool.cache,
-                    jnp.asarray(seg_pos))
-            else:
-                b = _bucket(m - 1, pool.length)
-                prefill_fn = self._prefill_for_bucket(b)
-                insert_fn = self._insert_for_bucket(b, pi)
-                ptoks = np.zeros((1, b), np.int32)
-                ptoks[0, :m - 1] = toks[:m - 1]
-                # pads park at bucket (dropped writes), real tokens 0..m-2
-                positions = np.full((1, b), b, np.int32)
-                positions[0, :m - 1] = np.arange(m - 1, dtype=np.int32)
-                small = tf.init_cache(self.cfg, 1, b)
-                _, small = prefill_fn(
-                    self._eng.params, jnp.asarray(ptoks),
-                    jnp.asarray(positions), small)
-                pool.cache = insert_fn(pool.cache, small, slot)
+        self._separate_prefill(pool, pi, slot, req, toks, start)
         # the first tick re-feeds the last prompt token at its own
         # position (writing its KV there — the position was not prefilled)
         # and samples the first generated token from the resulting logits
         self._set_row(pool, slot, int(toks[-1]), 0)
         pool.disp_pos[slot] = start + m - 1
+        pool.disp_gen[slot] = req.gen_base
+
+    def _separate_prefill(self, pool: _Pool, pi: int, slot: int,
+                          req: _Request, toks: np.ndarray, start: int):
+        """Admission-time prefill of ``toks[:-1]`` into the slot row: the
+        B=1 bucket program + splice, or the shared segment program for
+        prefix suffixes. Shared by the plain separate path and every
+        speculative non-fused admission."""
+        from deepspeed_tpu.models import transformer as tf
+
+        m = int(toks.size)
+        if m <= 1:
+            return
+        if req.prefix is not None:
+            # prefill the suffix MINUS its last token through the shared
+            # segment program: other rows' positions park at the pool
+            # length so their KV writes drop; pad columns land at future
+            # positions of THIS row, each overwritten by a real decode
+            # write before it is ever attended (slot-reuse argument)
+            sb = _bucket(m - 1, pool.length)
+            seg_toks = np.zeros((pool.n_slots, sb), np.int32)
+            seg_toks[slot, :m - 1] = toks[:m - 1]
+            seg_pos = np.full(pool.n_slots, pool.length, np.int32)
+            seg_pos[slot] = start
+            _, pool.cache = pool.segment_fn(
+                self._eng.params, jnp.asarray(seg_toks), pool.cache,
+                jnp.asarray(seg_pos))
+        else:
+            b = _bucket(m - 1, pool.length)
+            prefill_fn = self._prefill_for_bucket(b)
+            insert_fn = self._insert_for_bucket(b, pi)
+            ptoks = np.zeros((1, b), np.int32)
+            ptoks[0, :m - 1] = toks[:m - 1]
+            # pads park at bucket (dropped writes), real tokens 0..m-2
+            positions = np.full((1, b), b, np.int32)
+            positions[0, :m - 1] = np.arange(m - 1, dtype=np.int32)
+            small = tf.init_cache(self.cfg, 1, b)
+            _, small = prefill_fn(
+                self._eng.params, jnp.asarray(ptoks),
+                jnp.asarray(positions), small)
+            pool.cache = insert_fn(pool.cache, small, slot)
+
+    def _admit_spec(self, req: _Request, pool: _Pool, pi: int, slot: int,
+                    toks: np.ndarray, start: int):
+        """Speculative admission. The row ALWAYS prefills its tokens minus
+        the last one (fused mode chunks them through the shared segment
+        program, one enqueue-only chunk per step; separate mode uses the
+        bucket prefill + splice) — the row's first spec round feeds the
+        last prompt token and its verify logits yield the first generated
+        token, so fused and separate admission produce the same stream.
+        Draft mode additionally prefills the FULL prompt minus its last
+        token through the draft segment program in one dispatch (prefix
+        caching is target-only — the draft cache starts cold)."""
+        m = int(toks.size)
+        first_pos = start + m - 1
+        if self.spec_mode == "draft":
+            mfull = int(req.prompt.size)
+            if mfull > 1:
+                db = _bucket(mfull - 1, pool.length)
+                dtoks = np.zeros((pool.n_slots, db), np.int32)
+                dtoks[slot, :mfull - 1] = req.prompt[:mfull - 1]
+                dpos = np.full(pool.n_slots, pool.length, np.int32)
+                dpos[slot] = 0
+                _, pool.draft_cache = pool.draft_segment_fn(
+                    self._draft_eng.params, jnp.asarray(dtoks),
+                    pool.draft_cache, jnp.asarray(dpos))
+        if self.fused_prefill and m > 1:
+            req.chunks = self._chunk_schedule(pool, toks[:-1], start)
+            pool.prefill_q.append(req)
+        else:
+            self._separate_prefill(pool, pi, slot, req, toks, start)
+        if self.fault_hook is not None:
+            self.fault_hook("set_row", {"tick": self._tick_index,
+                                        "slot": slot})
+        (pool.last_tok_dev, pool.done_dev, pool.pos_dev,
+         pool.gen_dev) = pool.spec_set_row_fn(
+            pool.last_tok_dev, pool.done_dev, pool.pos_dev, pool.gen_dev,
+            slot, int(toks[-1]), 0, first_pos, int(req.gen_base))
+        pool.disp_pos[slot] = first_pos
         pool.disp_gen[slot] = req.gen_base
 
     def precompile_tick_programs(self, progress: Optional[Callable] = None) -> int:
@@ -1162,6 +1537,9 @@ class ContinuousBatchingEngine:
             read_lens = sorted(
                 {self._read_len(pool, e) for e in range(1, pool.length + 1)},
                 key=lambda r: (r is None, r))
+            if self.spec_gamma:
+                count += self._precompile_spec(pool, read_lens, progress)
+                continue
             chunks: List[Optional[int]] = [None]
             if self.fused_prefill:
                 chunks += sorted({_bucket(m, pool.chunk_cap, _CHUNK_FLOOR)
@@ -1194,6 +1572,60 @@ class ContinuousBatchingEngine:
                                  f"chunk={ch}) in {time.time() - t0:.1f}s")
         return count
 
+    def _precompile_spec(self, pool: _Pool, read_lens, progress) -> int:
+        """Speculative arm of :meth:`precompile_tick_programs`: the spec
+        tick per read bucket (chunks never enter it — fused admission
+        rides the segment program, warmed per chunk width below)."""
+        from deepspeed_tpu.models import transformer as tf
+
+        count, g, n = 0, self.spec_gamma, pool.n_slots
+        for rl in read_lens:
+            t0 = time.time()
+            fn = self._spec_tick_fn(pool, rl)
+            cache = jax.device_put(
+                tf.init_cache(self.cfg, n, pool.length), pool.cache_sh)
+
+            def zeros():
+                # donated operands must not alias — fresh buffers each
+                return jnp.zeros(n, jnp.int32)
+
+            parked = jnp.full(n, pool.length, jnp.int32)
+            if self.spec_mode == "draft":
+                dcache = jax.device_put(
+                    tf.init_cache(self.draft_cfg, n, pool.length),
+                    pool.draft_cache_sh)
+                args = (self._eng.params, self._draft_eng.params, cache,
+                        dcache, zeros(), jnp.ones(n, jnp.int32), parked,
+                        zeros(), zeros(), zeros(), zeros(), self._base_key)
+            else:
+                args = (self._eng.params, cache, zeros(),
+                        jnp.ones(n, jnp.int32), parked, zeros(), zeros(),
+                        zeros(), zeros(), jnp.zeros((n, g), jnp.int32),
+                        self._base_key)
+            jax.block_until_ready(fn(*args)[0])
+            count += 1
+            if progress is not None:
+                progress(f"spec_tick(pool={pool.length}, read_len={rl}, "
+                         f"mode={self.spec_mode}, gamma={g}) "
+                         f"in {time.time() - t0:.1f}s")
+        if self.fused_prefill:
+            # fused spec admission dispatches prompt chunks through the
+            # shared segment program — retraces per chunk width
+            for W in sorted({_bucket(m, pool.chunk_cap, _CHUNK_FLOOR)
+                             for m in range(1, pool.chunk_cap + 1)}):
+                t0 = time.time()
+                cache = jax.device_put(
+                    tf.init_cache(self.cfg, n, pool.length), pool.cache_sh)
+                _, c2 = pool.segment_fn(
+                    self._eng.params, jnp.zeros((n, W), jnp.int32), cache,
+                    jnp.full(n, pool.length, jnp.int32))
+                jax.block_until_ready(c2)
+                count += 1
+                if progress is not None:
+                    progress(f"spec_segment(pool={pool.length}, chunk={W}) "
+                             f"in {time.time() - t0:.1f}s")
+        return count
+
     def _finish(self, pool: _Pool, slot: int):
         # pool pressure BEFORE the pop: the event describes the state this
         # request served under (popping first reads 0.0 for the last one)
@@ -1219,6 +1651,10 @@ class ContinuousBatchingEngine:
             }
             if new:  # every token rides a pool-tick read now
                 event["kv_bytes_per_token"] = round(req.kv_bytes_read / new, 1)
+            if self.spec_gamma:
+                event["spec_gamma"] = self.spec_gamma
+                event["spec_drafted"] = int(req.spec_drafted)
+                event["spec_accepted"] = int(req.spec_accepted)
             if self.request_event_hook is not None:
                 event = self.request_event_hook(req.rid, event) or event
             tele.emit("inference_request", event)

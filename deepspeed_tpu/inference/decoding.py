@@ -447,6 +447,31 @@ def request_keys(base_key, rids, gens):
     return jax.vmap(one)(rids, gens)
 
 
+# Speculative tick RNG lanes: a THIRD fold_in on top of request_keys'
+# (seed, rid, token_index) identity separates the three independent draws
+# speculation makes per token index — the draft proposal, the acceptance
+# uniform, and the bonus/correction draw. Lane keys can never collide with
+# the plain path's two-fold keys (different fold depth), and rejection
+# sampling stays correct because the residual draw at an index is
+# independent of the acceptance uniform that rejected the proposal there.
+LANE_DRAFT, LANE_ACCEPT, LANE_BONUS = 1, 2, 3
+
+
+def spec_request_keys(base_key, rids, gens, lane: int):
+    """Per-row speculative sampling keys:
+    ``fold_in(fold_in(fold_in(base, rid), gen), lane)`` vmapped over the
+    batch. Like :func:`request_keys`, the key depends only on (engine
+    seed, request id, token index, lane) — never on slot placement, tick
+    depth, or how many proposals earlier rounds accepted — so speculative
+    sampled streams are reproducible across pipeline depths, fusion modes,
+    and gamma."""
+    def one(rid, gen):
+        k = jax.random.fold_in(jax.random.fold_in(base_key, rid), gen)
+        return jax.random.fold_in(k, lane)
+
+    return jax.vmap(one)(rids, gens)
+
+
 def select_token_rows(logits, temperature: float, top_k: int, keys,
                       top_p: float = 1.0) -> jnp.ndarray:
     """Row-wise :func:`select_token`: one key per row (request_keys) instead
@@ -616,6 +641,271 @@ def compile_row_update_fn(mesh, cfg, batch_size: int, donate: bool = True):
         in_shardings=(row_sh, row_sh, None, None, None),
         out_shardings=(row_sh, row_sh),
         donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def compile_spec_pool_tick_fn(mesh, cfg, param_shardings, batch_size: int,
+                              cache_len: int, gamma: int, temperature: float,
+                              top_k: int, top_p: float,
+                              eos_token_id: Optional[int] = None,
+                              read_len: Optional[int] = None,
+                              donate: bool = True,
+                              draft_cfg=None, draft_param_shardings=None):
+    """Speculative continuous-batching tick: per dispatch, every active row
+    proposes ``gamma`` tokens, ONE target forward over the (gamma+1)-wide
+    window verifies all rows at once, and the lossless accept/correct rule
+    (the on-device mirror of :func:`_accept_round`) runs inside the jit —
+    per-row accept counts, the bonus token, and the rollback positions land
+    in one packed int32 buffer, so the host keeps its single coalesced
+    fetch per tick and ``pipeline_depth`` dispatch-ahead composes
+    unchanged.
+
+    Two drafting variants share the verify/accept machinery:
+
+    Draft-model (``draft_cfg`` + ``draft_param_shardings`` given): a second
+    param tree resident on the same mesh proposes autoregressively through
+    its own pool-geometry KV cache (gamma single-token steps + one extra
+    step caching the final proposal's KV, mirroring
+    :func:`speculative_decode_loop`)::
+
+        run(params, draft_params, cache, draft_cache, last_tok, done, pos,
+            gen, quota, rids, run_mask, base_key)
+          -> (packed, cache, draft_cache, last_tok, done, pos, gen)
+
+    N-gram / self-drafting (``draft_cfg=None``): the host proposes
+    ``drafts`` (B, gamma) from each request's own emitted context
+    (inference/ngram.py) — a POINT-MASS proposal q = δ(d), for which the
+    acceptance rule degenerates to ``u < p(d)`` and the residual to p with
+    d's mass removed; losslessness holds for any proposal, so speculation
+    needs no second model::
+
+        run(params, cache, last_tok, done, pos, gen, quota, rids, run_mask,
+            drafts, base_key)
+          -> (packed, cache, last_tok, done, pos, gen)
+
+    ``pos``/``gen`` are device-THREADED here (unlike the plain tick, where
+    the host mirrors them exactly): a row advances by its own accepted
+    count, which the host only learns at retire time, so the authoritative
+    copies ride the tick chain and the host keeps an upper-bound mirror
+    for read-geometry selection only. ``run_mask`` (1 = this row decodes
+    this tick) parks rows the host cannot run (mid-prefill, quota already
+    covered by in-flight ticks) without touching their threaded state.
+    Parked and done rows write at position ``cache_len`` — the vector-pos
+    cache scatter drops out-of-range columns, which also makes the
+    quota-tail window overrun safe: columns past a row's last needed
+    position drop their KV writes and their outputs are quota-clipped out
+    of acceptance.
+
+    ``packed`` is (B, gamma+4) int32: ``[:, :gamma+1]`` the emitted tokens
+    (accepted prefix then bonus/correction), ``[:, gamma+1]`` n_emitted,
+    ``[:, gamma+2]`` the done flag, ``[:, gamma+3]`` the accepted draft
+    count (telemetry + host mirror reconciliation). Greedy mode emits the
+    target argmax chain token-for-token identically to the plain tick;
+    sampled mode draws from lane-separated :func:`spec_request_keys`, so
+    streams are reproducible across scheduling but (like any rejection
+    sampler) equal to the plain stream in distribution, not bitwise.
+    Returns ``(run_fn, cache_sh, row_sh)``."""
+    from deepspeed_tpu.models import transformer as tf
+
+    row_sh, cache_sh, _ = _tick_shardings(mesh, cfg, batch_size)
+    assert gamma >= 1, gamma
+    B, g1 = batch_size, gamma + 1
+    greedy = temperature <= 0.0
+    draft_mode = draft_cfg is not None
+    if draft_mode:
+        _, draft_cache_sh = _decode_shardings(mesh, draft_cfg, batch_size)
+    iota_g = jnp.arange(gamma, dtype=jnp.int32)
+    iota_g1 = jnp.arange(g1, dtype=jnp.int32)
+
+    def accept_round(vlogits, drafts, qstack, active, pos, gen, quota,
+                     last_tok, done, rids, base_key):
+        """On-device mirror of :func:`_accept_round` plus the emission/
+        state bookkeeping the host loop does around it. ``qstack`` None
+        means a point-mass proposal (ngram)."""
+        if greedy:
+            tgt = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # (B, g1)
+            match = drafts == tgt[:, :gamma]
+        else:
+            V = vlogits.shape[-1]
+            p = _filtered_probs(
+                vlogits.reshape(B * g1, V), temperature, top_k, top_p
+            ).reshape(B, g1, V)
+            p_at = jnp.take_along_axis(
+                p[:, :gamma], drafts[..., None], axis=2)[..., 0]
+            if qstack is None:
+                ratio = p_at  # point-mass proposal: q(d) == 1
+            else:
+                q_at = jnp.take_along_axis(
+                    qstack, drafts[..., None], axis=2)[..., 0]
+                ratio = p_at / jnp.maximum(q_at, 1e-20)
+
+            def urow(rid, g0):
+                def at(i):
+                    k = jax.random.fold_in(
+                        jax.random.fold_in(base_key, rid), g0 + i)
+                    return jax.random.uniform(
+                        jax.random.fold_in(k, LANE_ACCEPT))
+
+                return jax.vmap(at)(iota_g)
+
+            u = jax.vmap(urow)(rids, gen)
+            match = u < jnp.minimum(1.0, ratio)
+        n_acc = jnp.where(
+            match.all(axis=1), gamma,
+            jnp.argmin(match.astype(jnp.int32), axis=1)).astype(jnp.int32)
+
+        rem = jnp.maximum(quota - gen, 0)
+        n_take = jnp.minimum(n_acc, rem)
+        if eos_token_id is not None:
+            eos_mask = (drafts == eos_token_id) & (iota_g[None] < n_take[:, None])
+            took_eos = eos_mask.any(axis=1)
+            first_eos = jnp.where(
+                took_eos, jnp.argmax(eos_mask.astype(jnp.int32), axis=1), gamma)
+            n_take = jnp.minimum(n_take, first_eos + 1)
+        else:
+            took_eos = jnp.zeros((B,), bool)
+        took_eos = took_eos & active
+        n_take = jnp.where(active, n_take, 0).astype(jnp.int32)
+
+        bonus_ok = active & ~took_eos & (n_take == n_acc) & (gen + n_take < quota)
+        if greedy:
+            bonus = jnp.take_along_axis(tgt, n_take[:, None], axis=1)[:, 0]
+        else:
+            p_b = jnp.take_along_axis(p, n_take[:, None, None], axis=1)[:, 0]
+            if qstack is None:
+                d_b = jnp.take_along_axis(
+                    drafts, jnp.minimum(n_take, gamma - 1)[:, None], axis=1)[:, 0]
+                q_b = jax.nn.one_hot(d_b, p_b.shape[-1], dtype=p_b.dtype)
+            else:
+                q_b = jnp.take_along_axis(
+                    qstack, jnp.minimum(n_take, gamma - 1)[:, None, None],
+                    axis=1)[:, 0]
+            residual = jnp.maximum(p_b - q_b, 0.0)
+            dist = jnp.where((n_take < gamma)[:, None], residual, p_b)
+            tot = dist.sum(axis=1, keepdims=True)
+            dist = jnp.where(tot > 0, dist / jnp.where(tot > 0, tot, 1.0), p_b)
+            bkeys = spec_request_keys(base_key, rids, gen + n_take, LANE_BONUS)
+            bonus = jax.vmap(jax.random.categorical)(
+                bkeys, jnp.where(dist > 0, jnp.log(dist), -1e30)
+            ).astype(jnp.int32)
+
+        n_emit = n_take + bonus_ok.astype(jnp.int32)
+        pad_drafts = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        tok_out = jnp.where(
+            iota_g1[None] < n_take[:, None], pad_drafts,
+            jnp.where((iota_g1[None] == n_take[:, None]) & bonus_ok[:, None],
+                      bonus[:, None], 0))
+        gen2 = jnp.where(active, gen + n_emit, gen)
+        done2 = jnp.where(active & (took_eos | (gen2 >= quota)), 1, done)
+        if eos_token_id is not None:
+            done2 = jnp.where(active & bonus_ok & (bonus == eos_token_id),
+                              1, done2)
+        last2 = jnp.where(active & bonus_ok, bonus, last_tok)
+        # rollback-on-rejection IS the position rule: the next round's
+        # window starts right after the last verified input column the row
+        # consumed, so rejected drafts' KV is overwritten before any later
+        # query's causal extent reaches it (windows tile contiguously)
+        pos2 = jnp.where(active, pos + n_take + 1, pos)
+        packed = jnp.concatenate(
+            [tok_out, n_emit[:, None], done2[:, None], n_take[:, None]],
+            axis=1)
+        return packed, last2, done2, pos2, gen2
+
+    if not draft_mode:
+        def run(params, cache, last_tok, done, pos, gen, quota, rids,
+                run_mask, drafts, base_key):
+            active = (done == 0) & (run_mask == 1)
+            wpos = jnp.where(active, pos, cache_len)
+            seg = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            vlogits, cache = tf.forward_with_cache(
+                params, cfg, seg, cache, wpos, read_len=read_len)
+            packed, last2, done2, pos2, gen2 = accept_round(
+                vlogits, drafts, None, active, pos, gen, quota,
+                last_tok, done, rids, base_key)
+            return packed, cache, last2, done2, pos2, gen2
+
+        fn = jax.jit(
+            run,
+            in_shardings=(param_shardings, cache_sh, row_sh, row_sh, row_sh,
+                          row_sh, row_sh, row_sh, row_sh, row_sh, None),
+            out_shardings=(row_sh, cache_sh, row_sh, row_sh, row_sh, row_sh),
+            donate_argnums=(1, 2, 3, 4, 5) if donate else (),
+        )
+        return fn, cache_sh, row_sh
+
+    def run(params, draft_params, cache, draft_cache, last_tok, done, pos,
+            gen, quota, rids, run_mask, base_key):
+        active = (done == 0) & (run_mask == 1)
+        wpos = jnp.where(active, pos, cache_len)
+
+        def dbody(carry, i):
+            dcache, cur = carry
+            dlogits, dcache = tf.forward_with_cache(
+                draft_params, draft_cfg, cur[:, None], dcache,
+                jnp.where(active, pos + i, cache_len), read_len=read_len)
+            lg = dlogits[:, 0]
+            if greedy:
+                d = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                return (dcache, d), d
+            q = _filtered_probs(lg, temperature, top_k, top_p)
+            keys = spec_request_keys(base_key, rids, gen + i, LANE_DRAFT)
+            d = jax.vmap(jax.random.categorical)(
+                keys, jnp.where(q > 0, jnp.log(q), -1e30)).astype(jnp.int32)
+            return (dcache, d), (d, q)
+
+        (draft_cache, dlast), ys = jax.lax.scan(
+            dbody, (draft_cache, last_tok), iota_g)
+        if greedy:
+            drafts, qstack = jnp.moveaxis(ys, 0, 1), None
+        else:
+            drafts = jnp.moveaxis(ys[0], 0, 1)        # (B, gamma)
+            qstack = jnp.moveaxis(ys[1], 0, 1)        # (B, gamma, V)
+        # one extra draft step caches the final proposal's KV so the draft
+        # context stays complete when every proposal is accepted
+        _, draft_cache = tf.forward_with_cache(
+            draft_params, draft_cfg, dlast[:, None], draft_cache,
+            jnp.where(active, pos + gamma, cache_len), read_len=read_len)
+
+        seg = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+        vlogits, cache = tf.forward_with_cache(
+            params, cfg, seg, cache, wpos, read_len=read_len)
+        packed, last2, done2, pos2, gen2 = accept_round(
+            vlogits, drafts, qstack, active, pos, gen, quota,
+            last_tok, done, rids, base_key)
+        return packed, cache, draft_cache, last2, done2, pos2, gen2
+
+    fn = jax.jit(
+        run,
+        in_shardings=(param_shardings, draft_param_shardings, cache_sh,
+                      draft_cache_sh, row_sh, row_sh, row_sh, row_sh,
+                      row_sh, row_sh, row_sh, None),
+        out_shardings=(row_sh, cache_sh, draft_cache_sh, row_sh, row_sh,
+                       row_sh, row_sh),
+        donate_argnums=(2, 3, 4, 5, 6, 7) if donate else (),
+    )
+    return fn, cache_sh, row_sh
+
+
+def compile_spec_row_update_fn(mesh, cfg, batch_size: int, donate: bool = True):
+    """:func:`compile_row_update_fn` for the speculative tick's WIDER
+    device-threaded state: ``pos``/``gen`` ride the tick chain too (a row
+    advances by its own accepted count, which only the device knows at
+    dispatch time), so admission must splice them in the same
+    enqueue-only way. Returns ``set_row(last_tok, done, pos, gen, slot,
+    tok, flag, p, g) -> (last_tok, done, pos, gen)``."""
+    row_sh, _, _ = _tick_shardings(mesh, cfg, batch_size)
+
+    def set_row(last_tok, done, pos, gen, slot, tok, flag, p, g):
+        return (last_tok.at[slot].set(tok), done.at[slot].set(flag),
+                pos.at[slot].set(p), gen.at[slot].set(g))
+
+    return jax.jit(
+        set_row,
+        in_shardings=(row_sh, row_sh, row_sh, row_sh, None, None, None,
+                      None, None),
+        out_shardings=(row_sh, row_sh, row_sh, row_sh),
+        donate_argnums=(0, 1, 2, 3) if donate else (),
     )
 
 
@@ -876,8 +1166,12 @@ def speculative_generate(cfg, params, draft, tokens, max_new_tokens: int,
     ``draft`` is an InferenceEngine providing its own via _spec_fns."""
     from deepspeed_tpu.models import transformer as tf
 
-    assert draft.cfg.vocab_size == cfg.vocab_size, "draft must share the vocabulary"
-    assert gamma >= 1, f"num_draft_tokens must be >= 1, got {gamma}"
+    if draft.cfg.vocab_size != cfg.vocab_size:
+        raise ValueError(
+            f"draft must share the vocabulary: draft vocab "
+            f"{draft.cfg.vocab_size} != target vocab {cfg.vocab_size}")
+    if gamma < 1:
+        raise ValueError(f"speculative.num_draft_tokens must be >= 1, got {gamma}")
     B, S = tokens.shape
     total = S + max_new_tokens + gamma + 1  # verify-round overrun slack
     cache_len = bounded_cache_len(total, max(cfg.max_seq_len, total), max_out_tokens)

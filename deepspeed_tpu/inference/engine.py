@@ -563,12 +563,16 @@ class InferenceEngine:
             if draft is None:
                 raise ValueError(
                     "speculative.enabled but no draft model: pass draft= to "
-                    "generate() or draft_model= to init_inference()"
+                    "generate() or draft_model= to init_inference(), or set "
+                    "speculative.mode='ngram' for draft-free self-drafting "
+                    "(pooled serving, ContinuousBatcher)"
                 )
         if draft is not None:
             gamma = (num_draft_tokens if num_draft_tokens is not None
                      else self.config.speculative.num_draft_tokens)
-            assert gamma >= 1, f"num_draft_tokens must be >= 1, got {gamma}"
+            if gamma < 1:
+                raise ValueError(
+                    f"speculative.num_draft_tokens must be >= 1, got {gamma}")
             result = self._generate_speculative(
                 draft, tokens, max_new_tokens, temperature, top_k, top_p, rng,
                 gamma, eos_token_id,

@@ -71,6 +71,17 @@ def main(argv=None):
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--cache-len", type=int, default=512)
     p.add_argument("--burst", type=int, default=1)
+    p.add_argument("--speculative", default=None, metavar="GAMMA[:MODE]",
+                   help="warm the SPECULATIVE tick family (--continuous): "
+                        "gamma draft tokens verified per round, mode "
+                        "'ngram' (default, draft-free) or 'draft' (a "
+                        "second model on the same mesh — needs "
+                        "--draft-preset). Implies single-token ticks "
+                        "(--burst ignored); docs/inference.md "
+                        "'Speculative decoding'")
+    p.add_argument("--draft-preset", default=None,
+                   help="draft-model preset for --speculative GAMMA:draft "
+                        "(must share the target's vocabulary)")
     p.add_argument("--pipeline-depth", type=int, default=1,
                    help="pipeline depth the warmed serve will run at (a "
                         "host-loop knob: it does not change the compiled "
@@ -198,11 +209,28 @@ def main(argv=None):
             if args.continuous:
                 from deepspeed_tpu.inference import ContinuousBatchingEngine
 
+                scfg, spec_kw, burst = dict(mcfg), {}, args.burst
+                if args.speculative:
+                    g, _, m = args.speculative.partition(":")
+                    mode = m or "ngram"
+                    scfg["speculative"] = {
+                        "enabled": True, "pool": True, "mode": mode,
+                        "num_draft_tokens": int(g)}
+                    burst = 1  # the gamma-wide verify round IS the burst
+                    if mode == "draft":
+                        if not args.draft_preset:
+                            p.error("--speculative GAMMA:draft needs "
+                                    "--draft-preset")
+                        dmodel = TransformerModel.from_preset(
+                            args.draft_preset, dtype=args.dtype)
+                        spec_kw = dict(
+                            draft_model=dmodel,
+                            draft_params=dmodel.init(jax.random.PRNGKey(1)))
                 serve = ContinuousBatchingEngine(
-                    model, params=params, config=dict(mcfg), max_slots=args.slots,
-                    cache_len=args.cache_len, tokens_per_tick=args.burst,
+                    model, params=params, config=scfg, max_slots=args.slots,
+                    cache_len=args.cache_len, tokens_per_tick=burst,
                     pipeline_depth=args.pipeline_depth,
-                    fused_prefill=not args.no_fused_prefill)
+                    fused_prefill=not args.no_fused_prefill, **spec_kw)
 
                 def run_pool():
                     # drive a real request through: warms the admission programs
@@ -218,8 +246,10 @@ def main(argv=None):
                         serve.step()
                     serve.finished()
 
+                spec_label = (f", speculative={args.speculative}"
+                              if args.speculative else "")
                 tick(f"continuous pool (slots={args.slots}, cache={args.cache_len}, "
-                     f"burst={args.burst}{label})", run_pool)
+                     f"burst={burst}{spec_label}{label})", run_pool)
                 # then the FULL tick-program family (bucket x read_len x {plain,
                 # burst, fused-prefill}) under THIS mesh: a live serve dispatches
                 # whichever variant its mix demands — every one missing

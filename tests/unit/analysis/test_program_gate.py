@@ -111,6 +111,14 @@ def test_tp2_inventory_matches_the_pinned_profiles(artifacts):
             assert art.collective_inventory() == plain, label
     for art in table["program://pool_row_update@tp2"]:
         assert art.collective_inventory() == {}
+    for mode in ("ngram", "draft"):
+        g = expected_collectives(f"spec_tick_{mode}", 2, sampled=False)
+        s = expected_collectives(f"spec_tick_{mode}", 2, sampled=True)
+        for art in table[f"program://pool_spec_tick_{mode}@tp2"]:
+            assert art.collective_inventory() == (
+                s if art.meta.get("sampled") else g), art.label
+    for art in table["program://pool_spec_row_update@tp2"]:
+        assert art.collective_inventory() == {}
     for fam in ("train_micro", "train_apply"):
         for art in table[f"program://{fam}@tp2"]:
             assert art.collective_inventory() == \

@@ -130,6 +130,15 @@ def decode_table(events):
                     and not isinstance(e.get(field), bool)]
             if vals:
                 row[f"{field}_mean"] = sum(vals) / len(vals)
+        # speculative acceptance (requests served by spec pool ticks):
+        # pooled drafted/accepted totals — "accepted" means emitted to
+        # the client (quota-clipped), so this is the effective rate
+        drafted = sum(int(e["spec_drafted"]) for e in evs
+                      if isinstance(e.get("spec_drafted"), int)
+                      and not isinstance(e.get("spec_drafted"), bool))
+        if drafted:
+            accepted = sum(int(e.get("spec_accepted", 0)) for e in evs)
+            row["spec_acceptance"] = accepted / drafted
         out[path] = row
     return out
 
@@ -139,7 +148,7 @@ def format_decode_table(table):
         return ""
     cols = ("count", "ttft_ms_p50", "ttft_ms_p95", "tok_s_p50", "tok_s_p95",
             "kv_bytes_read_p50", "kv_bytes_read_p95", "kv_bytes_per_token_mean",
-            "cache_utilization_mean")
+            "cache_utilization_mean", "spec_acceptance")
     present = [c for c in cols if any(c in row for row in table.values())]
     name_w = max(len("path"), max(len(p) for p in table))
     col_w = max(12, max(len(c) for c in present) + 2)
@@ -225,6 +234,31 @@ def serve_table(events):
                   if isinstance(e.get("inflight"), (int, float))]
         if depths:
             out["inflight_max"] = max(depths)
+    # speculative sub-table: serving_tick events from a speculative pool
+    # carry spec_gamma plus per-step drafted/accepted deltas, so the
+    # tick-window acceptance rate is Σ accepted / Σ drafted; the finished
+    # request stream adds the per-request acceptance spread
+    spec_ticks = [e for e in ticks if e.get("spec_gamma")]
+    if spec_ticks:
+        drafted = sum(int(e.get("spec_drafted", 0)) for e in spec_ticks)
+        accepted = sum(int(e.get("spec_accepted", 0)) for e in spec_ticks)
+        spec = {"gamma": int(spec_ticks[-1]["spec_gamma"]),
+                "ticks": len(spec_ticks),
+                "drafted": drafted, "accepted": accepted}
+        if drafted:
+            spec["acceptance"] = round(accepted / drafted, 4)
+            spec["accepted_per_draft"] = round(
+                accepted / drafted * spec["gamma"], 3)
+        rates = sorted(
+            float(e["spec_accepted"]) / float(e["spec_drafted"])
+            for e in finished
+            if isinstance(e.get("spec_drafted"), int)
+            and not isinstance(e.get("spec_drafted"), bool)
+            and e.get("spec_drafted"))
+        if rates:
+            spec["request_acceptance_p50"] = round(percentile(rates, 50.0), 4)
+            spec["request_acceptance_p95"] = round(percentile(rates, 95.0), 4)
+        out["speculative"] = spec
     if faults:
         # recovery section: serving_fault events are the fault-tolerance
         # layer's journal — tick failures, retry outcomes, engine
@@ -406,6 +440,21 @@ def format_serve_table(table):
             tail.append(f"inflight<= {table['inflight_max']}")
         if tail:
             lines.append(f"                  {'   '.join(tail)}")
+    spec = table.get("speculative")
+    if spec:
+        line = (f"speculative       gamma {spec['gamma']}"
+                f"   drafted {spec['drafted']}"
+                f"   accepted {spec['accepted']}")
+        if "acceptance" in spec:
+            line += (f"   acceptance {spec['acceptance'] * 100:.1f}%"
+                     f" ({_fmt(spec['accepted_per_draft'])}/{spec['gamma']}"
+                     f" per draft)")
+        lines.append(line)
+        if "request_acceptance_p50" in spec:
+            lines.append(
+                f"                  per-request acceptance p50 "
+                f"{spec['request_acceptance_p50'] * 100:.1f}%   p95 "
+                f"{spec['request_acceptance_p95'] * 100:.1f}%")
     if "fault_events" in table:
         line = (f"recovery          faults {table['faults']}"
                 f"   retries {table['fault_retries']}"
