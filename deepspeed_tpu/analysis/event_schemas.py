@@ -88,6 +88,30 @@ EVENT_SCHEMAS = {
             "spec_gamma": "int",
             "spec_drafted": "int",
             "spec_accepted": "int",
+            "trace_id": "str",
+        },
+    },
+    "span": {
+        # request-scoped tracing (telemetry/spans.py write side,
+        # telemetry/timeline.py read side): one closed span per line,
+        # kinds enumerated in timeline.SPAN_KINDS (queue | admission |
+        # prefill_chunk | decode_window | spec_verify_round | migration |
+        # recovery_replay | drain_wait | train_step | train_retry |
+        # train_rebuild). t0/t1 are monotonic-clock seconds in one clock
+        # domain per trace file; parent_id stitches causality (absent on
+        # roots); attrs carries kind-specific detail.
+        "required": {
+            "span": "str",
+            "trace_id": "str",
+            "span_id": "str",
+            "t0": "number",
+            "t1": "number",
+            "dur_ms": "number",
+        },
+        "optional": {
+            "parent_id": "str",
+            "attrs": "dict",
+            "replica": "str",
         },
     },
     "serving_event": {

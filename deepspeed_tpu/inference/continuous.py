@@ -135,6 +135,17 @@ class _Request:
     # request vs drafts its verify rounds accepted
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # tick-window span accumulation (span_hook): consecutive retired
+    # ticks of one kind coalesce into one window span, flushed on kind
+    # change / span_window_ticks / finish — host bookkeeping only, the
+    # times come from clocks the tick loop already reads
+    win_kind: Optional[str] = None
+    win_t0: float = 0.0
+    win_t1: float = 0.0
+    win_ticks: int = 0
+    win_tokens: int = 0
+    win_drafted: int = 0
+    win_accepted: int = 0
 
 
 class _TickRecord:
@@ -142,7 +153,7 @@ class _TickRecord:
     the packed result future plus everything needed to attribute it when
     the tick is retired."""
 
-    __slots__ = ("packed", "live", "k", "row_bytes", "fused", "spec")
+    __slots__ = ("packed", "live", "k", "row_bytes", "fused", "spec", "t0")
 
     def __init__(self, packed, live, k, row_bytes, fused, spec=0):
         self.packed = packed          # device future: (B, k+2) int32
@@ -152,6 +163,9 @@ class _TickRecord:
         self.fused = fused            # carried a prefill chunk
         self.spec = spec              # speculative round: gamma (0 = plain;
         # packed is (B, gamma+4) and row_bytes is the WHOLE round's bytes)
+        self.t0 = 0.0                 # dispatch time for window spans
+        # (time.monotonic, set by _step_body only when a span_hook is
+        # installed — zero otherwise, never read)
 
 
 class _Pool:
@@ -439,6 +453,17 @@ class ContinuousBatchingEngine:
         # emitted (deepspeed_tpu/serving adds queue_ms/priority/deadline_met
         # and retags path:"serving"). None = emit the event as built.
         self.request_event_hook: Optional[Callable[[int, dict], Optional[dict]]] = None
+        # request-scoped tracing (docs/telemetry.md "Request tracing"):
+        # called with (rid, span_kind, t0, t1, attrs) when a coalesced
+        # tick window retires — prefill_chunk / decode_window /
+        # spec_verify_round, times in time.monotonic seconds. The serving
+        # layer installs this ONLY when its hub is live, so the default
+        # tick loop pays nothing (no clock reads, no window bookkeeping).
+        # Windows coalesce up to span_window_ticks consecutive same-kind
+        # ticks per request: span volume scales ~tokens/window, not
+        # per-tick.
+        self.span_hook: Optional[Callable[[int, str, float, float, dict], None]] = None
+        self.span_window_ticks = 16
         # fault-injection hook (serving/faults.py FaultInjector): called
         # with (point, info) at "dispatch" (top of step, BEFORE any state
         # mutates), "retire" (before each packed-result fetch) and
@@ -922,6 +947,12 @@ class ContinuousBatchingEngine:
         # overlap); the block span in _retire ends at a real host fetch
         dispatch_ms = (time.perf_counter() - t0) * 1000.0  # ds-lint: disable=unsynced-timing
         if recs:
+            if self.span_hook is not None:
+                # window-span clock zero for this tick's records: one
+                # host clock read per step, no device traffic
+                t_disp = time.monotonic()
+                for r in recs.values():
+                    r.t0 = t_disp
             self._inflight.append(recs)
         stats = self._tick_stats
         stats["steps"] += 1
@@ -1290,6 +1321,11 @@ class ContinuousBatchingEngine:
             block_ms += dt * 1000.0
             k = rec.k
             g = rec.spec
+            hook = self.span_hook
+            if hook is not None:
+                t_ret = time.monotonic()
+                tick_kind = ("spec_verify_round" if g else
+                             "prefill_chunk" if rec.fused else "decode_window")
             for slot, req in rec.live.items():
                 if pool.active.get(slot) is not req:
                     # cancelled / already finished while this tick was in
@@ -1320,6 +1356,24 @@ class ContinuousBatchingEngine:
                     # wasted work, not free work) — kv_bytes_read reports
                     # physical HBM traffic
                     req.kv_bytes_read += k * rec.row_bytes
+                if hook is not None:
+                    # coalesce this retired tick into the request's open
+                    # window (flush on kind change / window cap; _finish
+                    # flushes the tail) — pure host arithmetic on values
+                    # the attribution above already fetched
+                    if req.win_kind is not None and req.win_kind != tick_kind:
+                        self._flush_window(req)
+                    if req.win_kind is None:
+                        req.win_kind = tick_kind
+                        req.win_t0 = rec.t0
+                    req.win_t1 = t_ret
+                    req.win_ticks += 1
+                    req.win_tokens += n
+                    if g:
+                        req.win_drafted += g
+                        req.win_accepted += accepted
+                    if req.win_ticks >= self.span_window_ticks:
+                        self._flush_window(req)
                 if n:
                     toks = [int(t) for t in arr[slot, :n]]
                     req.generated.extend(toks)
@@ -1328,6 +1382,22 @@ class ContinuousBatchingEngine:
                     req.done = True
                     self._finish(pool, slot)
         return block_ms
+
+    def _flush_window(self, req: "_Request"):
+        """Emit the request's open tick window through ``span_hook`` and
+        reset the accumulator. No-op when no window is open (or the hook
+        was uninstalled mid-flight)."""
+        if req.win_kind is None or self.span_hook is None:
+            req.win_kind = None
+            return
+        attrs = {"ticks": req.win_ticks, "tokens": req.win_tokens}
+        if req.win_kind == "spec_verify_round":
+            attrs["drafted"] = req.win_drafted
+            attrs["accepted"] = req.win_accepted
+        self.span_hook(req.rid, req.win_kind, req.win_t0, req.win_t1, attrs)
+        req.win_kind = None
+        req.win_ticks = req.win_tokens = 0
+        req.win_drafted = req.win_accepted = 0
 
     # -- internals ------------------------------------------------------
     def _prefill_for_bucket(self, bucket: int):
@@ -1631,6 +1701,9 @@ class ContinuousBatchingEngine:
         # request served under (popping first reads 0.0 for the last one)
         util = self.cache_utilization()
         req = pool.active.pop(slot)
+        self._flush_window(req)  # tail window span BEFORE the request
+        # leaves the serving layer's engine-rid table (the hook resolves
+        # the trace through it)
         self._results[req.rid] = np.concatenate(
             [req.prompt, np.asarray(req.generated, np.int32)]
         )

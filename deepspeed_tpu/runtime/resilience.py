@@ -76,6 +76,7 @@ from deepspeed_tpu.faults import (
     TrainPreempted,
 )
 from deepspeed_tpu.runtime.checkpoint_engine import integrity as ckpt_integrity
+from deepspeed_tpu.telemetry.spans import SpanEmitter
 from deepspeed_tpu.runtime.numerics import (
     NumericCorruption,
     NumericSentinel,
@@ -278,6 +279,14 @@ class TrainSupervisor:
         self._pinned_batch = None      # first micro-batch seen, host copy
         self._clock = time.perf_counter
         self._sleep = time.sleep
+        # train-side request tracing (docs/telemetry.md "Request
+        # tracing"): one trace per training step (trace_id "step:N") with
+        # a train_step root and train_retry / train_rebuild children —
+        # the same span model, reader and tooling as serving traces. The
+        # emitter binds to the hub lazily (the hub exists only after the
+        # first engine build).
+        self._spans = SpanEmitter(None, clock=self._clock)
+        self._step_span: Optional[str] = None  # open train_step root id
 
     # ------------------------------------------------------------------
     # engine lifecycle
@@ -293,6 +302,7 @@ class TrainSupervisor:
             self._tele = eng.telemetry
         else:
             eng.telemetry = self._tele
+        self._spans.rebind(self._tele)
         eng.fault_hook = self.fault_hook
         if self.cfg.fetch_timeout_s is not None:
             eng.fetch_timeout_s = self.cfg.fetch_timeout_s
@@ -315,12 +325,25 @@ class TrainSupervisor:
         self._ensure_engine()
         while self.engine.global_steps < num_steps:
             step_no = self.engine.global_steps + 1
+            # train_step root span covers the attempt AND any in-step
+            # recovery (its id is minted up front so train_retry /
+            # train_rebuild children can parent on it before it closes)
+            span_t0 = self._clock()
+            self._step_span = (self._spans.new_span_id()
+                               if self._spans.enabled else None)
             try:
                 self._run_one_step(step_no)
             except TrainingFailed:
                 raise
             except Exception as exc:  # noqa: BLE001 — every failure enters the ladder
                 self._on_step_failure(step_no, exc)
+            finally:
+                if self._step_span is not None:
+                    self._spans.emit(
+                        "train_step", f"step:{step_no}", span_t0,
+                        self._clock(), span_id=self._step_span,
+                        attrs={"step": step_no})
+                    self._step_span = None
         # the last cadence's async save must be durable before run()
         # reports success
         self._fence_pending_save()
@@ -401,11 +424,19 @@ class TrainSupervisor:
                 self._count_fault(exc, step=step_no, micro=micro)
                 if eng.poisoned or attempt >= cfg.max_step_retries:
                     raise
+                retry_t0 = self._clock()
                 self._sleep(cfg.backoff_s * (2 ** attempt))
                 attempt += 1
                 self._retry_count += 1
                 if self._tele is not None and self._tele.enabled:
                     self._tele.registry.counter("step_retry_total").inc()
+                if self._step_span is not None:
+                    # the backoff window, attributed as recovery time
+                    # inside the step's trace
+                    self._spans.emit(
+                        "train_retry", f"step:{step_no}", retry_t0,
+                        self._clock(), parent_id=self._step_span,
+                        attrs={"micro": micro, "attempt": attempt})
 
     # ------------------------------------------------------------------
     # numerical-health rungs (quarantine < rewind < the ordinary ladder)
@@ -760,6 +791,15 @@ class TrainSupervisor:
             reg = self._tele.registry
             reg.counter("rebuild_total").inc()
             reg.histogram("recovery_ms").observe(recovery_ms)
+        if self._step_span is not None:
+            # whole-rebuild window (both in-process and disk-restore
+            # paths converge here with the rung's t0 in hand)
+            self._spans.emit(
+                "train_rebuild", f"step:{failed_at}", t0, self._clock(),
+                parent_id=self._step_span,
+                attrs={"source": source, "resume_step": resume_step,
+                       "degraded": degraded,
+                       "rebuilds": self._rebuild_count})
         logger.warning(
             f"training engine rebuilt after {type(exc).__name__} at step "
             f"{failed_at} (#{self._rebuild_count}, {recovery_ms:.1f} ms, "

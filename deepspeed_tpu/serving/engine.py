@@ -43,6 +43,14 @@ touching the hot path:
   ``request_event_hook``) with ``path:"serving"``, ``queue_ms``,
   ``ttft_ms``, ``priority``, ``tenant``, ``deadline_ms``/``deadline_met``
   so ``ds_trace_report --serve`` can summarize a run.
+- **Request tracing** (docs/telemetry.md "Request tracing"): every
+  admitted request carries a ``trace_id`` (optionally sampled via
+  ``span_sampler=``) and the lifecycle emits causally-linked ``span``
+  events — queue/admission here, tick windows via the engine's
+  ``span_hook``, recovery_replay on rebuild, migration bridges from the
+  fleet router — that ``telemetry/timeline.py`` reconstructs into one
+  per-request timeline with critical-path attribution and Perfetto
+  export (``ds_trace_report --request`` / ``ds_trace_timeline``).
 
 Single-threaded by design, like the engine it wraps: the caller (or
 ``tools/ds_loadgen.py``) drives ``step()``; everything is deterministic
@@ -84,6 +92,7 @@ from deepspeed_tpu.serving.request import (
     Admission,
     ServeRequest,
 )
+from deepspeed_tpu.telemetry.spans import SpanEmitter
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -142,7 +151,8 @@ class ServingEngine:
                  pipeline_depth: Optional[int] = None,
                  engine_factory: Optional[Callable] = None,
                  degrade_mesh_shapes: Optional[List[dict]] = None,
-                 recovery=None, sleep=time.sleep):
+                 recovery=None, sleep=time.sleep,
+                 span_sampler: Optional[Callable[[int], bool]] = None):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
         if aging_s <= 0:
@@ -238,7 +248,23 @@ class ServingEngine:
         self._next_rid = 0
         self._t_start: Optional[float] = None  # first submit: rate clock zero
         self._tokens_done = 0                  # finished requests' tokens
+        # committed (finished-request) tokens per tenant — the /statusz
+        # fair-share view and serve_tenant_committed_tokens gauges
+        self._tenant_tokens: Dict[str, int] = {}
         engine.request_event_hook = self._event_hook
+        # -- request-scoped tracing (docs/telemetry.md "Request tracing") --
+        # One SpanEmitter per serving engine; span ids are scope-unique so
+        # several replicas sharing one trace file never collide. The
+        # sampler (None = trace everything) decides per ORIGINAL serving
+        # rid at submit; sampled-out requests get trace_id None and emit
+        # no spans (their counters/events are untouched). The engine-side
+        # span hook is installed only when the hub is live, so a disabled
+        # build never pays the per-tick window bookkeeping.
+        self._span_sampler = span_sampler
+        self._spans = SpanEmitter(self._tele, clock=clock)
+        self._drain_t0: Optional[float] = None  # drain() start, for drain_wait
+        if self._tele.enabled:
+            engine.span_hook = self._span_hook
 
     # -- public API -----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
@@ -298,6 +324,12 @@ class ServingEngine:
                            tenant=tenant, deadline_ms=deadline_ms,
                            on_token=on_token, submit_t=now,
                            prefix_id=prefix_id)
+        if self._tele.enabled and (self._span_sampler is None
+                                   or self._span_sampler(rid)):
+            # trace identity = birth replica + original serving rid; it
+            # rides the recovery entry unchanged, so spans emitted after a
+            # migration still land on the SAME trace
+            req.trace_id = f"{self._trace_scope()}{rid}"
         self._requests[rid] = req
         # empty queue + a fitting free slot: hand straight to the engine —
         # the strongest statement submit can truthfully make (with a
@@ -348,6 +380,22 @@ class ServingEngine:
                 if req is None:
                     continue
                 self._finish_request(req, result, tnow)
+            if ticked and self._tele.enabled:
+                s = self._cb.tick_stats()
+                if s.get("spec_drafted"):
+                    # live acceptance rate for /metrics + /statusz: the
+                    # one number that says whether speculation is earning
+                    # its verify FLOPs right now
+                    self._tele.registry.gauge("serve_spec_acceptance").set(
+                        round(s["spec_accepted"] / s["spec_drafted"], 4))
+        if (self._drain_t0 is not None and self._draining
+                and not self.has_work()):
+            # the drain completed this tick: close the ops-scoped
+            # drain_wait span (how long removal-from-rotation stalled on
+            # in-flight work)
+            self._spans.emit("drain_wait", f"{self._trace_scope()}ops",
+                             self._drain_t0, self._clock())
+            self._drain_t0 = None
         self._update_gauges()
         return out
 
@@ -364,10 +412,15 @@ class ServingEngine:
             # telemetry off: the event hook didn't judge it first
             req.deadline_met = now <= req.deadline_at
         self._tokens_done += len(req.tokens)
+        self._tenant_tokens[req.tenant] = (
+            self._tenant_tokens.get(req.tenant, 0) + len(req.tokens))
         self.policy.on_finish(req, now)
         if self._tele.enabled:
             reg = self._tele.registry
             reg.counter("serve_finished_total").inc()
+            reg.gauge("serve_tenant_committed_tokens",
+                      {"tenant": req.tenant}).set(
+                self._tenant_tokens[req.tenant])
             if req.deadline_met is not None:
                 reg.counter("serve_deadline_met_total" if req.deadline_met
                             else "serve_deadline_missed_total").inc()
@@ -550,6 +603,8 @@ class ServingEngine:
         new._eng.telemetry = self._tele
         new.request_event_hook = self._event_hook
         new.fault_hook = old_hook
+        if self._tele.enabled:
+            new.span_hook = self._span_hook
         # the replacement's HBM attribution, through the adopted hub (its
         # own build snapshot went to the factory's disabled telemetry):
         # a degraded-mesh rebuild's changed per-chip footprint is visible
@@ -586,6 +641,7 @@ class ServingEngine:
             full = np.concatenate([
                 np.asarray(entry["prompt"], np.int32),
                 np.asarray(emitted, np.int32)]) if emitted else req.prompt
+            t0_replay = self._clock()
             try:
                 erid = new.submit(full, remaining, rid=entry["engine_rid"],
                                   gen_base=len(emitted))
@@ -597,6 +653,17 @@ class ServingEngine:
             staged[erid] = req.need_tokens
             req.recoveries += 1
             readmitted += 1
+            if req.trace_id is not None and self._spans.enabled:
+                # in-process recovery: the replay span parents on the
+                # request's root and becomes the parent of its post-
+                # recovery tick windows — the timeline shows recovery
+                # time as recovery, not mystery gap
+                sid = self._spans.emit(
+                    "recovery_replay", req.trace_id, t0_replay, self._clock(),
+                    parent_id=req.span_root,
+                    attrs={"gen_base": len(emitted),
+                           "engine_rid": int(erid)})
+                req.span_parent = sid
         # commit: the one multi-step mutation a scrape must never observe
         # half-done (the _ops_lock read/swap discipline)
         with self._ops_lock:
@@ -785,6 +852,9 @@ class ServingEngine:
             return
         with self._ops_lock:  # consistent with a concurrent statusz()
             self._draining = True
+        # drain_wait span clock zero: step() closes the span (under the
+        # replica's ops trace id) once the last in-flight stream retires
+        self._drain_t0 = self._clock() if self.has_work() else None
         if self._tele.enabled:
             self._tele.emit("serving_event", {
                 "event": "drain", "queue_depth": len(self._queue),
@@ -796,6 +866,7 @@ class ServingEngine:
             return
         with self._ops_lock:
             self._draining = False
+        self._drain_t0 = None  # drain aborted: no drain_wait span
         if self._tele.enabled:
             self._tele.emit("serving_event", {"event": "resume"})
 
@@ -861,6 +932,14 @@ class ServingEngine:
                 "block_ms_per_token": stats.get("block_ms_per_token"),
                 "recovery_generation": self._rebuild_count,
                 "breaker_open": self._breaker_open,
+                # speculative decode health: lifetime acceptance rate
+                # (accepted drafts / proposed drafts; None = speculation
+                # never ran) — mirrors the serve_spec_acceptance gauge
+                "spec_acceptance": stats.get("spec_acceptance"),
+                # committed (finished-request) tokens per tenant — the
+                # fair-share ledger behind the per-tenant
+                # serve_tenant_committed_tokens gauges
+                "tenant_committed_tokens": dict(self._tenant_tokens),
                 # queue residue: how much admitted-but-unfinished work
                 # this replica still owes. "draining with residue" means
                 # don't place here, but the work WILL finish; "breaker
@@ -1070,7 +1149,8 @@ class ServingEngine:
                 out.extend(snapshot_request(r) for r in list(self._queue))
         return out
 
-    def readmit(self, entry: dict, *, on_token=None) -> Admission:
+    def readmit(self, entry: dict, *, on_token=None,
+                parent_span: Optional[str] = None) -> Admission:
         """Re-admit a (possibly foreign) ``RecoveryLog`` entry onto THIS
         serving engine, resuming its stream mid-token: the handover
         re-prefills ``prompt + emitted`` and continues at
@@ -1117,6 +1197,10 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         req = self._entry_request(rid, entry, prompt, on_token, emitted)
+        if parent_span is not None:
+            # the router's migration span: the survivor-side admission
+            # span parents on it, bridging the replicas in one timeline
+            req.span_parent = parent_span
         self._requests[rid] = req
         try:
             if not self._queue and self._fits_now(need):
@@ -1149,6 +1233,11 @@ class ServingEngine:
         req.tokens.extend(emitted)
         req.engine_rid = entry.get("engine_rid")
         req.recoveries = 1
+        # trace identity rides the entry: survivor-side spans land on the
+        # ORIGINAL trace_id under the original root (None = sampled out)
+        req.trace_id = entry.get("trace_id")
+        req.span_root = entry.get("span_root")
+        req.span_parent = entry.get("span_parent")
         return req
 
     def release(self, rid: int) -> Optional[ServeRequest]:
@@ -1293,6 +1382,10 @@ class ServingEngine:
         self._rid_watermark = max(self._rid_watermark, req.engine_rid + 1)
         self._staged[req.engine_rid] = req.need_tokens
         self._running[req.engine_rid] = req
+        # spans BEFORE the recovery-log snapshot: the entry must carry
+        # span_root, or a migrated re-admission would mint a second root
+        # and the cross-replica timeline would fork
+        self._emit_admit_spans(req, now)
         self._recovery_log.admit(req)
         self.policy.on_admit(req, now)
         if self._tele.enabled:
@@ -1357,6 +1450,51 @@ class ServingEngine:
         reg.gauge("serve_queue_depth").set(len(self._queue))
         reg.gauge("serve_committed_tokens").set(self.committed_tokens())
 
+    # -- request-scoped tracing (docs/telemetry.md "Request tracing") ----
+    def _trace_scope(self) -> str:
+        """Trace-id prefix: the hub's replica tag when this engine serves
+        inside a fleet (``ReplicaTelemetry``), else empty. Serving rids
+        are per-replica counters, so the birth-replica prefix is what
+        keeps trace ids distinct in a shared fleet trace file."""
+        rep = getattr(self._tele, "replica", None)
+        return f"{rep}/" if rep is not None else ""
+
+    def _emit_admit_spans(self, req: ServeRequest, now: float):
+        """Queue + admission spans at handover. The queue (root) span is
+        emitted once per trace — original submit to FIRST handover, even
+        when that handover happens on a survivor replica after a
+        migration — and every handover adds an admission span that
+        becomes the parent the request's subsequent tick-window spans
+        hang off. A migrated re-admission's admission span parents on the
+        router's migration span (``req.span_parent`` pre-seeded by
+        ``readmit``), stitching the cross-replica bridge."""
+        if req.trace_id is None or not self._spans.enabled:
+            return
+        if req.span_root is None:
+            req.span_root = self._spans.emit(
+                "queue", req.trace_id, req.submit_t, now,
+                attrs={"request": req.rid, "priority": req.priority,
+                       "tenant": req.tenant})
+        parent = req.span_parent if req.span_parent is not None else req.span_root
+        sid = self._spans.emit(
+            "admission", req.trace_id, now, self._clock(), parent_id=parent,
+            attrs={"engine_rid": int(req.engine_rid),
+                   "gen_base": len(req.tokens),
+                   "prefix": req.prefix_id is not None})
+        req.span_parent = sid
+
+    def _span_hook(self, engine_rid: int, kind: str, t0: float, t1: float,
+                   attrs: Optional[dict] = None):
+        """Installed as the batching engine's ``span_hook`` (only when the
+        hub is live): attribute a retired tick window (prefill_chunk /
+        decode_window / spec_verify_round) to the owning request's trace,
+        parented on its latest admission/recovery_replay span."""
+        req = self._running.get(engine_rid)
+        if req is None or req.trace_id is None:
+            return
+        self._spans.emit(kind, req.trace_id, t0, t1,
+                         parent_id=req.span_parent, attrs=attrs)
+
     def _event_hook(self, engine_rid: int, event: dict) -> Optional[dict]:
         """Installed as the batching engine's ``request_event_hook``:
         enrich the per-request ``inference_request`` event with the
@@ -1384,6 +1522,10 @@ class ServingEngine:
             ttft if ttft is not None else (now - req.submit_t) * 1000.0, 3)
         event["priority"] = req.priority
         event["tenant"] = req.tenant
+        if req.trace_id is not None:
+            # joins the request summary to its span timeline: slo_blame
+            # and ds_trace_report --request pivot on this
+            event["trace_id"] = req.trace_id
         if req.recoveries:
             # the rebuilt engine only generated the post-outage remainder;
             # the client's stream is the full accumulated one — report

@@ -103,6 +103,18 @@ def snapshot_request(req) -> dict:
         "submit_t": float(req.submit_t),
         "prefix_id": (int(req.prefix_id)
                       if req.prefix_id is not None else None),
+        # request-scoped tracing identity (telemetry/spans.py): carried in
+        # the entry so a migrated request's survivor-side spans land on the
+        # SAME trace_id and stitch under the same root — one timeline
+        # across engine generations and replicas. All None when the
+        # request was sampled out (no spans anywhere).
+        "trace_id": (str(req.trace_id)
+                     if getattr(req, "trace_id", None) is not None else None),
+        "span_root": (str(req.span_root)
+                      if getattr(req, "span_root", None) is not None else None),
+        "span_parent": (str(req.span_parent)
+                        if getattr(req, "span_parent", None) is not None
+                        else None),
     }
 
 

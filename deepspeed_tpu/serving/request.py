@@ -88,6 +88,16 @@ class ServeRequest:
     # times this request was re-admitted onto a rebuilt engine (fault
     # tolerance; 0 = never touched by a recovery)
     recoveries: int = 0
+    # request-scoped tracing (telemetry/spans.py): trace_id is the span
+    # layer's request identity (None = sampled out, no spans emitted);
+    # span_root is the root queue span's id and span_parent the span the
+    # NEXT tick-window spans hang off (the latest admission /
+    # recovery_replay span). The recovery snapshot carries all three, so
+    # a migrated request's survivor-side spans stitch onto the same
+    # trace_id across replicas.
+    trace_id: Optional[str] = None
+    span_root: Optional[str] = None
+    span_parent: Optional[str] = None
 
     @property
     def need_tokens(self) -> int:

@@ -58,6 +58,7 @@ from deepspeed_tpu.serving.request import (
     Admission,
     ServeRequest,
 )
+from deepspeed_tpu.telemetry.spans import SpanEmitter
 
 # tick_stats fields that are ratios/identities, recomputed (not summed)
 # when aggregating across replicas
@@ -157,6 +158,10 @@ class FleetRouter:
             first = next(iter(self._replicas.values()))
             tele = first.serving._tele
             self._tele = getattr(tele, "_base", tele)
+        # migration-bridge spans go to the base hub untagged (the bridge
+        # is fleet-level, between replicas); only the main thread emits
+        # (the _place_entry call sites), honoring the probe discipline
+        self._spans = SpanEmitter(self._tele, clock=clock)
 
     # -- fleet lifecycle ------------------------------------------------
     def add(self, factory: Optional[Callable[[str], object]] = None) -> str:
@@ -319,14 +324,21 @@ class FleetRouter:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                priority: int = 0, tenant: str = "default",
                deadline_ms: Optional[float] = None,
-               on_token=None) -> Admission:
+               on_token=None, prefix_id: Optional[int] = None) -> Admission:
         """Fleet admission: one honest verdict from the best replica.
         Candidates (healthy, not backed off) are ranked by committed KV
         tokens; ``admission_outlook`` picks the first that would ADMIT,
         falling back to the first that would queue, falling back to the
         least-loaded one's real shed verdict (whose ``retry_after_s``
         hint also backs that replica off). The returned rid is
-        fleet-scoped."""
+        fleet-scoped.
+
+        ``prefix_id`` requires the factory to register prefixes
+        SYMMETRICALLY on every replica (same registration order -> same
+        serving-level id everywhere): placement may pick any replica, and
+        a migrated request's survivor resolves the same id — a replica
+        missing it falls back to the full-prompt prefill rather than
+        stranding the stream."""
         self._submitted += 1
         self._counter("fleet_submitted_total")
         if deadline_ms is None:   # degradation ladder: no-SLO traffic
@@ -359,7 +371,8 @@ class FleetRouter:
             chosen = cands[0]   # all would shed: least-loaded sheds honestly
         adm = chosen.serving.submit(
             prompt_ids, max_new_tokens, priority=priority, tenant=tenant,
-            deadline_ms=deadline_ms, on_token=on_token)
+            deadline_ms=deadline_ms, on_token=on_token,
+            prefix_id=prefix_id)
         if not adm:
             chosen.shed += 1
             self._shed += 1
@@ -521,11 +534,19 @@ class FleetRouter:
         (``rebalanced``, counted separately: nothing died)."""
         now = self._clock()
         cands = targets if targets is not None else self._candidates(now)
+        # migration-bridge span id, minted BEFORE the readmit so the
+        # survivor's admission span can parent on it — but EMITTED only
+        # after a successful placement (a failed sweep writes nothing, so
+        # the trace never holds a dangling bridge)
+        mig_span = (self._spans.new_span_id()
+                    if entry.get("trace_id") is not None
+                    and self._spans.enabled else None)
         for surv in cands:
             if surv is dead:
                 continue
             try:
-                adm = surv.serving.readmit(entry, on_token=on_token)
+                adm = surv.serving.readmit(entry, on_token=on_token,
+                                           parent_span=mig_span)
             except ValueError:
                 continue  # cannot ever fit here (budget/rid collision)
             if not adm:
@@ -539,6 +560,17 @@ class FleetRouter:
                 self._counter("fleet_migrated_total")
             else:
                 self._counter("fleet_rebalanced_total")
+            if mig_span is not None:
+                # the cross-replica stitch: parented on the request's
+                # root (emitted on its birth replica), tagged with both
+                # endpoints — one trace_id spans engine generations
+                self._spans.emit(
+                    "migration", entry["trace_id"], now, self._clock(),
+                    span_id=mig_span, parent_id=entry.get("span_root"),
+                    attrs={"event": event,
+                           "from_replica": dead.replica_id,
+                           "to_replica": surv.replica_id,
+                           "gen_base": len(entry.get("emitted", []))})
             self._event({
                 "event": event, "request": frid,
                 "from_replica": dead.replica_id,

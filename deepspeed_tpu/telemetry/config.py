@@ -45,6 +45,12 @@ class TelemetryConfig:
     profile_start_step: int = 0
     profile_num_steps: int = 1
     profile_dir: str = ""
+    # size bound (bytes) on the JSONL trace file: 0 = unbounded (the
+    # historical behavior); > 0 rotates the file to <trace_file>.1 once a
+    # flushed write reaches the bound (one rotated generation is kept, so
+    # disk stays <= ~2x the bound) and counts each rotation in the
+    # trace_rotations counter. Soak runs set this; short runs never hit it.
+    max_trace_bytes: int = 0
     # per-device HBM capacity override (bytes) for the hbm_headroom_bytes
     # gauge and memory_snapshot events. 0 = use the backend allocator's
     # bytes_limit when it reports one (TPU), else headroom is unknown

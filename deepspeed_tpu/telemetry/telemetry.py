@@ -59,7 +59,8 @@ class Telemetry:
             import jax
 
             if jax.process_index() == 0:
-                self._writer = TraceWriter(self.cfg.trace_file)
+                self._writer = TraceWriter(self.cfg.trace_file,
+                                           max_bytes=self.cfg.max_trace_bytes)
 
     # ------------------------------------------------------------------
     def span(self, name: str, labels: Optional[dict] = None):
@@ -79,7 +80,11 @@ class Telemetry:
             self.registry.histogram(f"{kind}.{field}").observe(value)
         if self._writer is not None:
             try:
+                rotations_before = self._writer.rotations
                 self._writer.write(kind, event)
+                if self._writer.rotations != rotations_before:
+                    self.registry.counter("trace_rotations").inc(
+                        self._writer.rotations - rotations_before)
             except OSError as e:  # telemetry must never kill the step loop
                 # a transient disk hiccup must not permanently blind the
                 # trace: count it, warn ONCE (not per event), and drop the

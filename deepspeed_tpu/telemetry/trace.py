@@ -28,10 +28,20 @@ def _json_default(obj):
 class TraceWriter:
     """Append-only JSONL writer; the file opens lazily on the first event
     (so a constructed-but-never-used writer creates nothing) and each line
-    is flushed — a crashed run keeps every completed event."""
+    is flushed — a crashed run keeps every completed event.
 
-    def __init__(self, path: str):
+    ``max_bytes`` > 0 size-bounds the file: once a completed write
+    reaches the limit the file rotates to ``<path>.1`` (one generation —
+    the previous ``.1`` is replaced, so disk use stays <= ~2x the bound)
+    and the next event lazily reopens a fresh file. ``rotations`` counts
+    rotations for the hub's ``trace_rotations`` counter. Rotation happens
+    AFTER the triggering line is flushed, so no event is ever torn across
+    files."""
+
+    def __init__(self, path: str, max_bytes: int = 0):
         self.path = path
+        self.max_bytes = int(max_bytes or 0)
+        self.rotations = 0
         self._fh = None
 
     def write(self, kind: str, payload: dict):
@@ -43,7 +53,15 @@ class TraceWriter:
             self._fh = open(self.path, "a")
         self._fh.write(json.dumps(event, default=_json_default) + "\n")
         self._fh.flush()
+        if self.max_bytes > 0 and self._fh.tell() >= self.max_bytes:
+            self._rotate()
         return event
+
+    def _rotate(self):
+        self._fh.close()
+        self._fh = None
+        os.replace(self.path, self.path + ".1")
+        self.rotations += 1
 
     def flush(self):
         if self._fh is not None:

@@ -10,6 +10,7 @@ import re
 
 from deepspeed_tpu.analysis import event_schemas
 from deepspeed_tpu.analysis.core import iter_python_files
+from deepspeed_tpu.analysis.rules import telemetry_schema
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
@@ -34,15 +35,19 @@ def test_field_types_expand_number_and_alternatives():
 
 def _emit_kinds_in_package():
     """Every string-literal kind passed to a telemetry hub .emit() in the
-    package source."""
+    package source — using the SAME receiver discrimination as the
+    telemetry-schema lint rule (``tele``/``_tele``/``telemetry`` terminal
+    names), so span-kind strings passed to ``SpanEmitter.emit`` (a
+    different first-argument vocabulary, enumerated in
+    ``timeline.SPAN_KINDS``) are not mistaken for event kinds."""
     kinds = set()
     for path in iter_python_files([PACKAGE]):
         with open(path, "r", encoding="utf-8") as fh:
             tree = ast.parse(fh.read(), filename=path)
         for node in ast.walk(tree):
             if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "emit" and node.args):
+                    and telemetry_schema._is_hub_emit(node)
+                    and node.args):
                 continue
             kind = node.args[0]
             if isinstance(kind, ast.Constant) and isinstance(kind.value, str):

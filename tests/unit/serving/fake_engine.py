@@ -8,6 +8,7 @@ i)`` gives the real engine. So ``submit(rid=, gen_base=)`` resume, rid
 partitioning, and cross-replica migration are all testable for
 bitwise identity in milliseconds, no jax import anywhere."""
 
+import time
 from collections import deque
 from types import SimpleNamespace
 
@@ -27,7 +28,7 @@ class FakeEngine:
     the engine poisoned (the unrecoverable shape)."""
 
     def __init__(self, vocab_size: int = 101, cache_len: int = 64,
-                 slots: int = 4):
+                 slots: int = 4, clock=time.monotonic):
         self.cfg = SimpleNamespace(vocab_size=vocab_size,
                                    max_seq_len=cache_len)
         self.cache_len = cache_len
@@ -37,6 +38,19 @@ class FakeEngine:
         self.poisoned = False
         self.fault_hook = None
         self.request_event_hook = None
+        # request tracing, mirroring the real engine: the serving layer
+        # installs span_hook when its hub is live; each tick then reports
+        # one window span per live request (prefill_chunk on the
+        # admission tick, spec_verify_round under spec_gamma > 0, else
+        # decode_window). ``clock`` should be the same injected clock the
+        # ServingEngine runs on, so span times share its domain.
+        self.span_hook = None
+        self.clock = clock
+        # spec accounting knob: gamma > 0 emulates speculative ticks —
+        # the TOKEN STREAM is unchanged (still one token/request/tick, so
+        # bitwise-resume invariants hold); only drafted/accepted
+        # accounting and span kinds change
+        self.spec_gamma = 0
         self.fail_next_step = 0        # clean failures to raise
         self.poison_next_step = False  # poison on the next tick
         self._eng = SimpleNamespace(telemetry=_DisabledTelemetry())
@@ -48,7 +62,8 @@ class FakeEngine:
         self._tick_index = 0
         self._stats = {"ticks": 0, "steps": 0, "dispatch_ms": 0.0,
                        "block_ms": 0.0, "tokens": 0, "wasted": 0,
-                       "capacity_tokens": 0}
+                       "capacity_tokens": 0, "spec_drafted": 0,
+                       "spec_accepted": 0}
         self._prefixes = {}
         self._next_pid = 0
 
@@ -118,17 +133,39 @@ class FakeEngine:
         still = []
         for req in self._pending:
             if len(self._active) < self.slots:
+                req["fresh"] = True  # first tick prefills
                 self._active[req["rid"]] = req
             else:
                 still.append(req)
         self._pending = still
         out = {}
         finished = []
+        span_t0 = self.clock() if self.span_hook is not None else 0.0
+        g = self.spec_gamma
         for rid, req in self._active.items():
             idx = req["gen_base"] + len(req["emitted"])
             tok = fake_token(rid, idx, self.cfg.vocab_size)
             req["emitted"].append(tok)
             out[rid] = [tok]
+            if g:
+                # deterministic acceptance pattern: varies per (rid,
+                # tick) so acceptance-rate math has real structure
+                accepted = (rid + idx) % (g + 1)
+                self._stats["spec_drafted"] += g
+                self._stats["spec_accepted"] += accepted
+                req["spec_drafted"] = req.get("spec_drafted", 0) + g
+                req["spec_accepted"] = req.get("spec_accepted", 0) + accepted
+            if self.span_hook is not None:
+                if req.pop("fresh", False):
+                    kind, attrs = "prefill_chunk", {
+                        "ticks": 1, "tokens": int(req["prompt"].size)}
+                elif g:
+                    kind, attrs = "spec_verify_round", {
+                        "ticks": 1, "tokens": 1,
+                        "drafted": g, "accepted": accepted}
+                else:
+                    kind, attrs = "decode_window", {"ticks": 1, "tokens": 1}
+                self.span_hook(rid, kind, span_t0, self.clock(), attrs)
             if len(req["emitted"]) + req["gen_base"] >= req["max_new"] \
                     + req["gen_base"] and \
                     len(req["emitted"]) >= req["max_new"]:
@@ -183,6 +220,8 @@ class FakeEngine:
         host = s["dispatch_ms"] + s["block_ms"]
         s["overlap_frac"] = (round(1.0 - s["block_ms"] / host, 4)
                              if host > 0 else None)
+        s["spec_acceptance"] = (round(s["spec_accepted"] / s["spec_drafted"], 4)
+                                if s["spec_drafted"] else None)
         return s
 
     def hbm_components(self) -> dict:

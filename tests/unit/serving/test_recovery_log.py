@@ -49,6 +49,9 @@ class TestSnapshotRequest:
             "emitted": [9, 10], "max_new_tokens": 8, "priority": 2,
             "tenant": "a", "deadline_ms": 500.0, "submit_t": 1.5,
             "prefix_id": None,
+            # tracing identity rides the entry (None = sampled out /
+            # submitted before any handover set the root)
+            "trace_id": None, "span_root": None, "span_parent": None,
         }
         # JSON-serializable as-is (no numpy scalars leak through)
         json.dumps(entry)

@@ -1,0 +1,350 @@
+"""Request-scoped tracing through the serving stack — jax-free
+(FakeEngine), part of the fast pre-tier-1 CI stage
+(tools/ci_jaxfree_tests.py).
+
+The acceptance shape (ISSUE 16): a request using a shared prefix and
+speculative verify rounds, migrated mid-stream by a replica kill and
+finishing on the survivor, must reconstruct as ONE contiguous timeline —
+a single trace_id, a single root (the queue span), zero orphans, and a
+``migration`` span bridging the two replica tags. The FakeClock only
+advances between steps, so within-tick spans are zero-duration:
+"contiguous" is asserted as tree connectivity (every span reaches the
+root via parent links), not as wall-clock gap analysis.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from fake_engine import FakeEngine, fake_token  # noqa: E402
+
+from deepspeed_tpu.serving import RecoveryConfig
+from deepspeed_tpu.serving.engine import ServingEngine
+from deepspeed_tpu.serving.fleet import attach_replica_telemetry
+from deepspeed_tpu.serving.router import FleetRouter
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.timeline import build_timelines
+
+VOCAB = 997
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class HubStub:
+    """Minimal enabled telemetry hub: captures events, shares a registry."""
+
+    def __init__(self):
+        self.enabled = True
+        self.registry = MetricsRegistry()
+        self.events = []
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+    def close(self):
+        pass
+
+    def spans(self):
+        """Captured span events re-shaped as trace lines (the hub writes
+        ``{"kind": kind, **payload}`` per line; the stub keeps them
+        split), ready for ``build_timelines``."""
+        return [dict(p, kind="span") for k, p in self.events if k == "span"]
+
+
+def expected(erid, n, start=0):
+    return [fake_token(erid, i, VOCAB) for i in range(start, start + n)]
+
+
+def run_fleet(router, clock, max_ticks=300, dt=0.01):
+    n = 0
+    while router.has_work():
+        assert n < max_ticks, "fleet did not converge"
+        router.step()
+        clock.advance(dt)
+        n += 1
+    return n
+
+
+def run_serving(srv, clock, max_ticks=300, dt=0.01):
+    n = 0
+    while srv.has_work():
+        assert n < max_ticks, "serving did not drain"
+        clock.advance(dt)
+        srv.step()
+        n += 1
+    return n
+
+
+def make_traced_fleet(hub, clock, *, replicas=2, slots=2, spec_gamma=0,
+                      prefix=None, span_sampler=None):
+    """A fleet whose replicas share one hub through ReplicaTelemetry
+    facades, with request tracing live on every replica. ``prefix`` is
+    registered SYMMETRICALLY (same order -> same serving-level id 0 on
+    every replica), the contract ``FleetRouter.submit(prefix_id=)``
+    documents for migration-safe shared prefixes."""
+
+    def factory(replica_id):
+        eng = FakeEngine(vocab_size=VOCAB, cache_len=64, slots=slots,
+                         clock=clock)
+        eng.spec_gamma = spec_gamma
+        attach_replica_telemetry(eng, hub, replica_id)
+        srv = ServingEngine(eng, clock=clock, span_sampler=span_sampler)
+        if prefix is not None:
+            srv.register_prefix(prefix)
+        return srv
+
+    return FleetRouter(factory, replicas=replicas, clock=clock,
+                       telemetry=hub)
+
+
+def one_timeline(hub):
+    tls = build_timelines(hub.spans())
+    assert len(tls) == 1, f"expected one trace, got {sorted(tls)}"
+    return next(iter(tls.values()))
+
+
+class TestFleetTimeline:
+    def test_migrated_spec_prefix_request_is_one_contiguous_timeline(self):
+        """THE acceptance test: shared prefix + spec verify rounds +
+        replica kill mid-stream; the survivor finishes the stream
+        bitwise and the trace reconstructs as one connected tree."""
+        clock = FakeClock()
+        hub = HubStub()
+        prefix = np.arange(1, 9, dtype=np.int32)     # 8 shared tokens
+        router = make_traced_fleet(hub, clock, spec_gamma=2, prefix=prefix)
+        adm = router.submit(np.asarray([21, 22], np.int32),
+                            max_new_tokens=8, prefix_id=0)
+        assert adm
+        for _ in range(3):                           # ~3 tokens on r0
+            router.step()
+            clock.advance(0.01)
+        router.kill("r0")                            # chaos: birth replica dies
+        run_fleet(router, clock)
+
+        # stream correctness first: migration was lossless and bitwise
+        # (first placement on r0 pinned engine rid 0)
+        res = router.result(adm.rid)
+        np.testing.assert_array_equal(
+            res[:10], np.concatenate([prefix, [21, 22]]))
+        assert list(res[10:]) == expected(0, 8)
+
+        tl = one_timeline(hub)
+        assert tl.trace_id == "r0/0"                 # birth replica + rid
+        # ONE contiguous timeline: a single root, zero orphans, every
+        # span connected to the root through parent links
+        assert tl.orphans == []
+        roots = [s for s in tl.spans if s.parent_id is None]
+        assert len(roots) == 1 and roots[0].kind == "queue"
+        assert all(tl.depth(s) > 0 for s in tl.spans if s is not roots[0])
+        # the request touched both replicas, bridged by a migration span
+        assert tl.replicas == ["r0", "r1"]
+        mig = [s for s in tl.spans if s.kind == "migration"]
+        assert len(mig) == 1
+        assert mig[0].parent_id == roots[0].span_id
+        assert mig[0].attrs["from_replica"] == "r0"
+        assert mig[0].attrs["to_replica"] == "r1"
+        assert mig[0].attrs["gen_base"] >= 1         # moved mid-stream
+        assert mig[0].replica is None                # fleet-level, untagged
+        # two admissions: birth (parents on the root) and survivor
+        # (parents on the migration bridge)
+        adms = [s for s in tl.spans if s.kind == "admission"]
+        assert len(adms) == 2
+        by_t = sorted(adms, key=lambda s: s.t0)
+        assert by_t[0].parent_id == roots[0].span_id
+        assert by_t[0].replica == "r0" and by_t[0].attrs["prefix"] is True
+        assert by_t[1].parent_id == mig[0].span_id
+        assert by_t[1].replica == "r1" and by_t[1].attrs["gen_base"] >= 1
+        # tick windows: a prefill on each placement, spec verify rounds
+        # (gamma=2) for the decode ticks, each under its side's admission
+        kinds = {s.kind for s in tl.spans}
+        assert {"queue", "admission", "prefill_chunk", "spec_verify_round",
+                "migration"} <= kinds
+        for s in tl.spans:
+            if s.kind == "prefill_chunk":
+                assert s.parent_id in {a.span_id for a in adms}
+            if s.kind == "spec_verify_round":
+                assert s.attrs["drafted"] == 2
+                assert 0 <= s.attrs["accepted"] <= 2
+        # survivor-side windows exist: the timeline really continues
+        # past the migration on r1
+        assert any(s.replica == "r1" for s in tl.spans
+                   if s.kind in ("prefill_chunk", "spec_verify_round"))
+        # the finished inference_request event carries the trace id, the
+        # join key ds_trace_report --request / --blame uses
+        reqs = hub.of_kind("inference_request") if hasattr(hub, "of_kind") \
+            else [p for k, p in hub.events if k == "inference_request"]
+        assert len(reqs) == 1
+        assert reqs[0]["trace_id"] == "r0/0"
+        assert reqs[0]["replica"] == "r1"            # finished on the survivor
+
+    def test_queue_root_emitted_once_across_migration(self):
+        """The queue (root) span belongs to the ORIGINAL submit: a
+        migrated re-admission must not mint a second root."""
+        clock = FakeClock()
+        hub = HubStub()
+        router = make_traced_fleet(hub, clock)
+        adm = router.submit(np.arange(1, 5, dtype=np.int32),
+                            max_new_tokens=8)
+        assert adm
+        for _ in range(2):
+            router.step()
+            clock.advance(0.01)
+        router.kill("r0")
+        run_fleet(router, clock)
+        tl = one_timeline(hub)
+        assert sum(1 for s in tl.spans if s.kind == "queue") == 1
+        assert tl.orphans == []
+
+    def test_sampled_out_request_emits_no_spans(self):
+        """span_sampler=False: the request still serves (counters and
+        events untouched) but writes zero span lines — the overhead
+        knob for high-QPS fleets."""
+        clock = FakeClock()
+        hub = HubStub()
+        router = make_traced_fleet(hub, clock,
+                                   span_sampler=lambda rid: False)
+        adm = router.submit(np.arange(1, 5, dtype=np.int32),
+                            max_new_tokens=6)
+        assert adm
+        run_fleet(router, clock)
+        assert list(router.result(adm.rid)[4:]) == expected(0, 6)
+        assert hub.spans() == []
+        # the lifecycle still counted: sampling never bends the metrics
+        reqs = [p for k, p in hub.events if k == "inference_request"]
+        assert len(reqs) == 1 and "trace_id" not in reqs[0]
+
+
+class TestInProcessRecoverySpans:
+    def _traced_serving(self, clock, hub, **kw):
+        eng = FakeEngine(vocab_size=VOCAB, cache_len=64, slots=2,
+                         clock=clock)
+        eng._eng.telemetry = hub
+        return eng, ServingEngine(eng, clock=clock, **kw)
+
+    def test_recovery_replay_span_reparents_post_rebuild_windows(self):
+        """A poisoned tick triggers the in-process rebuild ladder; the
+        timeline shows a recovery_replay span parented on the root, and
+        the replacement engine's tick windows parent on the replay span
+        — recovery time attributed as recovery, not mystery gap."""
+        clock = FakeClock()
+        hub = HubStub()
+        eng, srv = self._traced_serving(
+            clock, hub,
+            engine_factory=lambda mesh_shape=None: FakeEngine(
+                vocab_size=VOCAB, cache_len=64, slots=2, clock=clock),
+            recovery=RecoveryConfig(backoff_s=0.0),
+            sleep=lambda s: None)
+        adm = srv.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=8)
+        assert adm
+        for _ in range(3):
+            clock.advance(0.01)
+            srv.step()
+        eng.poison_next_step = True
+        run_serving(srv, clock)
+        req = srv.reap()[adm.rid]
+        assert req.state == "finished"
+        assert list(req.tokens) == expected(0, 8)
+        assert srv.recovery_stats()["rebuilds"] == 1
+
+        tl = one_timeline(hub)
+        assert tl.trace_id == "0"        # no replica facade: bare rid
+        assert tl.orphans == []
+        roots = [s for s in tl.spans if s.parent_id is None]
+        assert len(roots) == 1 and roots[0].kind == "queue"
+        replays = [s for s in tl.spans if s.kind == "recovery_replay"]
+        assert len(replays) == 1
+        assert replays[0].parent_id == roots[0].span_id
+        assert replays[0].attrs["gen_base"] == 3
+        # windows split around the rebuild: pre-fault ones under the
+        # admission span, post-rebuild ones under the replay span
+        adm_span = next(s for s in tl.spans if s.kind == "admission")
+        pre = [s for s in tl.spans if s.parent_id == adm_span.span_id
+               and s.kind in ("prefill_chunk", "decode_window")]
+        post = [s for s in tl.spans if s.parent_id == replays[0].span_id]
+        assert pre and post
+        assert all(s.kind in ("prefill_chunk", "decode_window")
+                   for s in post)
+        # the replacement's re-prefill (prompt + emitted) opens the
+        # post-recovery chain
+        assert post[0].kind == "prefill_chunk"
+
+    def test_drain_wait_span_closes_when_dry(self):
+        """drain() under in-flight work emits one ops-scoped drain_wait
+        span once the last stream retires."""
+        clock = FakeClock()
+        hub = HubStub()
+        _, srv = self._traced_serving(clock, hub)
+        srv.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=5)
+        clock.advance(0.01)
+        srv.step()
+        srv.drain()
+        run_serving(srv, clock)
+        waits = [p for k, p in hub.events
+                 if k == "span" and p["span"] == "drain_wait"]
+        assert len(waits) == 1
+        assert waits[0]["trace_id"] == "ops"
+        assert waits[0]["dur_ms"] > 0
+        # an idle drain (nothing in flight) emits nothing
+        srv.resume()
+        srv.drain()
+        srv.step()
+        assert len([p for k, p in hub.events
+                    if k == "span" and p["span"] == "drain_wait"]) == 1
+
+
+class TestSpecAndTenantStatusz:
+    def test_statusz_and_gauges_for_spec_and_tenants(self):
+        """Satellite 3: /statusz surfaces live spec acceptance and the
+        per-tenant committed-token ledger, mirrored as Prometheus
+        gauges."""
+        clock = FakeClock()
+        hub = HubStub()
+        eng = FakeEngine(vocab_size=VOCAB, cache_len=64, slots=4,
+                         clock=clock)
+        eng.spec_gamma = 2
+        eng._eng.telemetry = hub
+        srv = ServingEngine(eng, clock=clock)
+        a = srv.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=6,
+                       tenant="alpha")
+        b = srv.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=4,
+                       tenant="beta")
+        assert a and b
+        run_serving(srv, clock)
+        st = srv.statusz()
+        # lifetime acceptance: drafted 2/tick, accepted (rid+idx) % 3
+        stats = eng.tick_stats()
+        assert st["spec_acceptance"] == stats["spec_acceptance"]
+        assert 0.0 < st["spec_acceptance"] < 1.0
+        assert st["tenant_committed_tokens"] == {"alpha": 6, "beta": 4}
+        gauges = hub.registry.dump()["gauges"]
+        assert gauges["serve_spec_acceptance"] == st["spec_acceptance"]
+        assert gauges["serve_tenant_committed_tokens{tenant=alpha}"] == 6
+        assert gauges["serve_tenant_committed_tokens{tenant=beta}"] == 4
+
+    def test_spec_acceptance_none_when_speculation_off(self):
+        clock = FakeClock()
+        hub = HubStub()
+        eng = FakeEngine(vocab_size=VOCAB, cache_len=64, slots=2,
+                         clock=clock)
+        eng._eng.telemetry = hub
+        srv = ServingEngine(eng, clock=clock)
+        srv.submit(np.arange(1, 4, dtype=np.int32), max_new_tokens=3)
+        run_serving(srv, clock)
+        assert srv.statusz()["spec_acceptance"] is None
+        assert "serve_spec_acceptance" not in hub.registry.dump()["gauges"]
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,379 @@
+"""Span model + timeline reconstruction — jax-free by design (part of
+the tools/ci_jaxfree_tests.py stage): ``telemetry/timeline.py`` is the
+stdlib-only read side, ``telemetry/spans.py`` the write side, and the
+two must agree on the span-kind tables, the causality rules, and the
+Chrome-trace export format documented in docs/telemetry.md."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry.spans import SpanEmitter, make_trace_sampler
+from deepspeed_tpu.telemetry.timeline import (
+    SPAN_CATEGORY,
+    SPAN_KINDS,
+    Timeline,
+    build_timelines,
+    slo_blame,
+    spans_of,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+from deepspeed_tpu.telemetry.trace import TraceWriter, read_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+TIMELINE_CLI = os.path.join(REPO, "tools", "ds_trace_timeline.py")
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "mini_trace.jsonl")
+
+
+def mk(kind, tid, sid, t0, t1, parent=None, replica=None, attrs=None):
+    ev = {"schema": 1, "kind": "span", "ts": 0.0, "span": kind,
+          "trace_id": tid, "span_id": sid, "t0": t0, "t1": t1,
+          "dur_ms": (t1 - t0) * 1000.0}
+    if parent is not None:
+        ev["parent_id"] = parent
+    if replica is not None:
+        ev["replica"] = replica
+    if attrs:
+        ev["attrs"] = attrs
+    return ev
+
+
+class HubStub:
+    def __init__(self, enabled=True):
+        self.enabled = enabled
+        self.events = []
+
+    def emit(self, kind, payload, **kw):
+        self.events.append((kind, dict(payload)))
+
+
+# ---------------------------------------------------------------------------
+# the span model: kinds, emitter, sampler
+# ---------------------------------------------------------------------------
+
+def test_every_kind_has_a_category():
+    assert set(SPAN_KINDS) == set(SPAN_CATEGORY)
+    assert set(SPAN_CATEGORY.values()) == {"queue", "compute", "recovery"}
+
+
+def test_span_emitter_payload_and_ids():
+    hub = HubStub()
+    em = SpanEmitter(hub, clock=lambda: 0.0)
+    sid = em.emit("queue", "r0/1", 1.0, 1.25,
+                  attrs={"request": 1, "tenant": "a"})
+    assert sid is not None
+    kind, p = hub.events[0]
+    assert kind == "span" and p["span"] == "queue"
+    assert p["trace_id"] == "r0/1" and p["span_id"] == sid
+    assert p["t0"] == 1.0 and p["t1"] == 1.25
+    assert p["dur_ms"] == pytest.approx(250.0)
+    assert "parent_id" not in p and p["attrs"] == {"request": 1, "tenant": "a"}
+    # parent threading, explicit ids (the migration-bridge pattern), and
+    # t1 < t0 clamping to a zero-length span
+    child = em.emit("admission", "r0/1", 1.25, 1.2, parent_id=sid)
+    _, p2 = hub.events[1]
+    assert p2["parent_id"] == sid and p2["t1"] == p2["t0"] == 1.25
+    pre = em.new_span_id()
+    assert em.emit("migration", "r0/1", 1.3, 1.4, span_id=pre) == pre
+    assert child != sid != pre
+
+
+def test_span_emitter_inert_paths():
+    hub = HubStub()
+    em = SpanEmitter(hub)
+    # sampled-out request (trace_id None) and disabled/None hubs no-op
+    assert em.emit("queue", None, 0.0, 1.0) is None
+    assert SpanEmitter(HubStub(enabled=False)).emit("queue", "t", 0, 1) is None
+    assert SpanEmitter(None).emit("queue", "t", 0, 1) is None
+    assert not SpanEmitter(None).enabled and em.enabled
+    assert hub.events == []
+    # unknown kinds are a programming error, loudly
+    with pytest.raises(ValueError, match="unknown span kind"):
+        em.emit("made_up_kind", "t", 0.0, 1.0)
+    # rebind adopts a live hub without resetting the id scope
+    dead = SpanEmitter(None)
+    before = dead.new_span_id()
+    dead.rebind(hub)
+    assert dead.enabled
+    after = dead.emit("queue", "t", 0.0, 1.0)
+    assert after.split("-")[0] == before.split("-")[0]
+
+
+def test_two_emitters_never_collide():
+    hub = HubStub()
+    a, b = SpanEmitter(hub), SpanEmitter(hub)
+    ids = {a.emit("queue", "t", 0, 1), b.emit("queue", "t", 0, 1),
+           a.new_span_id(), b.new_span_id()}
+    assert len(ids) == 4
+
+
+def test_trace_sampler_deterministic_and_proportional():
+    s = make_trace_sampler(0.5, seed=7)
+    picks = [s(rid) for rid in range(2000)]
+    assert picks == [s(rid) for rid in range(2000)]          # stable
+    assert picks == [make_trace_sampler(0.5, seed=7)(r)      # pure in seed
+                     for r in range(2000)]
+    frac = sum(picks) / len(picks)
+    assert 0.4 < frac < 0.6
+    assert picks != [make_trace_sampler(0.5, seed=8)(r) for r in range(2000)]
+    assert all(make_trace_sampler(1.0)(r) for r in range(50))
+    assert not any(make_trace_sampler(0.0)(r) for r in range(50))
+
+
+# ---------------------------------------------------------------------------
+# reconstruction: orphans, migration stitch, critical path
+# ---------------------------------------------------------------------------
+
+def test_orphan_detection():
+    clean = build_timelines([
+        mk("queue", "t", "a", 0.0, 1.0),
+        mk("admission", "t", "b", 1.0, 2.0, parent="a"),
+    ])["t"]
+    assert clean.orphans == [] and [s.span_id for s in clean.roots] == ["a"]
+    torn = build_timelines([
+        mk("queue", "t", "a", 0.0, 1.0),
+        mk("admission", "t", "b", 1.0, 2.0, parent="MISSING"),
+    ])["t"]
+    assert [s.span_id for s in torn.orphans] == ["b"]
+
+
+def test_migration_stitch_is_one_timeline():
+    """The acceptance shape: birth on r0, migration bridge, survivor
+    spans on r1 — ONE trace_id, zero orphans, the bridge's parent is the
+    birth-replica root and the survivor admission hangs off the bridge."""
+    tls = build_timelines([
+        mk("queue", "r0/5", "q", 0.0, 1.0, replica="r0"),
+        mk("admission", "r0/5", "a0", 1.0, 1.2, parent="q", replica="r0"),
+        mk("decode_window", "r0/5", "w0", 1.2, 2.0, parent="a0",
+           replica="r0"),
+        mk("migration", "r0/5", "m", 2.0, 2.5, parent="q",
+           attrs={"from_replica": "r0", "to_replica": "r1"}),
+        mk("admission", "r0/5", "a1", 2.5, 2.7, parent="m", replica="r1"),
+        mk("decode_window", "r0/5", "w1", 2.7, 4.0, parent="a1",
+           replica="r1"),
+    ])
+    assert list(tls) == ["r0/5"]
+    tl = tls["r0/5"]
+    assert tl.orphans == []
+    assert tl.replicas == ["r0", "r1"]          # first-seen order
+    assert tl.depth(tl.by_id["w1"]) == 3        # q -> m -> a1 -> w1
+    assert [c.span_id for c in tl.children("m")] == ["a1"]
+    assert tl.duration_ms == pytest.approx(4000.0)
+
+
+def test_critical_path_charges_deepest_and_sums_exactly():
+    tl = Timeline("t", spans_of([
+        mk("queue", "t", "q", 0.0, 10.0),
+        mk("admission", "t", "a", 2.0, 8.0, parent="q"),
+        mk("decode_window", "t", "w", 3.0, 6.0, parent="a"),
+    ]))
+    path = tl.critical_path()
+    # [0,2] queue, [2,3] admission, [3,6] decode (deepest), [6,8]
+    # admission again, [8,10] queue
+    assert path == {"queue": pytest.approx(4000.0),
+                    "admission": pytest.approx(3000.0),
+                    "decode_window": pytest.approx(3000.0)}
+    assert sum(path.values()) == pytest.approx(tl.duration_ms)
+    assert tl.dominant_kind() == "queue"
+    assert tl.attribution() == {"queue": pytest.approx(4000.0),
+                                "compute": pytest.approx(6000.0)}
+
+
+def test_critical_path_gap_and_tiebreak():
+    tl = Timeline("t", spans_of([
+        mk("queue", "t", "q", 0.0, 1.0),
+        mk("recovery_replay", "t", "r", 3.0, 4.0, parent="q"),
+    ]))
+    path = tl.critical_path()
+    assert path["gap"] == pytest.approx(2000.0)     # [1,3] uncovered
+    assert tl.attribution()["recovery"] == pytest.approx(1000.0)
+    # siblings at equal depth: the later-starting (most specific) wins
+    tie = Timeline("t", spans_of([
+        mk("prefill_chunk", "t", "p", 0.0, 2.0),
+        mk("decode_window", "t", "d", 1.0, 2.0),
+    ]))
+    assert tie.critical_path() == {"prefill_chunk": pytest.approx(1000.0),
+                                   "decode_window": pytest.approx(1000.0)}
+
+
+def test_slo_blame_joins_requests_to_timelines():
+    events = [
+        mk("queue", "r0/1", "q", 0.0, 9.0),
+        mk("decode_window", "r0/1", "w", 9.0, 10.0, parent="q"),
+        {"kind": "inference_request", "path": "serving", "request": 1,
+         "trace_id": "r0/1", "deadline_met": False, "deadline_ms": 5.0,
+         "ttft_ms": 9100.0, "queue_ms": 9000.0, "tenant": "a"},
+        {"kind": "inference_request", "path": "serving", "request": 2,
+         "deadline_met": True, "ttft_ms": 1.0},    # met: not blamed
+        {"kind": "inference_request", "path": "serving", "request": 3,
+         "deadline_met": False, "ttft_ms": 2.0},   # missed, unsampled
+    ]
+    rows = slo_blame(events)
+    assert [r["request"] for r in rows] == [1, 3]  # worst ttft first
+    assert rows[0]["dominant"] == "queue"
+    assert rows[0]["attribution"]["queue"] == pytest.approx(9000.0)
+    assert rows[1]["dominant"] is None and rows[1]["trace_id"] is None
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace / Perfetto export (golden format)
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_golden_format():
+    tls = build_timelines([
+        mk("queue", "r0/5", "q", 100.0, 100.5, replica="r0"),
+        mk("migration", "r0/5", "m", 100.5, 100.6, parent="q"),
+        mk("decode_window", "r0/5", "w", 100.6, 101.0, parent="m",
+           replica="r1", attrs={"ticks": 4, "tokens": 4}),
+        mk("queue", "r1/7", "q2", 100.2, 100.9, replica="r1"),
+    ])
+    doc = to_chrome_trace(tls)
+    assert validate_chrome_trace(doc) == []
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # one process lane per replica plus pid 0 for unscoped spans, one
+    # thread lane per trace_id
+    procs = {e["args"]["name"]: e["pid"] for e in meta
+             if e["name"] == "process_name"}
+    assert procs == {"unscoped": 0, "r0": 1, "r1": 2}
+    threads = {e["args"]["name"] for e in meta if e["name"] == "thread_name"}
+    assert threads == {"trace r0/5", "trace r1/7"}
+    # timestamps rebase to the earliest span, in microseconds
+    by_name = {e["args"]["span_id"]: e for e in xs}
+    assert by_name["q"]["ts"] == 0.0
+    assert by_name["q"]["dur"] == pytest.approx(500_000.0)
+    assert by_name["w"]["ts"] == pytest.approx(600_000.0)
+    # the migrated request keeps ONE tid while crossing pids
+    assert by_name["q"]["tid"] == by_name["w"]["tid"]
+    assert by_name["q"]["pid"] == 1 and by_name["w"]["pid"] == 2
+    assert by_name["m"]["pid"] == 0
+    assert by_name["w"]["cat"] == "compute"
+    assert by_name["w"]["args"]["tokens"] == 4
+    assert by_name["m"]["args"]["parent_id"] == "q"
+    # and the whole document survives a JSON round-trip
+    assert validate_chrome_trace(json.loads(json.dumps(doc))) == []
+
+
+def test_validate_chrome_trace_rejects_garbage():
+    assert validate_chrome_trace({"foo": 1}) != []
+    bad = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 0, "tid": 1, "ts": -5.0, "dur": 1.0},
+        {"ph": "Z", "name": "b", "pid": 0, "tid": 1},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 0.0, "dur": 1.0},
+    ]}
+    problems = validate_chrome_trace(bad)
+    assert len(problems) == 3
+    assert any("bad ts" in p for p in problems)
+    assert any("unexpected ph" in p for p in problems)
+    assert any("missing name" in p for p in problems)
+
+
+def test_spans_of_skips_torn_span_lines():
+    spans = spans_of([
+        mk("queue", "t", "a", 0.0, 1.0),
+        {"kind": "span", "span": "queue", "trace_id": "t"},  # no ids/times
+        {"kind": "inference_request", "request": 1},
+    ])
+    assert [s.span_id for s in spans] == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# trace-writer rotation (telemetry.max_trace_bytes)
+# ---------------------------------------------------------------------------
+
+def test_trace_writer_rotation(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    # ~140 bytes/line, bound at 1000: the writer rotates once mid-run
+    # (around line 8) and the remaining lines land in a fresh file
+    w = TraceWriter(path, max_bytes=1000)
+    for i in range(10):
+        w.write("span", {"span": "decode_window", "trace_id": f"r0/{i}",
+                         "span_id": f"s-{i}", "t0": 0.0, "t1": 1.0,
+                         "dur_ms": 1000.0})
+    w.close()
+    assert w.rotations == 1
+    assert os.path.exists(path + ".1")
+    # no event torn across the rotation: every line in both generations
+    # parses, and together they hold all 10 events (exactly one older
+    # generation kept, so disk stays <= ~2x the bound)
+    kept = list(read_trace(path)) + list(read_trace(path + ".1"))
+    assert len(kept) == 10
+    assert {e["span_id"] for e in kept} == {f"s-{i}" for i in range(10)}
+    assert not os.path.exists(path + ".2")
+
+
+def test_trace_writer_unbounded_never_rotates(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    w = TraceWriter(path)          # max_bytes 0: unbounded (the default)
+    for i in range(50):
+        w.write("span", {"span_id": f"s-{i}"})
+    w.close()
+    assert w.rotations == 0 and not os.path.exists(path + ".1")
+
+
+# ---------------------------------------------------------------------------
+# fixture + CLI round trips
+# ---------------------------------------------------------------------------
+
+def test_fixture_reconstructs_clean():
+    """The checked-in miniature trace carries a migrated request (r0/5)
+    and a queue-dominated deadline miss (r1/6): both reconstruct with
+    zero orphans, and the blame join names the queue."""
+    events = list(read_trace(FIXTURE))
+    tls = build_timelines(events)
+    assert set(tls) == {"r0/5", "r1/6"}
+    mig = tls["r0/5"]
+    assert mig.orphans == [] and mig.replicas == ["r0", "r1"]
+    assert any(s.kind == "migration" for s in mig.spans)
+    assert mig.dominant_kind() == "decode_window"
+    rows = slo_blame(events, tls)
+    assert [r["trace_id"] for r in rows] == ["r1/6"]
+    assert rows[0]["dominant"] == "queue"
+    assert validate_chrome_trace(to_chrome_trace(tls)) == []
+
+
+def test_timeline_cli_summary_and_perfetto(tmp_path):
+    out = str(tmp_path / "perfetto.json")
+    proc = subprocess.run(
+        [sys.executable, TIMELINE_CLI, FIXTURE, "--perfetto", out,
+         "--strict"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "r0/5" in proc.stdout and "0 orphans" in proc.stdout
+    assert "1 migrated" in proc.stdout
+    doc = json.load(open(out))
+    assert validate_chrome_trace(doc) == []
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+def test_timeline_cli_drilldown_and_json():
+    proc = subprocess.run(
+        [sys.executable, TIMELINE_CLI, FIXTURE, "--trace-id", "r0/5"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "migration" in proc.stdout and "critical path" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, TIMELINE_CLI, FIXTURE, "--json"],
+        capture_output=True, text=True, timeout=60)
+    rows = json.loads(proc.stdout)["timelines"]
+    assert {r["trace_id"] for r in rows} == {"r0/5", "r1/6"}
+    assert all(r["orphans"] == 0 for r in rows)
+    mig = next(r for r in rows if r["trace_id"] == "r0/5")
+    assert mig["migrated"] is True and mig["replicas"] == ["r0", "r1"]
+
+
+def test_timeline_cli_no_spans_exits_one(tmp_path):
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text('{"schema": 1, "kind": "train_step", "fwd_ms": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, TIMELINE_CLI, str(bare)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1 and "no span events" in proc.stderr

@@ -378,6 +378,140 @@ def test_cli_missing_file_exit_code():
 
 
 # ---------------------------------------------------------------------------
+# --request / --slowest / --blame: span-timeline triage views
+# ---------------------------------------------------------------------------
+
+def _fixture_timelines():
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    tm = ds_trace_report._load_timeline()
+    return events, tm, tm.build_timelines(events)
+
+
+def test_find_timeline_exact_suffix_and_ambiguous():
+    _, _, tls = _fixture_timelines()
+    assert set(tls) == {"r0/5", "r1/6"}
+    tl, err = ds_trace_report.find_timeline(tls, "r0/5")
+    assert err is None and tl.trace_id == "r0/5"
+    # bare serving rid resolves through the /<rid> suffix when unique
+    tl, err = ds_trace_report.find_timeline(tls, "5")
+    assert err is None and tl.trace_id == "r0/5"
+    tl, err = ds_trace_report.find_timeline(tls, "9")
+    assert tl is None and "no trace_id" in err
+    amb = {"r0/7": tls["r0/5"], "r1/7": tls["r1/6"]}
+    tl, err = ds_trace_report.find_timeline(amb, "7")
+    assert tl is None and "ambiguous" in err
+    assert "r0/7" in err and "r1/7" in err
+
+
+def test_format_request_timeline_tree():
+    _, _, tls = _fixture_timelines()
+    text = ds_trace_report.format_request_timeline(tls["r0/5"])
+    assert "== request timeline r0/5 ==" in text
+    assert "replicas r0->r1" in text
+    assert "migration" in text and "@r0" in text and "@r1" in text
+    assert "critical path" in text and "attribution" in text
+    assert "ORPHAN" not in text
+
+
+def test_slowest_rows_order_and_migration_mark():
+    _, _, tls = _fixture_timelines()
+    rows = ds_trace_report.slowest_rows(tls, 10)
+    # r1/6 queued 12 ms and spans 21 ms of wall; r0/5 spans 18 ms
+    assert [r["trace_id"] for r in rows] == ["r1/6", "r0/5"]
+    assert rows[0]["migrated"] is False and rows[0]["dominant"] == "queue"
+    assert rows[1]["migrated"] is True
+    assert rows[1]["replicas"] == ["r0", "r1"]
+    assert ds_trace_report.slowest_rows(tls, 1) == rows[:1]
+    text = ds_trace_report.format_slowest(rows)
+    assert "slowest requests (2)" in text and "MIGRATED" in text
+
+
+def test_format_blame_with_and_without_spans():
+    events, tm, tls = _fixture_timelines()
+    rows = tm.slo_blame(events, tls)
+    assert len(rows) == 1
+    assert rows[0]["trace_id"] == "r1/6" and rows[0]["dominant"] == "queue"
+    text = ds_trace_report.format_blame(rows)
+    assert "SLO-miss blame (1 missed requests)" in text
+    assert "queue" in text
+    # a missed request whose spans were sampled out still gets a row —
+    # with the honest "no spans" note instead of invented blame
+    rows_bare = tm.slo_blame(
+        [{"kind": "inference_request", "deadline_met": False,
+          "ttft_ms": 9.0, "queue_ms": 2.0}], tls)
+    text = ds_trace_report.format_blame(rows_bare)
+    assert "no spans: trace sampled out or rotated away" in text
+
+
+def test_cli_request_flag(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--request", "5"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "== request timeline r0/5 ==" in proc.stdout
+    assert "migration" in proc.stdout
+    # JSON mode returns the summary row
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--request", "r1/6", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    row = json.loads(proc.stdout)
+    assert row["trace_id"] == "r1/6" and row["dominant"] == "queue"
+    # unknown request is a usage error
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--request", "404"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    assert "no trace_id" in proc.stderr
+    # a span-free trace exits 1 (same contract as --decode/--serve)
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text('{"schema": 1, "kind": "train_step", "fwd_ms": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, CLI, str(bare), "--request", "5"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no span events" in proc.stderr
+
+
+def test_cli_slowest_and_blame_flags(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--slowest", "2", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rows = json.loads(proc.stdout)["slowest"]
+    assert [r["trace_id"] for r in rows] == ["r1/6", "r0/5"]
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--blame", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rows = json.loads(proc.stdout)["blame"]
+    assert len(rows) == 1 and rows[0]["trace_id"] == "r1/6"
+    # table mode smoke
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--blame"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "SLO-miss blame" in proc.stdout
+    # no deadline misses at all -> exit 1 with the honest message
+    bare = tmp_path / "met.jsonl"
+    bare.write_text('{"schema": 1, "kind": "inference_request", '
+                    '"deadline_met": true, "ttft_ms": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, CLI, str(bare), "--blame"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "no deadline-missing" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
 # --audit: static-vs-runtime comm cross-check (ds-audit pairing)
 # ---------------------------------------------------------------------------
 

@@ -8,13 +8,16 @@
 #                                           proves no jax import)
 #   4. scenario-matrix smoke               (scenarios/*.jsonl load, compile
 #                                           deterministically, byte-match
-#                                           builtin_matrix())
+#                                           builtin_matrix(); traced chaos
+#                                           run round-trips zero-orphan and
+#                                           emits ci_perfetto_smoke.json)
 #   5. tier-1 tests                        (the ROADMAP.md command)
 #
 # Usage:  tools/ci_check.sh [BASE_REF] [SARIF_DIR]
 #   BASE_REF   git ref to diff against for ds-lint --changed (default HEAD,
 #              i.e. uncommitted work; CI passes origin/main)
-#   SARIF_DIR  where the SARIF documents land (default ./ci_artifacts)
+#   SARIF_DIR  where the SARIF documents and the scenario-smoke Perfetto
+#              artifact land (default ./ci_artifacts)
 #
 # Exit: non-zero on the FIRST failing stage; SARIF files are written for
 # whichever stages ran (code hosts ingest them for PR annotation).
@@ -53,8 +56,8 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [4/5] scenario-matrix smoke (tools/ci_scenario_smoke.py)"
-python "${REPO}/tools/ci_scenario_smoke.py"
+echo "ci_check: [4/5] scenario-matrix smoke + tracing round-trip (tools/ci_scenario_smoke.py)"
+python "${REPO}/tools/ci_scenario_smoke.py" "${SARIF_DIR}"
 rc=$?
 if [ $rc -ne 0 ]; then
     echo "ci_check: scenario smoke FAILED (exit $rc)" >&2

@@ -45,6 +45,8 @@ JAXFREE_TESTS = [
     "tests/unit/runtime/test_resilience_policy.py",
     "tests/unit/runtime/test_numerics.py",
     "tests/unit/checkpoint/test_checkpoint_integrity.py",
+    "tests/unit/serving/test_spans.py",
+    "tests/unit/telemetry/test_timeline.py",
 ]
 
 

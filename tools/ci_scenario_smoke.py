@@ -16,14 +16,23 @@ catches the drift at CI speed:
 - compile must be deterministic (two calls, identical output),
 - regenerating the matrix into a scratch dir must reproduce the
   committed bytes, file for file, with no extras on either side,
+- a traced FakeEngine chaos run (spec decode + replica kill + migration)
+  must round-trip through the timeline reconstructor with ZERO orphan
+  spans, and its Perfetto export (``ci_perfetto_smoke.json``, written to
+  the artifact dir next to the SARIF files) must pass the Chrome-trace
+  lint and hold exactly one complete event per span line — the
+  docs/telemetry.md "Request tracing" causality contract, gated per PR,
 - and ``jax`` must never enter ``sys.modules`` (the scenario engine is
   host-side bookkeeping; same promise as tools/ci_jaxfree_tests.py).
 
-Usage: python tools/ci_scenario_smoke.py   (exit 0 ok, 1 on any drift,
-3 if jax leaked).
+Usage: python tools/ci_scenario_smoke.py [ARTIFACT_DIR]
+(exit 0 ok, 1 on any drift, 3 if jax leaked; ARTIFACT_DIR defaults to
+./ci_artifacts).
 """
 
 import glob
+import importlib.util
+import json
 import os
 import sys
 import tempfile
@@ -38,6 +47,111 @@ def _stub_pkg(name: str, path: str):
     pkg = types.ModuleType(name)
     pkg.__path__ = [path]
     sys.modules[name] = pkg
+
+
+def _tracing_roundtrip(artifact_dir: str) -> list:
+    """Drive a tiny traced chaos fleet (FakeEngine: spec decode, replica
+    kill, cross-replica migration), write its telemetry to a JSONL
+    trace, reconstruct every request timeline, and export + lint the
+    Perfetto artifact. Returns failure strings (empty = ok)."""
+    sys.path.insert(0, os.path.join(REPO, "tests", "unit", "serving"))
+    from fake_engine import FakeEngine
+
+    from deepspeed_tpu.serving.engine import ServingEngine
+    from deepspeed_tpu.serving.fleet import attach_replica_telemetry
+    from deepspeed_tpu.serving.router import FleetRouter
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+    from deepspeed_tpu.telemetry.trace import TraceWriter
+
+    spec = importlib.util.spec_from_file_location(
+        "_ci_smoke_timeline",
+        os.path.join(REPO, "deepspeed_tpu", "telemetry", "timeline.py"))
+    tm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tm)
+
+    trace_path = os.path.join(artifact_dir, "ci_trace_smoke.jsonl")
+    perfetto_path = os.path.join(artifact_dir, "ci_perfetto_smoke.json")
+    for p in (trace_path, perfetto_path):
+        if os.path.exists(p):
+            os.remove(p)
+
+    class Clock:
+        t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    class Hub:
+        enabled = True
+
+        def __init__(self, path):
+            self.registry = MetricsRegistry()
+            self._w = TraceWriter(path)
+
+        def emit(self, kind, payload, **kw):
+            self._w.write(kind, payload)
+
+        def close(self):
+            self._w.close()
+
+    clock = Clock()
+    hub = Hub(trace_path)
+    import numpy as np
+
+    def factory(replica_id):
+        eng = FakeEngine(vocab_size=997, cache_len=64, slots=2,
+                         clock=clock)
+        eng.spec_gamma = 2
+        attach_replica_telemetry(eng, hub, replica_id)
+        return ServingEngine(eng, clock=clock)
+
+    router = FleetRouter(factory, replicas=2, clock=clock, telemetry=hub)
+    for i in range(3):
+        router.submit(np.arange(1, 5 + i, dtype=np.int32),
+                      max_new_tokens=8)
+    for _ in range(3):
+        router.step()
+        clock.t += 0.01
+    router.kill("r0")          # chaos: migrate mid-stream to r1
+    ticks = 0
+    while router.has_work():
+        if ticks > 300:
+            hub.close()
+            return ["tracing roundtrip: chaos fleet did not converge"]
+        router.step()
+        clock.t += 0.01
+        ticks += 1
+    hub.close()
+
+    failures = []
+    events = list(tm.iter_events(trace_path))
+    n_span_lines = sum(1 for e in events if e.get("kind") == "span")
+    timelines = tm.build_timelines(events)
+    if not timelines:
+        return [f"tracing roundtrip: no span events in {trace_path}"]
+    orphans = sum(len(tl.orphans) for tl in timelines.values())
+    if orphans:
+        failures.append(
+            f"tracing roundtrip: {orphans} orphan span(s) — span "
+            f"causality the trace cannot back (parent emitted after "
+            f"child was dropped, or not at all)")
+    if not any(s.kind == "migration" for tl in timelines.values()
+               for s in tl.spans):
+        failures.append("tracing roundtrip: replica kill produced no "
+                        "migration span — the cross-replica stitch is "
+                        "not being emitted")
+    doc = tm.to_chrome_trace(timelines)
+    problems = tm.validate_chrome_trace(doc)
+    failures.extend(f"perfetto export lint: {p}" for p in problems)
+    n_complete = sum(1 for ev in doc["traceEvents"] if ev.get("ph") == "X")
+    if n_complete != n_span_lines:
+        failures.append(
+            f"perfetto export dropped spans: {n_span_lines} span lines "
+            f"in the trace, {n_complete} complete events exported")
+    if not failures:
+        with open(perfetto_path, "w") as fh:
+            json.dump(doc, fh)
+    return failures
 
 
 def main() -> int:
@@ -97,6 +211,11 @@ def main() -> int:
                         f"`python -m deepspeed_tpu.serving.scenarios "
                         f"scenarios`")
 
+    artifact_dir = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(REPO, "ci_artifacts")
+    os.makedirs(artifact_dir, exist_ok=True)
+    failures.extend(_tracing_roundtrip(artifact_dir))
+
     if "jax" in sys.modules:
         print("ci_scenario_smoke: FAIL — jax entered sys.modules in the "
               "scenario engine (it promises to be host-side "
@@ -107,8 +226,10 @@ def main() -> int:
             print(f"ci_scenario_smoke: FAIL — {f}", file=sys.stderr)
         return 1
     print(f"ci_scenario_smoke: ok — {len(committed)} scenarios load, "
-          f"compile deterministically, match builtin_matrix(); jax "
-          f"never imported")
+          f"compile deterministically, match builtin_matrix(); traced "
+          f"chaos run round-trips with zero orphan spans (Perfetto "
+          f"artifact: {os.path.join(artifact_dir, 'ci_perfetto_smoke.json')}); "
+          f"jax never imported")
     return 0
 
 

@@ -18,13 +18,35 @@ laptops holding traces scp'd off a pod.
 """
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 
 SUPPORTED_SCHEMA = 1
 # bookkeeping fields that aren't latencies/rates — excluded from tables
-# unless --all-fields asks for them
-_SKIP_FIELDS = {"schema", "ts", "request", "step", "micro_steps", "samples"}
+# unless --all-fields asks for them; t0/t1 are span-event monotonic
+# endpoints (dur_ms is the metric, the endpoints are bookkeeping)
+_SKIP_FIELDS = {"schema", "ts", "request", "step", "micro_steps", "samples",
+                "t0", "t1"}
+
+_TIMELINE_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deepspeed_tpu", "telemetry", "timeline.py")
+
+
+def _load_timeline():
+    """``telemetry/timeline.py`` loaded by file path — the module is
+    stdlib-only and self-contained, so the package (which imports jax)
+    never loads. Powers --request/--slowest/--blame."""
+    alias = "_ds_trace_report_timeline"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(alias, _TIMELINE_PY)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = module
+    spec.loader.exec_module(module)
+    return module
 
 
 def percentile(sorted_vals, q):
@@ -874,6 +896,105 @@ def format_audit_crosscheck(rows, tolerance):
     return "\n".join(lines) + "\n"
 
 
+def find_timeline(timelines, needle):
+    """Resolve --request: an exact trace_id match first, else the unique
+    timeline whose trace_id ends with ``/<needle>`` (so ``--request 5``
+    finds ``r0/5`` in a fleet trace when unambiguous)."""
+    if needle in timelines:
+        return timelines[needle], None
+    suffix = [tid for tid in timelines if tid.endswith(f"/{needle}")]
+    if len(suffix) == 1:
+        return timelines[suffix[0]], None
+    if len(suffix) > 1:
+        return None, (f"ambiguous request {needle!r}: matches "
+                      f"{', '.join(sorted(suffix))}")
+    return None, (f"no trace_id {needle!r} in the trace "
+                  f"(have: {', '.join(sorted(timelines)) or 'none'})")
+
+
+def format_request_timeline(tl):
+    """The "why is this request slow" view: the span tree indented by
+    causal depth, then the critical-path ledger."""
+    reps = "->".join(str(r) for r in tl.replicas) or "-"
+    lines = [f"== request timeline {tl.trace_id} ==",
+             f"duration          {_fmt(tl.duration_ms)} ms   "
+             f"spans {len(tl.spans)}   orphans {len(tl.orphans)}   "
+             f"replicas {reps}"]
+    origin = tl.t_start
+    for s in tl.spans:
+        pad = "  " * tl.depth(s)
+        rep = f" @{s.replica}" if s.replica is not None else ""
+        orphan = "  [ORPHAN]" if s in tl.orphans else ""
+        extras = " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
+        lines.append(f"  +{(s.t0 - origin) * 1000.0:>9.3f} ms "
+                     f"{pad}{s.kind} ({_fmt(s.dur_ms)} ms){rep}"
+                     + (f"  {extras}" if extras else "") + orphan)
+    path = tl.critical_path()
+    lines.append("critical path     "
+                 + "   ".join(f"{k} {_fmt(v)} ms" for k, v in
+                              sorted(path.items(), key=lambda kv: -kv[1])))
+    attr = tl.attribution()
+    lines.append("attribution       "
+                 + "   ".join(f"{k} {_fmt(v)} ms" for k, v in
+                              sorted(attr.items(), key=lambda kv: -kv[1])))
+    return "\n".join(lines) + "\n"
+
+
+def slowest_rows(timelines, n):
+    """Top-N request timelines by wall duration, each with its dominant
+    span kind and queue/compute/recovery split — the triage queue."""
+    tls = sorted(timelines.values(), key=lambda t: -t.duration_ms)[:n]
+    return [{
+        "trace_id": tl.trace_id,
+        "duration_ms": round(tl.duration_ms, 3),
+        "spans": len(tl.spans),
+        "orphans": len(tl.orphans),
+        "dominant": tl.dominant_kind(),
+        "attribution": {k: round(v, 3)
+                        for k, v in sorted(tl.attribution().items())},
+        "replicas": tl.replicas,
+        "migrated": any(s.kind == "migration" for s in tl.spans),
+    } for tl in tls]
+
+
+def format_slowest(rows):
+    lines = [f"== slowest requests ({len(rows)}) =="]
+    head = (f"{'trace_id':<20} {'dur_ms':>12} {'dominant':>18} "
+            f"{'queue':>10} {'compute':>10} {'recovery':>10}  replicas")
+    lines.append(head)
+    lines.append("-" * len(head))
+    for r in rows:
+        attr = r["attribution"]
+        reps = "->".join(str(x) for x in r["replicas"]) or "-"
+        mark = (" MIGRATED" if r["migrated"] else "") + \
+               (" ORPHANS" if r["orphans"] else "")
+        lines.append(
+            f"{r['trace_id']:<20} {_fmt(r['duration_ms']):>12} "
+            f"{r['dominant'] or '-':>18} "
+            f"{_fmt(attr.get('queue', 0.0)):>10} "
+            f"{_fmt(attr.get('compute', 0.0)):>10} "
+            f"{_fmt(attr.get('recovery', 0.0)):>10}  {reps}{mark}")
+    return "\n".join(lines) + "\n"
+
+
+def format_blame(rows):
+    lines = [f"== SLO-miss blame ({len(rows)} missed requests) =="]
+    head = (f"{'trace_id':<20} {'ttft_ms':>10} {'queue_ms':>10} "
+            f"{'dominant':>18}  blame")
+    lines.append(head)
+    lines.append("-" * len(head))
+    for r in rows:
+        attr = r.get("attribution") or {}
+        blame = "   ".join(f"{k} {_fmt(v)} ms" for k, v in
+                           sorted(attr.items(), key=lambda kv: -kv[1])) \
+                or "(no spans: trace sampled out or rotated away)"
+        lines.append(f"{str(r['trace_id']):<20} "
+                     f"{_fmt(r['ttft_ms'] or 0.0):>10} "
+                     f"{_fmt(r['queue_ms'] or 0.0):>10} "
+                     f"{r['dominant'] or '-':>18}  {blame}")
+    return "\n".join(lines) + "\n"
+
+
 def _fmt(v):
     if v == 0:
         return "0"
@@ -944,6 +1065,17 @@ def main(argv=None):
     ap.add_argument("--audit-tolerance", type=float, default=0.5,
                     help="accepted measured/static ratio band "
                          "[T, 1/T] for --audit (default 0.5)")
+    ap.add_argument("--request", metavar="RID", default=None,
+                    help="one request's reconstructed span timeline: the "
+                         "causal tree + critical-path breakdown for this "
+                         "trace_id ('r0/5', 'step:12'; a bare rid matches "
+                         "any replica when unambiguous)")
+    ap.add_argument("--slowest", type=int, metavar="N", default=None,
+                    help="top-N slowest request timelines with dominant "
+                         "span kind and queue/compute/recovery split")
+    ap.add_argument("--blame", action="store_true",
+                    help="SLO-miss blame: deadline-missing requests joined "
+                         "with their timeline's dominant span kind")
     args = ap.parse_args(argv)
 
     try:
@@ -986,6 +1118,42 @@ def main(argv=None):
         else:
             sys.stdout.write(
                 format_audit_crosscheck(rows, args.audit_tolerance))
+        return 0
+
+    if args.request or args.slowest is not None or args.blame:
+        tm = _load_timeline()
+        timelines = tm.build_timelines(events)
+        if not timelines and not args.blame:
+            print("no span events in the trace (is request tracing "
+                  "enabled? see docs/telemetry.md)", file=sys.stderr)
+            return 1
+        if args.request:
+            tl, err = find_timeline(timelines, args.request)
+            if tl is None:
+                print(f"error: {err}", file=sys.stderr)
+                return 2
+            if args.as_json:
+                print(json.dumps(slowest_rows({tl.trace_id: tl}, 1)[0],
+                                 indent=2, sort_keys=True))
+            else:
+                sys.stdout.write(format_request_timeline(tl))
+        if args.slowest is not None:
+            rows = slowest_rows(timelines, args.slowest)
+            if args.as_json:
+                print(json.dumps({"slowest": rows}, indent=2,
+                                 sort_keys=True))
+            else:
+                sys.stdout.write(format_slowest(rows))
+        if args.blame:
+            rows = tm.slo_blame(events, timelines)
+            if not rows:
+                print("no deadline-missing inference_request events in "
+                      "the trace", file=sys.stderr)
+                return 1
+            if args.as_json:
+                print(json.dumps({"blame": rows}, indent=2, sort_keys=True))
+            else:
+                sys.stdout.write(format_blame(rows))
         return 0
 
     if args.decode:

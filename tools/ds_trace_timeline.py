@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Reconstruct per-request span timelines from a telemetry JSONL trace
+and export them as Chrome trace-event JSON for Perfetto.
+
+The span layer (``docs/telemetry.md``, "Request tracing") writes one
+``kind: "span"`` line per closed span into the same trace every other
+telemetry event rides. This CLI groups them by ``trace_id``, stitches
+the parent/child tree (a ``migration`` span bridges replica tags, so a
+request that moved replicas reconstructs as ONE timeline), reports
+orphans — spans whose ``parent_id`` the file cannot back — and writes a
+``--perfetto`` JSON artifact loadable in https://ui.perfetto.dev or
+chrome://tracing: one process lane per replica, one thread lane per
+trace_id.
+
+Usage:
+    python tools/ds_trace_timeline.py runs/trace.jsonl
+    python tools/ds_trace_timeline.py runs/trace.jsonl --perfetto out.json
+    python tools/ds_trace_timeline.py runs/trace.jsonl --trace r0/5 --json
+    python tools/ds_trace_timeline.py runs/trace.jsonl --strict  # orphans -> exit 1
+
+Deliberately stdlib-only (``telemetry/timeline.py`` is loaded by file
+path, no package import): runs anywhere, including laptops holding
+traces scp'd off a pod — same portability contract as
+``ds_trace_report.py``.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TIMELINE_PY = os.path.join(REPO, "deepspeed_tpu", "telemetry", "timeline.py")
+_ALIAS = "_ds_trace_timeline_mod"
+
+
+def load_timeline_module():
+    """The stdlib-only read-side module, loaded by file path so this
+    tool never imports ``deepspeed_tpu`` (whose __init__ pulls in jax)."""
+    if _ALIAS in sys.modules:
+        return sys.modules[_ALIAS]
+    spec = importlib.util.spec_from_file_location(_ALIAS, _TIMELINE_PY)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[_ALIAS] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _fmt_ms(v):
+    return f"{v:,.3f}".rstrip("0").rstrip(".")
+
+
+def timeline_row(tl):
+    """One machine-readable summary row per reconstructed timeline."""
+    return {
+        "trace_id": tl.trace_id,
+        "spans": len(tl.spans),
+        "orphans": len(tl.orphans),
+        "duration_ms": round(tl.duration_ms, 3),
+        "replicas": tl.replicas,
+        "migrated": any(s.kind == "migration" for s in tl.spans),
+        "dominant": tl.dominant_kind(),
+        "attribution": {k: round(v, 3)
+                        for k, v in sorted(tl.attribution().items())},
+    }
+
+
+def format_summary(timelines, skipped_spans):
+    tls = sorted(timelines.values(), key=lambda t: -t.duration_ms)
+    n_spans = sum(len(t.spans) for t in tls)
+    n_orphans = sum(len(t.orphans) for t in tls)
+    migrated = sum(1 for t in tls if any(s.kind == "migration"
+                                         for s in t.spans))
+    lines = [f"== timelines ({len(tls)} traces, {n_spans} spans, "
+             f"{n_orphans} orphans, {migrated} migrated) =="]
+    if skipped_spans:
+        lines.append(f"   ({skipped_spans} non-span events ignored)")
+    head = (f"{'trace_id':<20} {'spans':>6} {'dur_ms':>12} "
+            f"{'dominant':>18}  replicas")
+    lines.append(head)
+    lines.append("-" * len(head))
+    for tl in tls:
+        reps = "->".join(str(r) for r in tl.replicas) or "-"
+        mark = " ORPHANS" if tl.orphans else ""
+        lines.append(f"{tl.trace_id:<20} {len(tl.spans):>6} "
+                     f"{_fmt_ms(tl.duration_ms):>12} "
+                     f"{tl.dominant_kind() or '-':>18}  {reps}{mark}")
+    return "\n".join(lines) + "\n"
+
+
+def format_one(tl):
+    """The drill-down view: the span tree of one trace_id, indented by
+    causal depth, timestamps relative to the timeline start."""
+    lines = [f"== trace {tl.trace_id} — {_fmt_ms(tl.duration_ms)} ms, "
+             f"{len(tl.spans)} spans, replicas "
+             f"{'->'.join(str(r) for r in tl.replicas) or '-'} =="]
+    origin = tl.t_start
+    for s in tl.spans:
+        pad = "  " * tl.depth(s)
+        rep = f" @{s.replica}" if s.replica is not None else ""
+        orphan = "  [ORPHAN: parent missing]" if s in tl.orphans else ""
+        extras = " ".join(f"{k}={v}" for k, v in sorted(s.attrs.items()))
+        lines.append(f"  {(s.t0 - origin) * 1000.0:>10.3f} ms "
+                     f"{pad}{s.kind} ({_fmt_ms(s.dur_ms)} ms){rep}"
+                     + (f"  {extras}" if extras else "") + orphan)
+    path = tl.critical_path()
+    lines.append("  critical path: "
+                 + "   ".join(f"{k} {_fmt_ms(v)} ms"
+                              for k, v in sorted(path.items(),
+                                                 key=lambda kv: -kv[1])))
+    attr = tl.attribution()
+    lines.append("  attribution:   "
+                 + "   ".join(f"{k} {_fmt_ms(v)} ms"
+                              for k, v in sorted(attr.items(),
+                                                 key=lambda kv: -kv[1])))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-request span timelines + Perfetto export from a "
+                    "deepspeed_tpu telemetry JSONL trace")
+    ap.add_argument("trace", help="path to the JSONL trace file")
+    ap.add_argument("--trace-id", dest="trace_id", default=None,
+                    metavar="TID",
+                    help="drill into one trace_id (e.g. 'r0/5' or "
+                         "'step:12'): full span tree + critical path")
+    ap.add_argument("--perfetto", metavar="OUT", default=None,
+                    help="write Chrome trace-event JSON here (load in "
+                         "https://ui.perfetto.dev or chrome://tracing)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit summary rows as JSON instead of tables")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any timeline has orphan spans (CI "
+                         "round-trip gate)")
+    args = ap.parse_args(argv)
+
+    tm = load_timeline_module()
+    try:
+        events = list(tm.iter_events(args.trace))
+    except OSError as e:
+        print(f"error: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    timelines = tm.build_timelines(events)
+    if not timelines:
+        print(f"no span events in {args.trace} (is request tracing "
+              f"enabled? see docs/telemetry.md)", file=sys.stderr)
+        return 1
+
+    if args.trace_id is not None:
+        tl = timelines.get(args.trace_id)
+        if tl is None:
+            print(f"error: no trace_id {args.trace_id!r} in the trace "
+                  f"(have: {', '.join(sorted(timelines))})", file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(timeline_row(tl), indent=2, sort_keys=True))
+        else:
+            sys.stdout.write(format_one(tl))
+    else:
+        rows = [timeline_row(tl) for tl in timelines.values()]
+        if args.as_json:
+            rows.sort(key=lambda r: -r["duration_ms"])
+            print(json.dumps({"timelines": rows}, indent=2, sort_keys=True))
+        else:
+            n_span_events = sum(1 for e in events if e.get("kind") == "span")
+            sys.stdout.write(format_summary(
+                timelines, len(events) - n_span_events))
+
+    if args.perfetto is not None:
+        doc = tm.to_chrome_trace(timelines)
+        problems = tm.validate_chrome_trace(doc)
+        if problems:
+            for p in problems:
+                print(f"error: export failed lint: {p}", file=sys.stderr)
+            return 2
+        with open(args.perfetto, "w") as fh:
+            json.dump(doc, fh)
+        n = sum(1 for ev in doc["traceEvents"] if ev.get("ph") == "X")
+        print(f"wrote {n} span events to {args.perfetto} "
+              f"(open in https://ui.perfetto.dev)", file=sys.stderr)
+
+    orphans = sum(len(tl.orphans) for tl in timelines.values())
+    if args.strict and orphans:
+        print(f"error: {orphans} orphan span(s) — causality the trace "
+              f"cannot back", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
