@@ -47,23 +47,10 @@ try:
 except Exception:
     pass
 
-PEAK_BF16_FLOPS = {
-    # per-chip dense bf16 peak
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v4": 275e12,
-    "v6e": 918e12,
-    "cpu": 1e12,  # nominal, so the script still runs off-TPU
-}
-PEAK_HBM_BW = {
-    "v5 lite": 819e9,
-    "v5e": 819e9,
-    "v5p": 2765e9,
-    "v4": 1228e9,
-    "v6e": 1640e9,
-    "cpu": 100e9,
-}
+# device peaks live in ONE place — analysis/program/costmodel.py — shared
+# with tools/perf_budget.py and the ds-perf roofline gate; the bench's
+# MFU math reads the same table it always printed (197 TF / 819 GB/s on
+# v5e, the v5e row as the unknown-kind default)
 
 
 _SMOKE = os.environ.get("DSTPU_BENCH_SMOKE") == "1"
@@ -83,19 +70,15 @@ def _device_kind() -> str:
 
 
 def peak_flops() -> float:
-    kind = _device_kind()
-    for key, val in PEAK_BF16_FLOPS.items():
-        if key in kind:
-            return val
-    return 197e12
+    from deepspeed_tpu.analysis.program.costmodel import peaks_for
+
+    return peaks_for(_device_kind()).flops
 
 
 def peak_bw() -> float:
-    kind = _device_kind()
-    for key, val in PEAK_HBM_BW.items():
-        if key in kind:
-            return val
-    return 819e9
+    from deepspeed_tpu.analysis.program.costmodel import peaks_for
+
+    return peaks_for(_device_kind()).hbm_bw
 
 
 def _sync(engine, loss):
@@ -1075,7 +1058,11 @@ def _bench_digest():
                 # winner was probed on — re-probe rather than replay stale
                 "deepspeed_tpu/analysis/program/contracts.py",
                 "deepspeed_tpu/analysis/program/capture.py",
-                "deepspeed_tpu/analysis/program/families.py"):
+                "deepspeed_tpu/analysis/program/families.py",
+                # ds-perf: the peaks table feeds the MFU column and the
+                # inventory fingerprint pins the compiled-program shape
+                "deepspeed_tpu/analysis/program/costmodel.py",
+                "deepspeed_tpu/analysis/program/inventory.py"):
         try:
             with open(os.path.join(root, rel), "rb") as f:
                 h.update(f.read())

@@ -7,13 +7,21 @@ transfers in device-resident families, dtype policy, and static HBM
 ceilings — the hot-path guarantees that only exist in the lowered
 program. See docs/static_analysis.md "Program audit".
 
+ds-perf (tools/ds_perf.py) layers the performance gate on the same
+artifacts: :mod:`.inventory` fingerprints each compiled program and
+diffs it against ``tools/ds_perf_baseline.json``; :mod:`.costmodel`
+holds the repo's ONE device-peaks table and the roofline/overlap-
+readiness math. See docs/static_analysis.md "Performance audit".
+
 Import layering: this package is part of ``deepspeed_tpu.analysis`` and
-therefore must stay importable WITHOUT jax (the ds-lint standalone
-loader). ``artifact``/``contracts``/``rules``/``auditor`` are pure
-stdlib; ``capture``/``families`` import jax lazily inside functions.
+therefore must stay importable WITHOUT jax (the ds-lint/ds-perf
+standalone loaders). ``artifact``/``contracts``/``rules``/``auditor``/
+``inventory``/``costmodel`` are pure stdlib; ``capture``/``families``
+import jax lazily inside functions.
 
 Entry points:
     python tools/ds_audit.py [--mesh 1:1,1:2] [--format text|json|sarif]
+    python tools/ds_perf.py [--diff CUR.json] [--write-baseline]
     dstpu_prewarm --audit ...            (audit the real warmed programs)
     tests/unit/analysis/test_program_gate.py   (the tier-1 gate)
 """
@@ -28,19 +36,51 @@ from .contracts import (
     known_families,
     validate_registry,
 )
-from .rules import ProgramRule, program_rules, program_rules_by_id
+from .costmodel import (
+    DEVICE_PEAKS,
+    DevicePeaks,
+    overlap_readiness,
+    peaks_for,
+    predict,
+    roofline_ms,
+)
+from .inventory import (
+    DEFAULT_TOLERANCES,
+    build_inventories,
+    build_inventory,
+    diff_inventories,
+    program_key,
+)
+from .rules import (
+    ProgramRule,
+    perf_rules,
+    program_rules,
+    program_rules_by_id,
+)
 
 __all__ = [
     "COLLECTIVE_PROFILES",
+    "DEFAULT_TOLERANCES",
+    "DEVICE_PEAKS",
+    "DevicePeaks",
     "PROGRAM_CONTRACTS",
     "ProgramArtifact",
     "ProgramAuditor",
     "ProgramRule",
     "audit_artifacts",
+    "build_inventories",
+    "build_inventory",
     "contract_for",
+    "diff_inventories",
     "expected_collectives",
     "known_families",
+    "overlap_readiness",
+    "peaks_for",
+    "perf_rules",
+    "predict",
+    "program_key",
     "program_rules",
     "program_rules_by_id",
+    "roofline_ms",
     "validate_registry",
 ]

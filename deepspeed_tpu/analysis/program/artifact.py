@@ -101,10 +101,11 @@ class CollectiveOp:
     operand_bytes: int   # sum of operand tensor bytes (per-chip payload)
     operand_shapes: tuple = ()  # ((dtype, (d0, d1, ...)), ...)
     line: str = ""
+    async_form: bool = False  # compiled as a -start/-done pair (overlappable)
 
     def to_dict(self) -> dict:
         return {"kind": self.kind, "out": f"{self.out_dtype}[{self.out_shape_csv}]",
-                "bytes": self.operand_bytes}
+                "bytes": self.operand_bytes, "async": self.async_form}
 
 
 @dataclass
@@ -211,6 +212,23 @@ class ProgramArtifact:
         out = {}
         for op in self.collectives():
             out[op.kind] = out.get(op.kind, 0) + op.operand_bytes
+        return out
+
+    def collective_forms(self) -> dict:
+        """{kind: {"sync": n, "async": m, "bytes": total, "async_bytes":
+        overlappable}} — the sync-vs-async split per collective kind.
+        An op compiled as a ``-start/-done`` pair is async (the scheduler
+        may hide it under compute); a plain op blocks the stream. This is
+        what the sync-collective rule and the overlap-readiness metric
+        read."""
+        out = {}
+        for op in self.collectives():
+            slot = out.setdefault(op.kind, {"sync": 0, "async": 0,
+                                            "bytes": 0, "async_bytes": 0})
+            slot["async" if op.async_form else "sync"] += 1
+            slot["bytes"] += op.operand_bytes
+            if op.async_form:
+                slot["async_bytes"] += op.operand_bytes
         return out
 
     # -- host transfers -------------------------------------------------
@@ -386,7 +404,8 @@ def parse_collectives(hlo_text: str):
                                 out_shape_csv=out_csv,
                                 operand_bytes=operand_bytes,
                                 operand_shapes=tuple(operand_shapes),
-                                line=line.strip()[:160]))
+                                line=line.strip()[:160],
+                                async_form=(phase == "-start")))
     return ops
 
 

@@ -95,6 +95,8 @@ def extract_artifact(family: str, variant: str, fn, args, meta=None,
     try:
         import jax
 
+        # the cost model picks its peaks row from this (ds-perf predictions)
+        meta.setdefault("device_kind", jax.devices()[0].device_kind)
         lowered = fn.lower(*args)
         art.stable_text = lowered.as_text()
         try:
@@ -185,6 +187,7 @@ def notify_lowered(family: str, variant: str, lowered, meta=None,
     meta = _resolve_meta(meta)
     art = ProgramArtifact(family=family, variant=variant, meta=meta)
     try:
+        meta.setdefault("device_kind", jax.devices()[0].device_kind)
         art.stable_text = lowered.as_text()
         try:
             meta["donated_leaves"] = sum(

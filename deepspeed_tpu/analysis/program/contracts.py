@@ -41,6 +41,19 @@ Contract dimensions (each optional; absent = not checked for the family):
 - ``hbm``: ``"telemetry_limit"`` — the executable's static peak
   (arguments + outputs + temp - aliased) must fit the configured
   ``telemetry.hbm_limit_bytes`` when one is set.
+- ``perf``: the ds-perf envelope (read by :func:`..rules.perf_rules`,
+  not by ds-audit). ``overlap_collectives`` is the tuple of collective
+  kinds the family's schedule is designed to hide under compute — a
+  declared kind compiled in blocking form at tp>1 is a
+  ``sync-collective`` finding. Every tuple is EMPTY today: the
+  virtual-CPU backend compiles all collectives synchronously, so no
+  family may honestly declare overlap yet; ROADMAP item 3 (T3-style
+  compute/collective overlap) flips ``train_micro`` first, and this
+  registry is where that claim lands reviewably. ``dot_operands:
+  "meta"`` pins dot_general OPERAND dtypes to the artifact's
+  ``dot_dtypes`` meta (the model dtype's policy) — the
+  ``hot-dot-upcast`` rule; accumulation width stays the dtype rule's
+  job.
 
 Collective-count calibration: the transformer stacks layers through one
 ``lax.scan``, so the per-layer collectives appear ONCE in the compiled
@@ -151,6 +164,13 @@ def expected_collectives(profile: str, tp: int, sampled: bool = False):
 _DTYPE_DEFAULT = {"forbid": ("f64",), "matmul_accum": "meta",
                   "int8_kv": "stable"}
 
+# the default ds-perf envelope: operand dtypes pinned to the model
+# policy, no collective declared overlappable (see the module docstring
+# — the virtual-CPU gate compiles everything sync; a family earns a
+# non-empty overlap_collectives tuple the PR that lands its overlap
+# schedule, and the sync-collective rule holds it there)
+_PERF_DEFAULT = {"overlap_collectives": (), "dot_operands": "meta"}
+
 PROGRAM_CONTRACTS = {
     # -- continuous-batching pool (inference/continuous.py) -------------
     "pool_tick": {
@@ -160,6 +180,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "pool_segment": {
@@ -169,6 +190,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "pool_row_update": {
@@ -178,6 +200,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
     },
     "pool_spec_tick_ngram": {
         # compile_spec_pool_tick_fn (ngram) donate_argnums=(1, 2, 3, 4, 5)
@@ -186,6 +209,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "pool_spec_tick_draft": {
@@ -196,6 +220,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "pool_spec_row_update": {
@@ -205,6 +230,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
     },
     # -- engine decode pair (inference/engine.py _compile) --------------
     "decode_prefill": {
@@ -214,6 +240,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "decode_step": {
@@ -223,6 +250,7 @@ PROGRAM_CONTRACTS = {
         "param_collectives": "forbid",
         "host_transfers": "forbid",
         "dtype": _DTYPE_DEFAULT,
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     # -- training step programs (runtime/engine.py) ---------------------
@@ -232,6 +260,7 @@ PROGRAM_CONTRACTS = {
         "collectives": "train_micro",
         "host_transfers": "forbid",
         "dtype": {"forbid": ("f64",), "matmul_accum": "meta"},
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
     "train_apply": {
@@ -240,6 +269,7 @@ PROGRAM_CONTRACTS = {
         "collectives": "train_apply",
         "host_transfers": "forbid",
         "dtype": {"forbid": ("f64",)},
+        "perf": _PERF_DEFAULT,
         "hbm": "telemetry_limit",
     },
 }
@@ -283,6 +313,22 @@ def validate_registry():
         if hbm not in (None, "telemetry_limit"):
             raise ValueError(f"{family}: hbm must be 'telemetry_limit' or "
                              f"absent, got {hbm!r}")
+        perf = contract.get("perf")
+        if perf is not None:
+            from .artifact import COLLECTIVE_KINDS
+
+            unknown = set(perf) - {"overlap_collectives", "dot_operands"}
+            if unknown:
+                raise ValueError(f"{family}: unknown perf keys {unknown}")
+            bad = [k for k in perf.get("overlap_collectives", ())
+                   if k not in COLLECTIVE_KINDS]
+            if bad:
+                raise ValueError(f"{family}: overlap_collectives names "
+                                 f"unknown collective kind(s) {bad}")
+            if perf.get("dot_operands") not in (None, "meta"):
+                raise ValueError(f"{family}: perf.dot_operands must be "
+                                 f"'meta' or absent, got "
+                                 f"{perf.get('dot_operands')!r}")
     for name, table in COLLECTIVE_PROFILES.items():
         if 1 not in table or table[1] != {}:
             raise ValueError(f"profile {name}: tp=1 must be the empty "

@@ -32,6 +32,16 @@ _ACCUM_DTYPES = {
     "float16": ("f16", "f32"),
 }
 
+# allowed dot_general OPERAND dtypes per model dtype (the hot-dot-upcast
+# rule): a bf16 model's matmuls must feed bf16 operands — an f32 operand
+# halves MXU rate and doubles weight traffic. Distinct from
+# _ACCUM_DTYPES, which governs the dot OUTPUT (accumulation) width.
+_DOT_DTYPES = {
+    "float32": ("f32",),
+    "bfloat16": ("bf16",),
+    "float16": ("f16",),
+}
+
 
 def tiny_config(layers: int = 1, hidden: int = 32, heads: int = 2,
                 vocab: int = 64, seq: int = 64, dtype: str = "float32"):
@@ -53,6 +63,7 @@ def _base_meta(tp, donate, params, cfg, hbm_limit_bytes, kv_int8):
         "param_shapes": param_leaf_shapes(params),
         "dims": {"hidden": cfg.hidden_size, "vocab": cfg.vocab_size},
         "accum_dtypes": _ACCUM_DTYPES.get(cfg.dtype, ()),
+        "dot_dtypes": _DOT_DTYPES.get(cfg.dtype, ()),
         "int8_kv": bool(kv_int8),
         "hbm_limit_bytes": int(hbm_limit_bytes),
     }

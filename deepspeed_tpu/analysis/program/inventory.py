@@ -1,0 +1,327 @@
+"""Compiled-program inventories and the ds-perf regression diff.
+
+Where ds-audit judges an artifact against *declared contracts*, ds-perf
+judges it against *its own accepted past*: every family/variant/width
+gets a structural fingerprint of its compiled program — op-kind
+histogram, fusion count, per-kind collective forms and bytes,
+dot_general signatures, program size, cost/memory analysis numbers —
+checked into ``tools/ds_perf_baseline.json``. The diff is the gate: a
+PR that fattens a tick program, drops an async collective pair, or
+upcasts a hot matmul fails with the precise rule id and family named,
+exactly as ds-lint fails on new source debt.
+
+Tolerances are per-field (``DEFAULT_TOLERANCES``): exact fields
+(collective counts, dot signatures) fail on any change; noisy fields
+(program bytes, flops, op counts) carry a relative band plus an
+absolute slack so recompiles under the same jaxlib never flap the gate.
+Accepting an intentional change is ``ds_perf.py --write-baseline`` —
+the inventory baseline IS the accepted state; there is no second
+findings-baseline to hide debt in.
+
+Stdlib-only: the artifact side arrives pre-extracted (ProgramArtifact),
+and the diff side (``ds_perf.py --diff``) loads this module through the
+standalone alias loader with no jax in the interpreter.
+"""
+
+import json
+import re
+
+from ..core import Finding, SEVERITY_ERROR, SEVERITY_WARNING
+
+INVENTORY_VERSION = 1
+
+RULE_DRIFT = "inventory-drift"
+RULE_SYNC = "sync-collective"
+RULE_UPCAST = "hot-dot-upcast"
+RULE_BLOAT = "program-bloat"
+
+# severity per diff rule (mirrors the rule classes in .rules — kept here
+# so the jax-free diff path needs no rule instances)
+_DIFF_SEVERITY = {
+    RULE_DRIFT: SEVERITY_ERROR,
+    RULE_SYNC: SEVERITY_ERROR,
+    RULE_UPCAST: SEVERITY_ERROR,
+    RULE_BLOAT: SEVERITY_WARNING,
+}
+
+# One compiled-HLO op instruction: `%name = TYPE opkind(...)` where TYPE
+# is a tensor type or a tuple `(...)`. The capture is the op kind; async
+# halves (`all-reduce-start` / `-done`) count as their own kinds, which
+# is exactly what the histogram wants — a dropped pair changes the shape.
+_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[^\s(]+)\s+([a-zA-Z][\w\-]*)\(")
+
+# per-field drift tolerance: |cur - base| must stay within
+# max(abs, rel * |base|). Fields absent here (collective counts, dot
+# signatures, tp) are exact — any change is a finding.
+DEFAULT_TOLERANCES = {
+    "ops": {"rel": 0.20, "abs": 2},
+    "fusions": {"rel": 0.25, "abs": 2},
+    "program_bytes": {"rel": 0.25, "abs": 4096},
+    "collective_op_bytes": {"rel": 0.25, "abs": 256},
+    "flops": {"rel": 0.25, "abs": 1024},
+    "bytes_accessed": {"rel": 0.25, "abs": 4096},
+    "peak_bytes": {"rel": 0.35, "abs": 4096},
+}
+
+# operand-width rank for upcast detection (integer/bool operands are
+# outside the hot-matmul policy and rank 0)
+_DTYPE_WIDTH = {"f16": 2, "bf16": 2, "f32": 4, "f64": 8}
+
+
+def op_histogram(hlo_text: str) -> dict:
+    """{op kind: count} over every instruction of the compiled HLO text
+    (all computations — fusion bodies and scan bodies included; the
+    *program* shape, not the per-execution trip count)."""
+    ops = {}
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1)
+        ops[kind] = ops.get(kind, 0) + 1
+    return ops
+
+
+def program_key(artifact) -> str:
+    """Stable inventory key for one artifact. Labels collide for the
+    greedy/sampled compilations of one tick family (same family, variant
+    and width) — the sampler mode disambiguates them deterministically,
+    unlike ds-audit's first-come ``#2`` suffixing."""
+    key = artifact.label
+    if "sampled" in artifact.meta:
+        key += "#sampled" if artifact.meta.get("sampled") else "#greedy"
+    return key
+
+
+def build_inventory(artifact) -> dict:
+    """The structural fingerprint of one compiled program (pure data —
+    everything the diff, the cost model and the baseline need, none of
+    the texts)."""
+    mem = artifact.memory or {}
+    cost = artifact.cost or {}
+    sigs = {}
+    for ins, out in artifact.dot_outputs():
+        sig = f"{','.join(ins)}->{out}"
+        sigs[sig] = sigs.get(sig, 0) + 1
+    code_bytes = int(mem.get("code_bytes", 0))
+    peak = (int(mem.get("argument_bytes", 0)) + int(mem.get("output_bytes", 0))
+            + int(mem.get("temp_bytes", 0)) - int(mem.get("alias_bytes", 0)))
+    ops = op_histogram(artifact.hlo_text)
+    return {
+        "family": artifact.family,
+        "variant": artifact.variant,
+        "tp": artifact.tp,
+        "ops": ops,
+        "fusions": ops.get("fusion", 0),
+        "collectives": artifact.collective_forms(),
+        "dots": {"count": sum(sigs.values()), "signatures": sigs},
+        # generated_code_size is 0 on backends that don't report it (the
+        # virtual-CPU gate) — the printed HLO length is the stable proxy
+        "program_bytes": code_bytes if code_bytes > 0
+        else len(artifact.hlo_text),
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "peak_bytes": peak,
+    }
+
+
+def build_inventories(artifacts) -> dict:
+    """{program_key: inventory} for a family table; an artifact that
+    failed extraction is skipped (ds-audit's extraction-error rule owns
+    that failure — a fingerprint of a non-program would only mask it)."""
+    out = {}
+    for a in artifacts:
+        if a.error:
+            continue
+        out[program_key(a)] = build_inventory(a)
+    return out
+
+
+# -- diff ---------------------------------------------------------------
+
+def _within(cur, base, tol) -> bool:
+    return abs(float(cur) - float(base)) <= max(
+        float(tol.get("abs", 0)), float(tol.get("rel", 0.0)) * abs(float(base)))
+
+
+def _finding(rule: str, key: str, message: str, code: str) -> Finding:
+    return Finding(rule_id=rule, severity=_DIFF_SEVERITY[rule], path=key,
+                   line=1, col=0, message=message, code=code[:160])
+
+
+def _max_operand_width(sig: str) -> int:
+    ins = sig.split("->", 1)[0]
+    return max((_DTYPE_WIDTH.get(t.strip(), 0) for t in ins.split(",")),
+               default=0)
+
+
+def _diff_collectives(key: str, cur: dict, base: dict, tol) -> list:
+    out = []
+    for kind in sorted(set(cur) | set(base)):
+        c = cur.get(kind, {"sync": 0, "async": 0, "bytes": 0,
+                           "async_bytes": 0})
+        b = base.get(kind, {"sync": 0, "async": 0, "bytes": 0,
+                            "async_bytes": 0})
+        c_async, b_async = int(c.get("async", 0)), int(b.get("async", 0))
+        c_total = int(c.get("sync", 0)) + c_async
+        b_total = int(b.get("sync", 0)) + b_async
+        if c_async < b_async:
+            out.append(_finding(
+                RULE_SYNC, key,
+                f"{b_async - c_async} {kind} op(s) lost their async "
+                f"-start/-done form vs baseline ({b_async} async -> "
+                f"{c_async}) — the scheduler can no longer hide these "
+                f"bytes under compute",
+                code=f"{kind} async {b_async}->{c_async}"))
+        if c_total != b_total:
+            out.append(_finding(
+                RULE_DRIFT, key,
+                f"collective count drift: {kind} ×{b_total} in baseline, "
+                f"×{c_total} now",
+                code=f"{kind} count {b_total}->{c_total}"))
+        elif not _within(c.get("bytes", 0), b.get("bytes", 0), tol):
+            out.append(_finding(
+                RULE_DRIFT, key,
+                f"collective byte drift: {kind} moved "
+                f"{int(b.get('bytes', 0))} B/dispatch in baseline, "
+                f"{int(c.get('bytes', 0))} now",
+                code=f"{kind} bytes {int(b.get('bytes', 0))}"
+                     f"->{int(c.get('bytes', 0))}"))
+    return out
+
+
+def _diff_dots(key: str, cur: dict, base: dict) -> list:
+    out = []
+    c_sigs = dict(cur.get("signatures") or {})
+    b_sigs = dict(base.get("signatures") or {})
+    gained = {s: c_sigs[s] - b_sigs.get(s, 0) for s in c_sigs
+              if c_sigs[s] > b_sigs.get(s, 0)}
+    lost = {s: b_sigs[s] - c_sigs.get(s, 0) for s in b_sigs
+            if b_sigs[s] > c_sigs.get(s, 0)}
+    upcast = set()
+    for g in sorted(gained):
+        if any(_max_operand_width(g) > _max_operand_width(l_)
+               for l_ in lost):
+            narrower = sorted(l_ for l_ in lost
+                              if _max_operand_width(l_)
+                              < _max_operand_width(g))
+            out.append(_finding(
+                RULE_UPCAST, key,
+                f"dot_general upcast: {gained[g]} new dot(s) with "
+                f"signature {g} replace narrower {', '.join(narrower)} "
+                f"— a hot matmul widened its operands vs baseline",
+                code=f"dot {g} +{gained[g]}"))
+            upcast.add(g)
+    rest_gained = {s: n for s, n in gained.items() if s not in upcast}
+    if rest_gained or (lost and not upcast):
+        moved = ([f"+{n} {s}" for s, n in sorted(rest_gained.items())]
+                 + [f"-{n} {s}" for s, n in sorted(lost.items())])
+        if moved:
+            out.append(_finding(
+                RULE_DRIFT, key,
+                f"dot_general signature drift vs baseline: "
+                f"{', '.join(moved)}",
+                code=f"dots {' '.join(moved)}"))
+    return out
+
+
+def diff_inventories(current: dict, baseline: dict,
+                     tolerances: dict = None) -> list:
+    """Findings for every way ``current`` ({key: inventory}) drifted
+    from ``baseline`` beyond tolerance — sorted like every other
+    analysis result. Empty list == the gate is clean.
+
+    Baseline hygiene is part of the diff: a baseline key with no current
+    program is itself a finding (stale entries are how dead debt hides),
+    and a current program absent from the baseline must be explicitly
+    accepted via ``--write-baseline``.
+    """
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    findings = []
+    for key in sorted(set(baseline) - set(current)):
+        findings.append(_finding(
+            RULE_DRIFT, key,
+            f"stale baseline entry: {key} is in the baseline but no "
+            f"current program produced it — refresh with --write-baseline",
+            code=f"stale {key}"))
+    for key in sorted(set(current) - set(baseline)):
+        findings.append(_finding(
+            RULE_DRIFT, key,
+            f"new program {key} has no baseline entry — accept it with "
+            f"--write-baseline",
+            code=f"unbaselined {key}"))
+    for key in sorted(set(current) & set(baseline)):
+        cur, base = current[key], baseline[key]
+        if int(cur.get("tp", 1)) != int(base.get("tp", 1)):
+            findings.append(_finding(
+                RULE_DRIFT, key,
+                f"mesh width changed: tp{base.get('tp')} in baseline, "
+                f"tp{cur.get('tp')} now",
+                code=f"tp {base.get('tp')}->{cur.get('tp')}"))
+            continue  # every other field legitimately differs across widths
+        findings.extend(_diff_collectives(
+            key, cur.get("collectives") or {}, base.get("collectives") or {},
+            tol["collective_op_bytes"]))
+        findings.extend(_diff_dots(key, cur.get("dots") or {},
+                                   base.get("dots") or {}))
+        c_ops, b_ops = cur.get("ops") or {}, base.get("ops") or {}
+        for kind in sorted(set(c_ops) | set(b_ops)):
+            c_n, b_n = c_ops.get(kind, 0), b_ops.get(kind, 0)
+            if not _within(c_n, b_n, tol["ops"]):
+                findings.append(_finding(
+                    RULE_DRIFT, key,
+                    f"op histogram drift: {kind} ×{b_n} in baseline, "
+                    f"×{c_n} now (beyond ±max({tol['ops']['abs']}, "
+                    f"{int(tol['ops']['rel'] * 100)}%))",
+                    code=f"ops {kind} {b_n}->{c_n}"))
+        for field, bloats in (("fusions", True), ("program_bytes", True),
+                              ("flops", False), ("bytes_accessed", False),
+                              ("peak_bytes", False)):
+            c_v, b_v = cur.get(field, 0), base.get(field, 0)
+            if _within(c_v, b_v, tol[field]):
+                continue
+            grew = float(c_v) > float(b_v)
+            rule = RULE_BLOAT if (bloats and grew) else RULE_DRIFT
+            what = {"fusions": "fusion count",
+                    "program_bytes": "program size (bytes)",
+                    "flops": "cost_analysis flops",
+                    "bytes_accessed": "cost_analysis bytes accessed",
+                    "peak_bytes": "static memory peak (bytes)"}[field]
+            msg = (f"{what} {'grew' if grew else 'shrank'} beyond "
+                   f"tolerance: {b_v} in baseline, {c_v} now")
+            if float(b_v):
+                rel = (float(c_v) - float(b_v)) / abs(float(b_v))
+                msg += f" ({rel:+.0%} vs baseline)"
+            findings.append(_finding(rule, key, msg,
+                                     code=f"{field} {b_v}->{c_v}"))
+    findings.sort(key=lambda f: (f.path, f.rule_id, f.code))
+    return findings
+
+
+# -- baseline file ------------------------------------------------------
+
+def load_baseline(path: str) -> dict:
+    """{key: inventory} from a ds-perf baseline (or ``--json-out``
+    report — both carry the ``programs`` block). Raises ValueError on a
+    version this reader does not understand."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != INVENTORY_VERSION:
+        raise ValueError(
+            f"inventory file {path}: unsupported version "
+            f"{data.get('version')!r} (expected {INVENTORY_VERSION})")
+    return dict(data.get("programs") or {})
+
+
+def save_baseline(path: str, inventories: dict, device_kind: str = ""):
+    payload = {
+        "version": INVENTORY_VERSION,
+        "tool": "ds-perf",
+        "device_kind": device_kind,
+        "programs": {k: inventories[k] for k in sorted(inventories)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")

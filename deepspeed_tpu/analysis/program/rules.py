@@ -387,6 +387,112 @@ class DonationUnexpectedRule(ProgramRule):
                 code=f"unexpected alias {attrs}")
 
 
+class InventoryDriftRule(ProgramRule):
+    """Catalog entry for ds-perf's baseline diff (the findings are built
+    by :func:`..inventory.diff_inventories`, not per-artifact — this
+    class exists so --list-rules and the SARIF rule table describe the
+    id). Fires when a family's compiled-program fingerprint (op
+    histogram, collective counts/bytes, dot signatures, flops, bytes
+    accessed, static peak) moves beyond per-field tolerance without a
+    baseline update."""
+
+    id = "inventory-drift"
+    severity = SEVERITY_ERROR
+    description = ("compiled-program inventory drifted from "
+                   "tools/ds_perf_baseline.json beyond tolerance")
+
+    def check_program(self, artifact, contract):
+        return ()  # diff-driven: see inventory.diff_inventories
+
+
+class ProgramBloatRule(ProgramRule):
+    """Catalog entry for ds-perf's baseline diff: program size or fusion
+    count GREW beyond tolerance (a fattened tick program pays its extra
+    bytes on every dispatch); shrinkage reports as inventory-drift."""
+
+    id = "program-bloat"
+    severity = SEVERITY_WARNING
+    description = ("program bytes / fusion count grew beyond tolerance "
+                   "vs the ds-perf baseline")
+
+    def check_program(self, artifact, contract):
+        return ()  # diff-driven: see inventory.diff_inventories
+
+
+class SyncCollectiveRule(ProgramRule):
+    """A collective kind the family's contract declares overlappable
+    (``perf.overlap_collectives``) compiled in blocking form at tp>1 —
+    the program serializes bytes the schedule was designed to hide under
+    compute (ROADMAP item 3's regression mode). The baseline diff
+    additionally fires this id when a program LOSES async pairs it had,
+    whether or not the contract declares them."""
+
+    id = "sync-collective"
+    severity = SEVERITY_ERROR
+    description = ("a contract-declared overlappable collective compiled "
+                   "in blocking (non -start/-done) form")
+
+    def check_program(self, artifact, contract):
+        if contract is None:
+            return
+        declared = (contract.get("perf") or {}).get("overlap_collectives", ())
+        if not declared or artifact.error or not artifact.hlo_text:
+            return
+        if artifact.tp <= 1:
+            return  # nothing to overlap on one chip
+        forms = artifact.collective_forms()
+        for kind in declared:
+            slot = forms.get(kind)
+            if slot and slot["sync"] > 0:
+                yield self.finding(
+                    artifact,
+                    f"{slot['sync']} {kind} op(s) compiled in blocking "
+                    f"form ({slot['bytes'] - slot['async_bytes']} "
+                    f"B/dispatch serialized) but the "
+                    f"{artifact.family!r} contract declares {kind} "
+                    f"overlappable — the schedule cannot hide these "
+                    f"bytes under compute",
+                    code=f"sync {kind} x{slot['sync']}")
+
+
+class HotDotUpcastRule(ProgramRule):
+    """A dot_general whose operands are wider than the model dtype's
+    policy allows (``meta.dot_dtypes`` — stamped by the family builders
+    from the model dtype): an fp32-operand matmul in a bf16 model runs
+    at half MXU rate and doubles its weight traffic. Accumulation width
+    is the separate dtype-policy rule; this one pins the OPERANDS."""
+
+    id = "hot-dot-upcast"
+    severity = SEVERITY_ERROR
+    description = ("dot_general operand dtype wider than the model's "
+                   "dot dtype policy (meta.dot_dtypes)")
+
+    def check_program(self, artifact, contract):
+        if contract is None or (contract.get("perf") or {}) \
+                .get("dot_operands") != "meta":
+            return
+        if artifact.error or not artifact.stable_text:
+            return
+        allowed = set(artifact.meta.get("dot_dtypes", ()))
+        if not allowed:
+            return
+        float_tokens = {"f16", "bf16", "f32", "f64"}
+        seen = set()
+        for ins, out in artifact.dot_outputs():
+            bad = tuple(t for t in ins
+                        if t in float_tokens and t not in allowed)
+            if not bad or (ins, out) in seen:
+                continue
+            seen.add((ins, out))
+            yield self.finding(
+                artifact,
+                f"dot_general({', '.join(ins)}) -> {out} uses operand "
+                f"dtype(s) {', '.join(sorted(set(bad)))} outside the "
+                f"model's dot policy ({', '.join(sorted(allowed))}) — a "
+                f"hot matmul was upcast",
+                code=f"dot {','.join(ins)}->{out}")
+
+
 def program_rules():
     """The default ds-audit rule set, one instance each."""
     return [
@@ -399,6 +505,19 @@ def program_rules():
         HostTransferRule(),
         DtypePolicyRule(),
         HbmCeilingRule(),
+    ]
+
+
+def perf_rules():
+    """The ds-perf rule set: two live per-artifact checks plus the two
+    catalog-only diff rules (their findings come from
+    inventory.diff_inventories). Kept OUT of program_rules() — ds-audit
+    stays a contract auditor; ds-perf owns the perf gate."""
+    return [
+        InventoryDriftRule(),
+        ProgramBloatRule(),
+        SyncCollectiveRule(),
+        HotDotUpcastRule(),
     ]
 
 

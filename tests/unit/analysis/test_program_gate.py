@@ -29,6 +29,7 @@ from deepspeed_tpu.analysis.program.families import (
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 BASELINE = os.path.join(REPO, "tools", "ds_audit_baseline.json")
+PERF_BASELINE = os.path.join(REPO, "tools", "ds_perf_baseline.json")
 
 HBM_LIMIT = 1 << 30  # generous: exercises the ceiling rule, never trips
 
@@ -123,6 +124,62 @@ def test_tp2_inventory_matches_the_pinned_profiles(artifacts):
         for art in table[f"program://{fam}@tp2"]:
             assert art.collective_inventory() == \
                 expected_collectives(fam, 2), fam
+
+
+def test_perf_inventory_clean_against_checked_in_baseline(artifacts):
+    """The ds-perf tier-1 gate: the full tp∈{1,2} family table
+    fingerprints clean against tools/ds_perf_baseline.json with ZERO
+    stale entries — the inventory baseline IS the accepted program
+    state, so any structural drift (op histogram, collectives, dots,
+    size, cost numbers) fails here with the rule id + family named.
+    Accept intentional changes with ``ds_perf.py --write-baseline``."""
+    from deepspeed_tpu.analysis.program import (
+        build_inventories,
+        diff_inventories,
+    )
+    from deepspeed_tpu.analysis.program.inventory import load_baseline
+
+    inventories = build_inventories(artifacts)
+    baseline = load_baseline(PERF_BASELINE)
+    findings = diff_inventories(inventories, baseline)
+    assert findings == [], "\n".join(
+        f"  {f.path}: [{f.severity}] {f.rule_id}: {f.message}"
+        for f in findings)
+    # every compiled program is fingerprinted — a family added without a
+    # --write-baseline run fails above as 'unbaselined', and the reverse
+    # (baseline outliving its family) as 'stale'
+    assert set(inventories) == set(baseline)
+
+
+def test_perf_rules_clean_over_the_live_table(artifacts):
+    """The artifact-side perf rules (sync-collective, hot-dot-upcast)
+    hold over the real table: no contract-declared overlappable
+    collective compiles blocking, no dot widens past the model dtype's
+    operand policy."""
+    from deepspeed_tpu.analysis.program import ProgramAuditor, perf_rules
+
+    result = ProgramAuditor(rules=perf_rules()).audit(artifacts)
+    assert result.findings == [], [
+        (f.rule_id, f.path, f.message) for f in result.findings]
+
+
+def test_overlap_readiness_reports_per_tp2_family(artifacts):
+    """Overlap-readiness is defined (not None) exactly for the programs
+    that move collective bytes, and — the honest part — reads 0.0 today:
+    the virtual-CPU backend compiles every collective in blocking form,
+    which is the calibrated starting point ROADMAP item 3 must move."""
+    from deepspeed_tpu.analysis.program import overlap_readiness
+
+    readiness = {}
+    for a in artifacts:
+        forms = a.collective_forms()
+        readiness[(a.label, a.meta.get("sampled"))] = overlap_readiness(forms)
+    with_bytes = {k: r for k, r in readiness.items() if r is not None}
+    assert with_bytes, "no tp2 program moves collective bytes?"
+    assert all(r == 0.0 for r in with_bytes.values()), with_bytes
+    for (label, _), r in readiness.items():
+        if label.endswith("@tp1"):
+            assert r is None, label  # replicated: nothing to overlap
 
 
 def test_no_host_transfers_and_no_f64(artifacts):

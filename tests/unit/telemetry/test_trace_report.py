@@ -606,3 +606,109 @@ def test_cli_audit_flag(tmp_path):
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 2
+
+
+# -- ds-perf predicted-vs-measured cross-check (--perf) ---------------------
+
+PERF_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "fixtures", "mini_perf.json")
+
+
+def _perf_report(lb_tick=0.001, lb_iter=0.004, lb_step=0.0015):
+    def prog(family, lb, variant=""):
+        return {"family": family, "variant": variant, "tp": 1,
+                "predicted": {"device_kind": "cpu", "lb_ms": lb,
+                              "bound_by": "hbm"}}
+    return {"version": 1, "tool": "ds-perf", "device_kind": "cpu",
+            "programs": {
+                "program://pool_tick[plain]@tp1#greedy":
+                    prog("pool_tick", lb_tick, "plain"),
+                "program://train_micro@tp1": prog("train_micro", lb_iter),
+                "program://train_apply@tp1": prog("train_apply", lb_step),
+                "program://decode_step@tp1": prog("decode_step", 0.002),
+            }}
+
+
+def test_perf_crosscheck_on_the_fixture_trace():
+    """Acceptance surface: the mini_trace fixture measured against the
+    mini_perf fixture yields predicted-vs-measured rows with ok
+    verdicts — measured tick = mean(0.8+0.4, 0.6+0.2) = 1.0 ms, train
+    iter mean 3.8 ms, apply mean ~1.9 ms, all far above the cpu-peaks
+    lower bounds."""
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    with open(PERF_FIXTURE) as fh:
+        report = json.load(fh)
+    rows = ds_trace_report.perf_crosscheck(events, report)
+    tick = rows["program://pool_tick[plain]@tp1#greedy"]
+    assert tick["verdict"] == "ok"
+    assert tick["measured_ms"] == 1.0
+    assert tick["source"] == "serving_tick dispatch+block x2"
+    micro = rows["program://train_micro@tp1"]
+    assert micro["verdict"] == "ok"
+    assert micro["measured_ms"] == round((5.8 + 2.9 + 2.7) / 3, 3)
+    assert micro["source"] == "train_step iter_ms x3"
+    apply_ = rows["program://train_apply@tp1"]
+    assert apply_["verdict"] == "ok"
+    assert apply_["measured_ms"] == round((3.0 + 1.4 + 1.3) / 3, 3)
+    # a family with no trace counterpart is static-only, never a warning
+    assert rows["program://decode_step@tp1"]["verdict"] == "static-only"
+    text = ds_trace_report.format_perf_crosscheck(rows, 0.1)
+    assert "ok" in text and "static-only" in text
+    assert "warning:" not in text
+
+
+def test_perf_crosscheck_warns_when_measurement_beats_the_bound():
+    """A measured time below the static lower bound (beyond slack) means
+    the audited program is not the one that ran — WARN, mirroring the
+    --audit contract."""
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    rows = ds_trace_report.perf_crosscheck(
+        events, _perf_report(lb_tick=50.0))
+    tick = rows["program://pool_tick[plain]@tp1#greedy"]
+    assert tick["verdict"] == "WARN"
+    assert tick["ratio"] == round(1.0 / 50.0, 3)
+    text = ds_trace_report.format_perf_crosscheck(rows, 0.1)
+    assert "warning:" in text and "BELOW" in text
+
+
+def test_perf_crosscheck_slack_absorbs_noise():
+    """Beating the bound by less than the slack fraction is measurement
+    noise, not a contradiction."""
+    events, _ = ds_trace_report.load_events(FIXTURE)
+    rows = ds_trace_report.perf_crosscheck(
+        events, _perf_report(lb_tick=1.05), slack=0.1)
+    assert rows["program://pool_tick[plain]@tp1#greedy"]["verdict"] == "ok"
+    rows = ds_trace_report.perf_crosscheck(
+        events, _perf_report(lb_tick=1.05), slack=0.0)
+    assert rows["program://pool_tick[plain]@tp1#greedy"]["verdict"] == "WARN"
+
+
+def test_cli_perf_flag(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--perf", PERF_FIXTURE],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "Perf cross-check" in proc.stdout
+    assert "ok" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--perf", PERF_FIXTURE, "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rows = json.loads(proc.stdout)["perf_crosscheck"]
+    assert rows["program://train_micro@tp1"]["verdict"] == "ok"
+    # unreadable perf report is a usage error, like --audit
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--perf", "/nonexistent.json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2
+    # a report with no predictions is an empty-input error, not a crash
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"version": 1, "programs": {}}))
+    proc = subprocess.run(
+        [sys.executable, CLI, FIXTURE, "--perf", str(empty)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1

@@ -3,15 +3,19 @@
 #
 #   1. ds-lint  --changed --format sarif   (source contracts, diff-scoped)
 #   2. ds-audit --format sarif             (compiled-program contracts)
-#   3. jax-free serving tests              (router/policies/faults/recovery/
+#   3. ds-perf  --format sarif             (compiled-program inventory vs
+#                                           tools/ds_perf_baseline.json +
+#                                           perf rules; the inventory report
+#                                           lands as an artifact for diffing)
+#   4. jax-free serving tests              (router/policies/faults/recovery/
 #                                           scenarios/autoscaler, sub-second,
 #                                           proves no jax import)
-#   4. scenario-matrix smoke               (scenarios/*.jsonl load, compile
+#   5. scenario-matrix smoke               (scenarios/*.jsonl load, compile
 #                                           deterministically, byte-match
 #                                           builtin_matrix(); traced chaos
 #                                           run round-trips zero-orphan and
 #                                           emits ci_perfetto_smoke.json)
-#   5. tier-1 tests                        (the ROADMAP.md command)
+#   6. tier-1 tests                        (the ROADMAP.md command)
 #
 # Usage:  tools/ci_check.sh [BASE_REF] [SARIF_DIR]
 #   BASE_REF   git ref to diff against for ds-lint --changed (default HEAD,
@@ -30,7 +34,7 @@ BASE_REF="${1:-HEAD}"
 SARIF_DIR="${2:-${REPO}/ci_artifacts}"
 mkdir -p "${SARIF_DIR}"
 
-echo "ci_check: [1/5] ds-lint --changed ${BASE_REF} --format sarif"
+echo "ci_check: [1/6] ds-lint --changed ${BASE_REF} --format sarif"
 python "${REPO}/tools/ds_lint.py" --changed "${BASE_REF}" --format sarif \
     > "${SARIF_DIR}/ds_lint.sarif"
 rc=$?
@@ -39,7 +43,7 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [2/5] ds-audit --format sarif"
+echo "ci_check: [2/6] ds-audit --format sarif"
 python "${REPO}/tools/ds_audit.py" --format sarif \
     > "${SARIF_DIR}/ds_audit.sarif"
 rc=$?
@@ -48,7 +52,18 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [3/5] jax-free serving tests (tools/ci_jaxfree_tests.py)"
+echo "ci_check: [3/6] ds-perf --format sarif (inventory vs baseline + perf rules)"
+python "${REPO}/tools/ds_perf.py" --format sarif \
+    --json-out "${SARIF_DIR}/ds_perf_inventory.json" \
+    > "${SARIF_DIR}/ds_perf.sarif"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "ci_check: ds-perf FAILED (exit $rc) — findings in ${SARIF_DIR}/ds_perf.sarif," \
+         "inventory diff in ${SARIF_DIR}/ds_perf_inventory.json" >&2
+    exit $rc
+fi
+
+echo "ci_check: [4/6] jax-free serving tests (tools/ci_jaxfree_tests.py)"
 python "${REPO}/tools/ci_jaxfree_tests.py"
 rc=$?
 if [ $rc -ne 0 ]; then
@@ -56,7 +71,7 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [4/5] scenario-matrix smoke + tracing round-trip (tools/ci_scenario_smoke.py)"
+echo "ci_check: [5/6] scenario-matrix smoke + tracing round-trip (tools/ci_scenario_smoke.py)"
 python "${REPO}/tools/ci_scenario_smoke.py" "${SARIF_DIR}"
 rc=$?
 if [ $rc -ne 0 ]; then
@@ -64,7 +79,7 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
-echo "ci_check: [5/5] tier-1 tests (ROADMAP.md command)"
+echo "ci_check: [6/6] tier-1 tests (ROADMAP.md command)"
 cd "${REPO}" || exit 2
 rm -f /tmp/_t1.log
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -21,6 +21,7 @@ import argparse
 import importlib.util
 import json
 import os
+import re
 import sys
 
 SUPPORTED_SCHEMA = 1
@@ -896,6 +897,117 @@ def format_audit_crosscheck(rows, tolerance):
     return "\n".join(lines) + "\n"
 
 
+_PERF_KEY_RE = re.compile(r"^program://(?P<family>[^\[@#]+)")
+
+
+def perf_crosscheck(events, perf_report, slack=0.1):
+    """Static-vs-runtime step-time cross-check: ds-perf's roofline lower
+    bound per compiled program (the ``programs`` block of ``ds_perf.py
+    --json-out`` / ``--format json``) against what the trace measured.
+
+    Only some families have a measured counterpart in the trace today:
+
+    - ``pool_tick*`` / ``pool_spec_tick*`` -> mean ``serving_tick``
+      dispatch_ms + block_ms (one tick = one dispatch plus the device
+      block that drains it)
+    - ``train_micro`` -> mean ``train_step`` iter_ms (at accumulation 1
+      the iteration is micro-step dominated)
+    - ``train_apply`` -> mean ``train_step`` step_ms
+
+    The roofline is a LOWER bound at the report's device peaks, so the
+    verdicts read differently from --audit's ratio band:
+
+    - ``ok``: measured >= predicted * (1 - slack). Reality respects the
+      bound; beating it by less than ``slack`` is measurement noise.
+    - ``WARN``: measured < predicted * (1 - slack) — the measurement
+      beats physics, so the audited program is NOT the one that ran, or
+      the peaks table is wrong for this host.
+    - ``static-only``: no measured counterpart in the trace.
+    """
+    tick_vals = []
+    for ev in events:
+        if ev.get("kind") != "serving_tick":
+            continue
+        d, b = ev.get("dispatch_ms"), ev.get("block_ms")
+        if isinstance(d, (int, float)) and not isinstance(d, bool):
+            total = float(d)
+            if isinstance(b, (int, float)) and not isinstance(b, bool):
+                total += float(b)
+            tick_vals.append(total)
+    iter_vals, step_vals = [], []
+    for ev in events:
+        if ev.get("kind") != "train_step":
+            continue
+        for field, dest in (("iter_ms", iter_vals), ("step_ms", step_vals)):
+            v = ev.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                dest.append(float(v))
+    measured = {}
+    if tick_vals:
+        measured["tick"] = (sum(tick_vals) / len(tick_vals),
+                            f"serving_tick dispatch+block x{len(tick_vals)}")
+    if iter_vals:
+        measured["iter"] = (sum(iter_vals) / len(iter_vals),
+                            f"train_step iter_ms x{len(iter_vals)}")
+    if step_vals:
+        measured["step"] = (sum(step_vals) / len(step_vals),
+                            f"train_step step_ms x{len(step_vals)}")
+
+    rows = {}
+    for key in sorted(perf_report.get("programs") or {}):
+        entry = perf_report["programs"].get(key) or {}
+        pred = entry.get("predicted") or {}
+        lb = pred.get("lb_ms")
+        if not isinstance(lb, (int, float)) or isinstance(lb, bool):
+            continue
+        m = _PERF_KEY_RE.match(key)
+        family = m.group("family") if m else ""
+        if family.startswith(("pool_tick", "pool_spec_tick")):
+            bucket = "tick"
+        elif family == "train_micro":
+            bucket = "iter"
+        elif family == "train_apply":
+            bucket = "step"
+        else:
+            bucket = None
+        row = {"family": family, "predicted_lb_ms": float(lb),
+               "bound_by": pred.get("bound_by")}
+        got = measured.get(bucket) if bucket else None
+        if got is None:
+            row["verdict"] = "static-only"
+        else:
+            mean_ms, source = got
+            row["measured_ms"] = round(mean_ms, 3)
+            row["source"] = source
+            if lb > 0:
+                row["ratio"] = round(mean_ms / float(lb), 3)
+            row["verdict"] = ("ok" if mean_ms >= float(lb) * (1.0 - slack)
+                              else "WARN")
+        rows[key] = row
+    return rows
+
+
+def format_perf_crosscheck(rows, slack):
+    lines = ["Perf cross-check — ds-perf roofline lower bound vs trace "
+             f"measurement (slack {slack:g})",
+             f"  {'program':<40} {'predicted lb_ms':>16} {'measured_ms':>12} "
+             f"{'ratio':>10}  verdict"]
+    for key, row in rows.items():
+        short = key[len("program://"):] if key.startswith("program://") else key
+        ratio = row.get("ratio")
+        lines.append(
+            f"  {short:<40} {row['predicted_lb_ms']:>16} "
+            f"{row.get('measured_ms', '-'):>12} "
+            f"{ratio if ratio is not None else '-':>10}  {row['verdict']}")
+    warns = [k for k, r in rows.items() if r["verdict"] == "WARN"]
+    if warns:
+        lines.append(f"  warning: {len(warns)} program(s) measured BELOW "
+                     "their static roofline lower bound — the audited "
+                     "program is not the one that ran, or the peaks table "
+                     "is wrong for this host")
+    return "\n".join(lines) + "\n"
+
+
 def find_timeline(timelines, needle):
     """Resolve --request: an exact trace_id match first, else the unique
     timeline whose trace_id ends with ``/<needle>`` (so ``--request 5``
@@ -1065,6 +1177,15 @@ def main(argv=None):
     ap.add_argument("--audit-tolerance", type=float, default=0.5,
                     help="accepted measured/static ratio band "
                          "[T, 1/T] for --audit (default 0.5)")
+    ap.add_argument("--perf", metavar="PERF_JSON", default=None,
+                    help="cross-check ds-perf's roofline lower bound per "
+                         "program (ds_perf.py --json-out report) against "
+                         "the trace's measured serving_tick/train_step "
+                         "times; a measurement below the bound warns")
+    ap.add_argument("--perf-slack", type=float, default=0.1,
+                    help="fraction below the predicted lower bound still "
+                         "accepted as measurement noise for --perf "
+                         "(default 0.1)")
     ap.add_argument("--request", metavar="RID", default=None,
                     help="one request's reconstructed span timeline: the "
                          "causal tree + critical-path breakdown for this "
@@ -1118,6 +1239,29 @@ def main(argv=None):
         else:
             sys.stdout.write(
                 format_audit_crosscheck(rows, args.audit_tolerance))
+        return 0
+
+    if args.perf:
+        try:
+            with open(args.perf) as fh:
+                perf_report = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"error: cannot read perf report {args.perf}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not (0.0 <= args.perf_slack < 1.0):
+            print("error: --perf-slack must be in [0, 1)", file=sys.stderr)
+            return 2
+        rows = perf_crosscheck(events, perf_report, slack=args.perf_slack)
+        if not rows:
+            print("no programs with roofline predictions in the perf "
+                  "report (run ds_perf.py with --json-out)", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps({"perf_crosscheck": rows}, indent=2,
+                             sort_keys=True))
+        else:
+            sys.stdout.write(format_perf_crosscheck(rows, args.perf_slack))
         return 0
 
     if args.request or args.slowest is not None or args.blame:

@@ -29,8 +29,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-V5E_PEAK_FLOPS = 197e12  # bf16 MXU, one v5e chip
-V5E_HBM_BW = 819e9       # bytes/s
+# ONE source of truth for device peaks: the shared table in
+# analysis/program/costmodel.py (also behind _bench_impl's MFU math and
+# the ds-perf roofline gate).
+from deepspeed_tpu.analysis.program.costmodel import peaks_for, roofline_ms
+
+_V5E = peaks_for("v5e")
+V5E_PEAK_FLOPS = _V5E.flops  # bf16 MXU, one v5e chip
+V5E_HBM_BW = _V5E.hbm_bw     # bytes/s
 
 SEQ = 1024
 BS = 8
@@ -83,12 +89,13 @@ def analyze(attn: str, remat: bool):
     mem = compiled.memory_analysis()
     flops = float(cost.get("flops", 0.0))
     bytes_acc = float(cost.get("bytes accessed", 0.0))
+    bounds = roofline_ms(flops, bytes_acc, 0.0, _V5E)
     out = {
         "config": f"{attn}{'+remat' if remat else '+no-remat'}",
         "hlo_flops_G": round(flops / 1e9, 1),
         "hlo_bytes_accessed_GB": round(bytes_acc / 1e9, 2),
-        "roofline_mxu_ms": round(flops / V5E_PEAK_FLOPS * 1e3, 1),
-        "roofline_hbm_ms": round(bytes_acc / V5E_HBM_BW * 1e3, 1),
+        "roofline_mxu_ms": round(bounds["mxu_ms"], 1),
+        "roofline_hbm_ms": round(bounds["hbm_ms"], 1),
     }
     if mem is not None:
         out["temp_alloc_GB"] = round(mem.temp_size_in_bytes / 1e9, 2)
@@ -176,9 +183,10 @@ def main():
 
     print(f"# perf_budget: backend={jax.default_backend()} "
           f"devices={jax.device_count()}")
-    print("# NOT a silicon measurement. Roofline at v5e peaks "
-          "(197 TF bf16, 819 GB/s). Off-TPU, pallas rows use interpreter "
-          "HLO: read their analytic block, not hlo_*.")
+    print(f"# NOT a silicon measurement. Roofline at v5e peaks "
+          f"({V5E_PEAK_FLOPS / 1e12:.0f} TF bf16, "
+          f"{V5E_HBM_BW / 1e9:.0f} GB/s). Off-TPU, pallas rows use "
+          f"interpreter HLO: read their analytic block, not hlo_*.")
     rows = []
     for attn, remat in [("xla", True), ("xla", False), ("pallas", False)]:
         try:
